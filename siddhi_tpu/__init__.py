@@ -1,0 +1,13 @@
+"""siddhi_tpu — a TPU-native streaming CEP framework.
+
+A brand-new framework with the capabilities of Siddhi (streaming SQL: filters,
+windows, joins, pattern/sequence NFA matching, partitions, tables, aggregations,
+snapshots, sources/sinks), designed TPU-first: queries compile to vectorized
+micro-batch programs (JAX/XLA/Pallas) with all mutable state held in pytrees, and a
+host interpreter runtime serves as the semantic oracle and cold-path fallback.
+"""
+
+__version__ = "0.1.0"
+
+from . import query_api
+from .compiler import SiddhiCompiler, parse, parse_on_demand_query, parse_query

@@ -1,0 +1,63 @@
+"""SiddhiQL compiler front end.
+
+Reference: ``modules/siddhi-query-compiler`` — ``SiddhiCompiler.parse`` at
+``SiddhiCompiler.java:61`` plus ``updateVariables`` (``${var}`` substitution used by
+``SiddhiManager.createSiddhiAppRuntime``, ``SiddhiManager.java:94-97``).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from ..query_api import OnDemandQuery, Query, SiddhiApp
+from .parser import Parser, SiddhiParserError
+from .tokenizer import TokenizeError, tokenize
+
+__all__ = [
+    "SiddhiCompiler",
+    "SiddhiParserError",
+    "TokenizeError",
+    "parse",
+    "parse_query",
+    "parse_on_demand_query",
+    "update_variables",
+]
+
+_VAR_RE = re.compile(r"\$\{(\w+)\}")
+
+
+def update_variables(app_text: str, env: dict | None = None) -> str:
+    """Substitute ``${var}`` from env/system properties (SiddhiCompiler.updateVariables)."""
+    source = env if env is not None else os.environ
+
+    def sub(m: re.Match) -> str:
+        name = m.group(1)
+        if name not in source:
+            raise SiddhiParserError(f"no system/environment variable found for ${{{name}}}")
+        return str(source[name])
+
+    return _VAR_RE.sub(sub, app_text)
+
+
+def parse(app_text: str) -> SiddhiApp:
+    return Parser(app_text).parse_app()
+
+
+def parse_query(query_text: str) -> Query:
+    p = Parser(query_text)
+    anns = p.parse_annotations()
+    q = p.parse_query()
+    q.annotations = anns + q.annotations
+    return q
+
+
+def parse_on_demand_query(text: str) -> OnDemandQuery:
+    return Parser(text).parse_on_demand_query()
+
+
+class SiddhiCompiler:
+    parse = staticmethod(parse)
+    parse_query = staticmethod(parse_query)
+    parse_on_demand_query = staticmethod(parse_on_demand_query)
+    update_variables = staticmethod(update_variables)

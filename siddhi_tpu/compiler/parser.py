@@ -1,0 +1,1003 @@
+"""SiddhiQL recursive-descent parser → query_api AST.
+
+Plays the role of the reference's ANTLR visitor
+(``modules/siddhi-query-compiler/src/main/java/io/siddhi/query/compiler/internal/
+SiddhiQLBaseVisitorImpl.java``, 3,080 LoC) and grammar (``SiddhiQL.g4``, 918 lines),
+re-expressed as a hand-rolled parser over the tokenizer's output. Covers: stream /
+table / window / trigger / aggregation / function definitions, annotations, single /
+join / pattern / sequence queries, partitions, output rate limiting, insert / delete /
+update / update-or-insert / return actions, and on-demand (store) queries.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+from ..query_api import (
+    AbsentStreamStateElement,
+    AggregationDefinition,
+    And,
+    Annotation,
+    AttributeFunction,
+    Compare,
+    CompareOp,
+    Constant,
+    CountStateElement,
+    DataType,
+    DeleteStream,
+    EventOutputRate,
+    EventTrigger,
+    EveryStateElement,
+    Expression,
+    Filter,
+    FunctionDefinition,
+    In,
+    InsertIntoStream,
+    IsNull,
+    JoinInputStream,
+    JoinType,
+    LAST_INDEX,
+    LogicalStateElement,
+    LogicalType,
+    MathExpr,
+    MathOp,
+    Minus,
+    NextStateElement,
+    Not,
+    OnDemandQuery,
+    OnDemandQueryType,
+    Or,
+    OrderByAttribute,
+    OrderByOrder,
+    OutputAttribute,
+    OutputEventsFor,
+    OutputEventType,
+    OutputRateType,
+    Partition,
+    PartitionType,
+    Query,
+    RangePartitionProperty,
+    ReturnStream,
+    Selector,
+    SiddhiApp,
+    SingleInputStream,
+    SnapshotOutputRate,
+    StateElement,
+    StateInputStream,
+    StateInputStreamType,
+    StreamDefinition,
+    StreamFunction,
+    StreamStateElement,
+    TableDefinition,
+    TimeOutputRate,
+    TimePeriodDuration,
+    TriggerDefinition,
+    UpdateOrInsertStream,
+    UpdateSetAttribute,
+    UpdateStream,
+    Variable,
+    Window,
+    WindowDefinition,
+)
+from .tokenizer import PRIMITIVE_TYPES, TIME_UNITS, Token, TokenType, tokenize
+
+
+class SiddhiParserError(SyntaxError):
+    pass
+
+
+_DURATIONS = {
+    "sec": TimePeriodDuration.SECONDS, "seconds": TimePeriodDuration.SECONDS,
+    "second": TimePeriodDuration.SECONDS,
+    "min": TimePeriodDuration.MINUTES, "minutes": TimePeriodDuration.MINUTES,
+    "minute": TimePeriodDuration.MINUTES,
+    "hour": TimePeriodDuration.HOURS, "hours": TimePeriodDuration.HOURS,
+    "day": TimePeriodDuration.DAYS, "days": TimePeriodDuration.DAYS,
+    "month": TimePeriodDuration.MONTHS, "months": TimePeriodDuration.MONTHS,
+    "year": TimePeriodDuration.YEARS, "years": TimePeriodDuration.YEARS,
+}
+
+# keywords that terminate an input-stream section
+_QUERY_SECTION_KW = {"select", "insert", "delete", "update", "return", "output"}
+
+
+class Parser:
+    def __init__(self, text: str):
+        self.text = text
+        self.tokens = tokenize(text)
+        self.pos = 0
+
+    # ------------------------------------------------------------------ utils
+    def peek(self, offset: int = 0) -> Token:
+        i = min(self.pos + offset, len(self.tokens) - 1)
+        return self.tokens[i]
+
+    def next(self) -> Token:
+        t = self.peek()
+        self.pos += 1
+        return t
+
+    def at_kw(self, *kws: str, offset: int = 0) -> bool:
+        t = self.peek(offset)
+        return t.type == TokenType.IDENT and t.value.lower() in kws
+
+    def at_op(self, *ops: str, offset: int = 0) -> bool:
+        t = self.peek(offset)
+        return t.type == TokenType.OP and t.value in ops
+
+    def accept_kw(self, *kws: str) -> Optional[str]:
+        if self.at_kw(*kws):
+            return self.next().value.lower()
+        return None
+
+    def accept_op(self, *ops: str) -> Optional[str]:
+        if self.at_op(*ops):
+            return self.next().value
+        return None
+
+    def expect_kw(self, *kws: str) -> str:
+        v = self.accept_kw(*kws)
+        if v is None:
+            self.fail(f"expected {'/'.join(kws)!r}")
+        return v
+
+    def expect_op(self, *ops: str) -> str:
+        v = self.accept_op(*ops)
+        if v is None:
+            self.fail(f"expected {'/'.join(ops)!r}")
+        return v
+
+    def expect_ident(self) -> str:
+        t = self.peek()
+        if t.type != TokenType.IDENT:
+            self.fail("expected identifier")
+        return self.next().value
+
+    def fail(self, msg: str) -> None:
+        t = self.peek()
+        raise SiddhiParserError(
+            f"{msg}, got {t.type}({t.value!r}) at line {t.line}:{t.col}"
+        )
+
+    # -------------------------------------------------------------- top level
+    def parse_app(self) -> SiddhiApp:
+        app = SiddhiApp()
+        while self.peek().type != TokenType.EOF:
+            anns = self.parse_annotations()
+            if self.at_kw("define"):
+                self.parse_definition(app, anns)
+            elif self.at_kw("partition"):
+                app.add_partition(self.parse_partition(anns))
+            elif self.at_kw("from"):
+                q = self.parse_query()
+                q.annotations = anns + q.annotations
+                app.add_query(q)
+            else:
+                # app-level annotations with no following element
+                if anns:
+                    app.annotations.extend(anns)
+                    if self.accept_op(";"):
+                        continue
+                    if self.peek().type == TokenType.EOF:
+                        break
+                    continue
+                self.fail("expected definition, partition, query, or annotation")
+                anns = []
+            app.annotations.extend(a for a in anns if a.name.lower() == "app")
+            self.accept_op(";")
+        return app
+
+    # ------------------------------------------------------------ annotations
+    def parse_annotations(self) -> list[Annotation]:
+        anns = []
+        while self.at_op("@"):
+            anns.append(self.parse_annotation())
+        return anns
+
+    def parse_annotation(self) -> Annotation:
+        self.expect_op("@")
+        name = self.expect_ident()
+        ann = Annotation(name)
+        if self.accept_op(":"):
+            # `@App:name('x')` form → Annotation('app').element(key, value)
+            key = self.expect_ident()
+            ann.name = name.lower()
+            if self.accept_op("("):
+                val = self.parse_annotation_value()
+                self.expect_op(")")
+                ann.element(key, val)
+            else:
+                ann.element(key, "true")
+            return ann
+        if self.accept_op("("):
+            while not self.at_op(")"):
+                if self.at_op("@"):
+                    ann.annotations.append(self.parse_annotation())
+                else:
+                    t = self.peek()
+                    if (
+                        t.type == TokenType.IDENT
+                        and self.peek(1).type == TokenType.OP
+                        and self.peek(1).value == "="
+                    ):
+                        key = self.next().value
+                        self.next()  # '='
+                        ann.element(key, self.parse_annotation_value())
+                    else:
+                        ann.element(None, self.parse_annotation_value())
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        return ann
+
+    def parse_annotation_value(self) -> str:
+        t = self.peek()
+        if t.type == TokenType.STRING:
+            return self.next().value
+        if t.type in (TokenType.INT, TokenType.LONG, TokenType.FLOAT, TokenType.DOUBLE):
+            return self.next().value
+        if t.type == TokenType.IDENT:
+            return self.next().value
+        self.fail("expected annotation value")
+
+    # ------------------------------------------------------------ definitions
+    def parse_definition(self, app: SiddhiApp, anns: list[Annotation]) -> None:
+        self.expect_kw("define")
+        anns = [a for a in anns if a.name.lower() != "app"]
+        kind = self.expect_kw(
+            "stream", "table", "window", "trigger", "aggregation", "function"
+        )
+        if kind == "stream":
+            d = StreamDefinition(self.expect_ident())
+            d.annotations = anns
+            self.parse_attribute_list(d)
+            app.define_stream(d)
+        elif kind == "table":
+            d = TableDefinition(self.expect_ident())
+            d.annotations = anns
+            self.parse_attribute_list(d)
+            app.define_table(d)
+        elif kind == "window":
+            d = WindowDefinition(self.expect_ident())
+            d.annotations = anns
+            self.parse_attribute_list(d)
+            if self.peek().type == TokenType.IDENT and not self.at_kw("output"):
+                ns = None
+                name = self.expect_ident()
+                if self.accept_op("."):
+                    ns, name = name, self.expect_ident()
+                params: list[Expression] = []
+                if self.accept_op("("):
+                    params = self.parse_expression_list()
+                    self.expect_op(")")
+                d.window_handler = Window(None if ns in (None, "window") else ns, name, params)
+            if self.accept_kw("output"):
+                which = self.expect_kw("current", "expired", "all")
+                self.expect_kw("events")
+                d.output_event_type = {
+                    "current": OutputEventType.CURRENT_EVENTS,
+                    "expired": OutputEventType.EXPIRED_EVENTS,
+                    "all": OutputEventType.ALL_EVENTS,
+                }[which]
+            app.define_window(d)
+        elif kind == "trigger":
+            tid = self.expect_ident()
+            self.expect_kw("at")
+            d = TriggerDefinition(tid, annotations=anns)
+            if self.at_kw("every"):
+                self.next()
+                d.at_every_ms = self.parse_time_value()
+            elif self.peek().type == TokenType.STRING:
+                s = self.next().value
+                if s.lower() == "start":
+                    d.at_start = True
+                else:
+                    d.at_cron = s
+            else:
+                self.fail("expected 'start', cron string, or every <time>")
+            app.define_trigger(d)
+        elif kind == "aggregation":
+            d = AggregationDefinition(self.expect_ident())
+            d.annotations = anns
+            self.expect_kw("from")
+            d.basic_single_input_stream = self.parse_single_stream()
+            d.selector = self.parse_selector()
+            self.expect_kw("aggregate")
+            if self.accept_kw("by"):
+                d.aggregate_attribute = self.expect_ident()
+            self.expect_kw("every")
+            durations = [self._parse_duration()]
+            if self.accept_op("..."):
+                end = self._parse_duration()
+                durations = [
+                    td for td in TimePeriodDuration
+                    if durations[0].order <= td.order <= end.order
+                ]
+            else:
+                while self.accept_op(","):
+                    durations.append(self._parse_duration())
+            d.durations = durations
+            app.define_aggregation(d)
+        elif kind == "function":
+            fid = self.expect_ident()
+            self.expect_op("[")
+            lang = self.expect_ident()
+            self.expect_op("]")
+            self.expect_kw("return")
+            rtype = PRIMITIVE_TYPES[self.expect_kw(*PRIMITIVE_TYPES)]
+            t = self.peek()
+            if t.type != TokenType.SCRIPT:
+                self.fail("expected function body { ... }")
+            body = self.next().value
+            app.define_function(FunctionDefinition(fid, lang, rtype, body, anns))
+
+    def _parse_duration(self) -> TimePeriodDuration:
+        name = self.expect_ident().lower()
+        if name not in _DURATIONS:
+            self.fail(f"unknown aggregation duration {name!r}")
+        return _DURATIONS[name]
+
+    def parse_attribute_list(self, d) -> None:
+        self.expect_op("(")
+        while not self.at_op(")"):
+            name = self.expect_ident()
+            tname = self.expect_kw(*PRIMITIVE_TYPES)
+            d.attribute(name, PRIMITIVE_TYPES[tname])
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+
+    # -------------------------------------------------------------- partition
+    def parse_partition(self, anns: list[Annotation]) -> Partition:
+        self.expect_kw("partition")
+        self.expect_kw("with")
+        self.expect_op("(")
+        p = Partition(annotations=anns)
+        while not self.at_op(")"):
+            p.partition_types.append(self.parse_partition_type())
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        self.expect_kw("begin")
+        while not self.at_kw("end"):
+            q_anns = self.parse_annotations()
+            q = self.parse_query()
+            q.annotations = q_anns + q.annotations
+            p.queries.append(q)
+            self.accept_op(";")
+        self.expect_kw("end")
+        return p
+
+    def parse_partition_type(self) -> PartitionType:
+        # value: `expr of Stream`; range: `cond as 'label' or cond as 'label' ... of Stream`
+        first = self.parse_expression()
+        if self.at_kw("as"):
+            ranges = []
+            while True:
+                self.expect_kw("as")
+                label = self.next().value  # string literal
+                ranges.append(RangePartitionProperty(label, first))
+                if self.accept_kw("or"):
+                    first = self.parse_expression()
+                else:
+                    break
+            self.expect_kw("of")
+            return PartitionType(self.expect_ident(), ranges=ranges)
+        self.expect_kw("of")
+        return PartitionType(self.expect_ident(), value_expr=first)
+
+    # ------------------------------------------------------------------ query
+    def parse_query(self) -> Query:
+        q = Query()
+        self.expect_kw("from")
+        q.input_stream = self.parse_input_stream()
+        q.selector = self.parse_selector()
+        q.output_rate = self.parse_output_rate()
+        q.output_stream = self.parse_output_action()
+        return q
+
+    # -- input stream dispatch ------------------------------------------------
+    def parse_input_stream(self):
+        kind = self._sniff_input_kind()
+        if kind == "state":
+            return self.parse_state_stream()
+        if kind == "join":
+            return self.parse_join_stream()
+        return self.parse_single_stream()
+
+    def _sniff_input_kind(self) -> str:
+        """Lookahead: classify the from-clause as single / join / state."""
+        if self.at_kw("every", "not"):
+            return "state"
+        depth = 0
+        i = self.pos
+        toks = self.tokens
+        while i < len(toks):
+            t = toks[i]
+            if t.type == TokenType.OP:
+                if t.value in ("(", "["):
+                    depth += 1
+                elif t.value in (")", "]"):
+                    depth -= 1
+                elif depth == 0 and t.value == "->":
+                    return "state"
+                elif depth == 0 and t.value == ",":
+                    return "state"  # sequence
+                elif depth == 0 and t.value == "=":
+                    return "state"  # event binding e1=S
+            elif t.type == TokenType.IDENT and depth == 0:
+                v = t.value.lower()
+                if v in _QUERY_SECTION_KW:
+                    break
+                nxt = toks[i + 1].value.lower() if i + 1 < len(toks) else ""
+                if v == "join" or (v == "inner" and nxt == "join") or (
+                    v in ("left", "right", "full") and nxt == "outer"
+                ):
+                    return "join"
+            i += 1
+        return "single"
+
+    # -- single stream --------------------------------------------------------
+    def parse_single_stream(self) -> SingleInputStream:
+        is_inner = bool(self.accept_op("#"))
+        is_fault = bool(self.accept_op("!"))
+        sid = self.expect_ident()
+        s = SingleInputStream(sid, is_fault_stream=is_fault, is_inner_stream=is_inner)
+        self._parse_stream_handlers(s)
+        if self.accept_kw("as"):
+            s.alias = self.expect_ident()
+        return s
+
+    def _parse_stream_handlers(self, s: SingleInputStream) -> None:
+        while True:
+            if self.at_op("["):
+                self.next()
+                s.handlers.append(Filter(self.parse_expression()))
+                self.expect_op("]")
+            elif self.at_op("#"):
+                self.next()
+                ns = None
+                name = self.expect_ident()
+                if self.accept_op(":"):
+                    ns, name = name, self.expect_ident()
+                if self.accept_op("."):
+                    # `#window.length(..)` → window; `#ns.name` keeps ns
+                    sub = self.expect_ident()
+                    if name.lower() == "window" and ns is None:
+                        ns, name = None, sub
+                        is_window = True
+                    else:
+                        ns, name = name, sub
+                        is_window = False
+                else:
+                    is_window = False
+                params: list[Expression] = []
+                if self.accept_op("("):
+                    params = self.parse_expression_list()
+                    self.expect_op(")")
+                if is_window:
+                    s.handlers.append(Window(None, name, params))
+                else:
+                    s.handlers.append(StreamFunction(ns, name, params))
+            else:
+                break
+
+    # -- join stream ----------------------------------------------------------
+    def parse_join_stream(self) -> JoinInputStream:
+        left = self.parse_single_stream()
+        trigger = EventTrigger.ALL
+        if self.accept_kw("unidirectional"):
+            trigger = EventTrigger.LEFT
+        jt = self._parse_join_type()
+        right = self.parse_single_stream()
+        if self.accept_kw("unidirectional"):
+            trigger = EventTrigger.RIGHT
+        on = None
+        within = None
+        per = None
+        if self.accept_kw("on"):
+            on = self.parse_expression()
+        if self.accept_kw("within"):
+            within = self._parse_within_value()
+        if self.accept_kw("per"):
+            per = self.parse_expression()
+        return JoinInputStream(left, jt, right, on, trigger, within, per)
+
+    def _parse_join_type(self) -> JoinType:
+        if self.accept_kw("join"):
+            return JoinType.JOIN
+        if self.accept_kw("inner"):
+            self.expect_kw("join")
+            return JoinType.INNER_JOIN
+        side = self.expect_kw("left", "right", "full")
+        self.expect_kw("outer")
+        self.expect_kw("join")
+        return {
+            "left": JoinType.LEFT_OUTER_JOIN,
+            "right": JoinType.RIGHT_OUTER_JOIN,
+            "full": JoinType.FULL_OUTER_JOIN,
+        }[side]
+
+    # -- pattern / sequence ---------------------------------------------------
+    def parse_state_stream(self) -> StateInputStream:
+        # detect sequence by a top-level ',' before query-section keywords
+        is_sequence = self._state_is_sequence()
+        sep = "," if is_sequence else "->"
+        state = self._parse_state_chain(sep, is_sequence)
+        within = None
+        if self.accept_kw("within"):
+            within = self._parse_within_value()
+        return StateInputStream(
+            StateInputStreamType.SEQUENCE if is_sequence else StateInputStreamType.PATTERN,
+            state,
+            within,
+        )
+
+    def _state_is_sequence(self) -> bool:
+        depth = 0
+        i = self.pos
+        toks = self.tokens
+        while i < len(toks):
+            t = toks[i]
+            if t.type == TokenType.OP:
+                if t.value in ("(", "["):
+                    depth += 1
+                elif t.value in (")", "]"):
+                    depth -= 1
+                elif depth == 0 and t.value == "->":
+                    return False
+                elif depth == 0 and t.value == ",":
+                    return True
+            elif t.type == TokenType.IDENT and depth == 0 and t.value.lower() in _QUERY_SECTION_KW:
+                break
+            i += 1
+        return False
+
+    def _try_element_within(self) -> Optional["Constant"]:
+        """Consume a per-element `within <t>` only when the pattern continues after
+        it; a trailing `within` belongs to the whole state stream (rollback)."""
+        if not self.at_kw("within"):
+            return None
+        saved = self.pos
+        self.next()
+        w = self._parse_within_value()
+        if self.at_kw(*_QUERY_SECTION_KW) or self.peek().type == TokenType.EOF:
+            self.pos = saved
+            return None
+        return w
+
+    def _parse_state_chain(self, sep: str, is_sequence: bool) -> StateElement:
+        elems = [self._parse_state_unit(is_sequence)]
+        while self.at_op(sep):
+            self.next()
+            elems.append(self._parse_state_unit(is_sequence))
+        # right-fold into NextStateElement chain
+        state = elems[-1]
+        for e in reversed(elems[:-1]):
+            state = NextStateElement(e, state)
+        return state
+
+    def _parse_state_unit(self, is_sequence: bool) -> StateElement:
+        if self.accept_kw("every"):
+            if self.at_op("("):
+                self.next()
+                inner = self._parse_state_chain("," if is_sequence else "->", is_sequence)
+                self.expect_op(")")
+                el: StateElement = EveryStateElement(inner)
+            else:
+                el = EveryStateElement(self._parse_logical_unit(is_sequence))
+            el.within = self._try_element_within()
+            return el
+        if self.at_op("("):
+            self.next()
+            inner = self._parse_state_chain("," if is_sequence else "->", is_sequence)
+            self.expect_op(")")
+            w = self._try_element_within()
+            if w is not None:
+                inner.within = w
+            return inner
+        return self._parse_logical_unit(is_sequence)
+
+    def _parse_logical_unit(self, is_sequence: bool) -> StateElement:
+        first = self._parse_state_primary(is_sequence)
+        if self.at_kw("and", "or"):
+            op = LogicalType(self.next().value.lower())
+            second = self._parse_state_primary(is_sequence)
+            el = LogicalStateElement(first, op, second)
+            el.within = self._try_element_within()
+            return el
+        return first
+
+    def _parse_state_primary(self, is_sequence: bool) -> StateElement:
+        if self.accept_kw("not"):
+            stream = self._parse_state_basic_stream()
+            waiting = None
+            if self.accept_kw("for"):
+                waiting = self.parse_time_value()
+            return AbsentStreamStateElement(stream, waiting)
+        # optional event binding `e1=`
+        alias = None
+        if (
+            self.peek().type == TokenType.IDENT
+            and self.at_op("=", offset=1)
+        ):
+            alias = self.next().value
+            self.next()  # '='
+        stream = self._parse_state_basic_stream()
+        if alias:
+            stream.alias = alias
+        sse = StreamStateElement(stream)
+        # counting / kleene postfix
+        if self.at_op("<"):
+            self.next()
+            mn = int(self.next().value)
+            mx = mn
+            if self.accept_op(":"):
+                if self.peek().type == TokenType.INT:
+                    mx = int(self.next().value)
+                else:
+                    mx = -1
+            self.expect_op(">")
+            el: StateElement = CountStateElement(sse, mn, mx)
+        elif self.at_op("*") and is_sequence:
+            self.next()
+            el = CountStateElement(sse, 0, -1)
+        elif self.at_op("+") and is_sequence:
+            self.next()
+            el = CountStateElement(sse, 1, -1)
+        elif self.at_op("?") and is_sequence:
+            self.next()
+            el = CountStateElement(sse, 0, 1)
+        else:
+            el = sse
+        el.within = self._try_element_within()
+        return el
+
+    def _parse_state_basic_stream(self) -> SingleInputStream:
+        is_inner = bool(self.accept_op("#"))
+        sid = self.expect_ident()
+        s = SingleInputStream(sid, is_inner_stream=is_inner)
+        self._parse_stream_handlers(s)
+        return s
+
+    def _parse_within_value(self) -> Constant:
+        ms = self.parse_time_value()
+        return Constant(ms, DataType.LONG, is_time=True)
+
+    # --------------------------------------------------------------- selector
+    def parse_selector(self) -> Selector:
+        sel = Selector()
+        if self.accept_kw("select"):
+            if self.accept_op("*"):
+                sel.select_all = True
+            else:
+                while True:
+                    expr = self.parse_expression()
+                    rename = None
+                    if self.accept_kw("as"):
+                        rename = self.expect_ident()
+                    sel.attributes.append(OutputAttribute(rename, expr))
+                    if not self.accept_op(","):
+                        break
+        else:
+            sel.select_all = True
+        if self.at_kw("group"):
+            self.next()
+            self.expect_kw("by")
+            while True:
+                v = self.parse_variable_ref()
+                sel.group_by.append(v)
+                if not self.accept_op(","):
+                    break
+        if self.accept_kw("having"):
+            sel.having = self.parse_expression()
+        if self.at_kw("order"):
+            self.next()
+            self.expect_kw("by")
+            while True:
+                v = self.parse_variable_ref()
+                order = OrderByOrder.ASC
+                if self.at_kw("asc", "desc"):
+                    order = OrderByOrder(self.next().value.lower())
+                sel.order_by.append(OrderByAttribute(v, order))
+                if not self.accept_op(","):
+                    break
+        if self.accept_kw("limit"):
+            sel.limit = int(self.next().value)
+        if self.accept_kw("offset"):
+            sel.offset = int(self.next().value)
+        return sel
+
+    # ------------------------------------------------------------ output rate
+    def parse_output_rate(self):
+        if not self.at_kw("output"):
+            return None
+        # don't consume `output` of `output snapshot`? both are rates; handle all here
+        self.next()
+        if self.accept_kw("snapshot"):
+            self.expect_kw("every")
+            return SnapshotOutputRate(self.parse_time_value())
+        rtype = OutputRateType.ALL
+        kw = self.accept_kw("all", "first", "last")
+        if kw:
+            rtype = OutputRateType(kw)
+        self.expect_kw("every")
+        t = self.peek()
+        if t.type == TokenType.INT and self.at_kw("events", offset=1):
+            n = int(self.next().value)
+            self.expect_kw("events")
+            return EventOutputRate(n, rtype)
+        ms = self.parse_time_value()
+        return TimeOutputRate(ms, rtype)
+
+    # ---------------------------------------------------------- output action
+    def parse_output_action(self):
+        if self.accept_kw("insert"):
+            events_for = self._parse_events_for()
+            self.expect_kw("into")
+            is_inner = bool(self.accept_op("#"))
+            is_fault = bool(self.accept_op("!"))
+            target = self.expect_ident()
+            return InsertIntoStream(target, events_for, is_fault, is_inner)
+        if self.accept_kw("delete"):
+            target = self.expect_ident()
+            self._parse_events_for()
+            self.expect_kw("on")
+            return DeleteStream(target, self.parse_expression())
+        if self.accept_kw("update"):
+            if self.accept_kw("or"):
+                self.expect_kw("insert")
+                self.expect_kw("into")
+                target = self.expect_ident()
+                sets = self._parse_set_clause()
+                on = None
+                if self.accept_kw("on"):
+                    on = self.parse_expression()
+                return UpdateOrInsertStream(target, on, sets)
+            target = self.expect_ident()
+            self._parse_events_for()
+            sets = self._parse_set_clause()
+            self.expect_kw("on")
+            return UpdateStream(target, self.parse_expression(), sets)
+        if self.accept_kw("return"):
+            events_for = self._parse_events_for()
+            return ReturnStream(events_for)
+        return ReturnStream()
+
+    def _parse_events_for(self) -> OutputEventsFor:
+        kw = self.accept_kw("current", "expired", "all")
+        if kw:
+            self.expect_kw("events")
+            return OutputEventsFor(kw)
+        if self.at_kw("for"):
+            self.next()
+            kw = self.expect_kw("current", "expired", "all")
+            self.expect_kw("events")
+            return OutputEventsFor(kw)
+        return OutputEventsFor.CURRENT_EVENTS
+
+    def _parse_set_clause(self) -> list[UpdateSetAttribute]:
+        sets: list[UpdateSetAttribute] = []
+        if self.accept_kw("set"):
+            while True:
+                var = self.parse_variable_ref()
+                self.expect_op("=")
+                sets.append(UpdateSetAttribute(var, self.parse_expression()))
+                if not self.accept_op(","):
+                    break
+        return sets
+
+    # --------------------------------------------------------- on-demand query
+    def parse_on_demand_query(self) -> OnDemandQuery:
+        anns = self.parse_annotations()
+        if self.accept_kw("from"):
+            store = self.expect_ident()
+            on = None
+            if self.accept_kw("on"):
+                on = self.parse_expression()
+            within = None
+            per = None
+            if self.accept_kw("within"):
+                first = self.parse_expression()
+                if self.accept_op(","):
+                    within = (first, self.parse_expression())
+                else:
+                    within = (first,)
+            if self.accept_kw("per"):
+                per = self.parse_expression()
+            sel = self.parse_selector()
+            action = self.parse_output_action()
+            if isinstance(action, InsertIntoStream):
+                return OnDemandQuery(OnDemandQueryType.INSERT, store, on, sel, action,
+                                     within=within, per=per)
+            if isinstance(action, DeleteStream):
+                return OnDemandQuery(OnDemandQueryType.DELETE, store, on, sel, action,
+                                     within=within, per=per)
+            if isinstance(action, UpdateOrInsertStream):
+                return OnDemandQuery(OnDemandQueryType.UPDATE_OR_INSERT, store, on, sel,
+                                     action, within=within, per=per)
+            if isinstance(action, UpdateStream):
+                return OnDemandQuery(OnDemandQueryType.UPDATE, store, on, sel, action,
+                                     within=within, per=per)
+            return OnDemandQuery(OnDemandQueryType.FIND, store, on, sel, None,
+                                 within=within, per=per)
+        # `select ... insert into T` / `update T ...` / `delete T on ...` forms
+        sel = self.parse_selector()
+        action = self.parse_output_action()
+        type_map = {
+            InsertIntoStream: OnDemandQueryType.INSERT,
+            DeleteStream: OnDemandQueryType.DELETE,
+            UpdateStream: OnDemandQueryType.UPDATE,
+            UpdateOrInsertStream: OnDemandQueryType.UPDATE_OR_INSERT,
+        }
+        qt = type_map.get(type(action))
+        if qt is None:
+            self.fail("on-demand query needs a table action or 'from'")
+        target = getattr(action, "target_id", None)
+        return OnDemandQuery(qt, target, getattr(action, "on_condition", None), sel, action)
+
+    # ------------------------------------------------------------- expressions
+    def parse_expression(self) -> Expression:
+        return self.parse_or()
+
+    def parse_expression_list(self) -> list[Expression]:
+        if self.at_op(")"):
+            return []
+        out = [self.parse_expression()]
+        while self.accept_op(","):
+            out.append(self.parse_expression())
+        return out
+
+    def parse_or(self) -> Expression:
+        left = self.parse_and()
+        while self.at_kw("or"):
+            self.next()
+            left = Or(left, self.parse_and())
+        return left
+
+    def parse_and(self) -> Expression:
+        left = self.parse_not()
+        while self.at_kw("and"):
+            self.next()
+            left = And(left, self.parse_not())
+        return left
+
+    def parse_not(self) -> Expression:
+        if self.accept_kw("not"):
+            return Not(self.parse_not())
+        return self.parse_comparison()
+
+    def parse_comparison(self) -> Expression:
+        left = self.parse_postfix()
+        while self.at_op("<", "<=", ">", ">=", "==", "!="):
+            op = CompareOp(self.next().value)
+            right = self.parse_postfix()
+            left = Compare(left, op, right)
+        return left
+
+    def parse_postfix(self) -> Expression:
+        left = self.parse_additive()
+        while True:
+            if self.at_kw("is") and self.at_kw("null", offset=1):
+                self.next(); self.next()
+                if isinstance(left, Variable) and left.stream_id is None and left.attribute[0].islower() is False:
+                    # `e1 is null` — bare stream/alias reference
+                    left = IsNull(None, left.attribute, left.stream_index)
+                elif isinstance(left, Variable) and left.stream_id is None:
+                    left = IsNull(None, left.attribute, left.stream_index)
+                else:
+                    left = IsNull(left)
+            elif self.accept_kw("in"):
+                left = In(left, self.expect_ident())
+            else:
+                break
+        return left
+
+    def parse_additive(self) -> Expression:
+        left = self.parse_multiplicative()
+        while self.at_op("+", "-"):
+            op = MathOp(self.next().value)
+            left = MathExpr(left, op, self.parse_multiplicative())
+        return left
+
+    def parse_multiplicative(self) -> Expression:
+        left = self.parse_unary()
+        while self.at_op("*", "/", "%"):
+            op = MathOp(self.next().value)
+            left = MathExpr(left, op, self.parse_unary())
+        return left
+
+    def parse_unary(self) -> Expression:
+        if self.accept_op("-"):
+            return Minus(self.parse_unary())
+        if self.accept_op("+"):
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def parse_primary(self) -> Expression:
+        t = self.peek()
+        if t.type == TokenType.OP and t.value == "(":
+            self.next()
+            e = self.parse_expression()
+            self.expect_op(")")
+            return e
+        if t.type == TokenType.STRING:
+            self.next()
+            return Constant(t.value, DataType.STRING)
+        if t.type in (TokenType.INT, TokenType.LONG):
+            # time constant? `10 sec`
+            if self.peek(1).type == TokenType.IDENT and self.peek(1).value.lower() in TIME_UNITS:
+                return Constant(self.parse_time_value(), DataType.LONG, is_time=True)
+            self.next()
+            v = int(t.value)
+            return Constant(v, DataType.LONG if t.type == TokenType.LONG else DataType.INT)
+        if t.type == TokenType.FLOAT:
+            self.next()
+            return Constant(float(t.value), DataType.FLOAT)
+        if t.type == TokenType.DOUBLE:
+            self.next()
+            return Constant(float(t.value), DataType.DOUBLE)
+        if t.type == TokenType.IDENT:
+            low = t.value.lower()
+            if low == "true":
+                self.next()
+                return Constant(True, DataType.BOOL)
+            if low == "false":
+                self.next()
+                return Constant(False, DataType.BOOL)
+            return self.parse_name_expression()
+        self.fail("expected expression")
+
+    def parse_name_expression(self) -> Expression:
+        """Variable (`a`, `s.a`, `e1[0].a`) or function call (`ns:f(..)`, `f(..)`)."""
+        name = self.expect_ident()
+        # function with namespace `ns:f(...)`
+        if self.at_op(":") and self.peek(1).type == TokenType.IDENT and self.at_op("(", offset=2):
+            self.next()
+            fname = self.expect_ident()
+            self.expect_op("(")
+            args = self.parse_expression_list()
+            self.expect_op(")")
+            return AttributeFunction(name, fname, args)
+        if self.at_op("("):
+            self.next()
+            args = self.parse_expression_list()
+            self.expect_op(")")
+            return AttributeFunction(None, name, args)
+        # stream index `e1[0].a` / `e1[last].a`
+        idx: Optional[int] = None
+        if self.at_op("[") and (
+            self.peek(1).type == TokenType.INT
+            or (self.peek(1).type == TokenType.IDENT and self.peek(1).value.lower() == "last")
+        ) and self.at_op("]", offset=2):
+            self.next()
+            it = self.next()
+            idx = LAST_INDEX if it.type == TokenType.IDENT else int(it.value)
+            self.expect_op("]")
+        if self.accept_op("."):
+            attr = self.expect_ident()
+            return Variable(attribute=attr, stream_id=name, stream_index=idx)
+        return Variable(attribute=name, stream_index=idx)
+
+    def parse_variable_ref(self) -> Variable:
+        e = self.parse_name_expression()
+        if not isinstance(e, Variable):
+            self.fail("expected attribute reference")
+        return e
+
+    # ------------------------------------------------------------- time values
+    def parse_time_value(self) -> int:
+        """`1 hour 20 min` → milliseconds (sums unit terms)."""
+        total = 0
+        seen = False
+        while self.peek().type in (TokenType.INT, TokenType.LONG) and (
+            self.peek(1).type == TokenType.IDENT
+            and self.peek(1).value.lower() in TIME_UNITS
+        ):
+            n = int(self.next().value)
+            unit = self.next().value.lower()
+            total += n * TIME_UNITS[unit]
+            seen = True
+        if not seen:
+            self.fail("expected time value")
+        return total

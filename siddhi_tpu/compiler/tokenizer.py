@@ -1,0 +1,221 @@
+"""SiddhiQL tokenizer.
+
+Reference grammar: ``modules/siddhi-query-compiler/src/main/antlr4/io/siddhi/query/
+compiler/SiddhiQL.g4`` (lexer rules at the bottom of the file). Hand-rolled here —
+no ANTLR — producing a flat token list the recursive-descent parser consumes.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+from ..query_api.definition import DataType
+
+
+class TokenType:
+    IDENT = "IDENT"
+    STRING = "STRING"
+    INT = "INT"
+    LONG = "LONG"
+    FLOAT = "FLOAT"
+    DOUBLE = "DOUBLE"
+    OP = "OP"
+    SCRIPT = "SCRIPT"   # `{ ... }` raw function body
+    EOF = "EOF"
+
+
+@dataclass
+class Token:
+    type: str
+    value: str
+    line: int
+    col: int
+
+    def __repr__(self) -> str:
+        return f"{self.type}({self.value!r}@{self.line}:{self.col})"
+
+
+class TokenizeError(SyntaxError):
+    pass
+
+
+# multi-char operators first so maximal munch wins
+_OPERATORS = [
+    "->", "<=", ">=", "==", "!=", "...",
+    "(", ")", "[", "]", "<", ">", ",", ";", ":", "#", "@",
+    "+", "-", "*", "/", "%", "?", "!", ".", "=",
+]
+
+_NUMBER_RE = re.compile(
+    r"""
+    (?P<num>
+        (?:\d+\.\d+(?:[eE][+-]?\d+)?)   # 1.5, 1.5e3
+      | (?:\d+[eE][+-]?\d+)             # 1e3
+      | (?:\d+)                         # 42
+    )
+    (?P<suffix>[lLfFdD]?)
+    """,
+    re.VERBOSE,
+)
+
+_IDENT_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+def tokenize(text: str) -> list[Token]:
+    tokens: list[Token] = []
+    i, n = 0, len(text)
+    line, line_start = 1, 0
+
+    def pos() -> tuple[int, int]:
+        return line, i - line_start + 1
+
+    def advance_newlines(chunk: str, start: int) -> None:
+        nonlocal line, line_start
+        for m in re.finditer(r"\n", chunk):
+            line += 1
+            line_start = start + m.end()
+
+    while i < n:
+        c = text[i]
+        # whitespace
+        if c in " \t\r\n":
+            if c == "\n":
+                line += 1
+                line_start = i + 1
+            i += 1
+            continue
+        # comments: -- line, // line, /* block */
+        if text.startswith("--", i) or text.startswith("//", i):
+            j = text.find("\n", i)
+            i = n if j < 0 else j
+            continue
+        if text.startswith("/*", i):
+            j = text.find("*/", i + 2)
+            if j < 0:
+                raise TokenizeError(f"unterminated block comment at line {line}")
+            advance_newlines(text[i:j + 2], i)
+            i = j + 2
+            continue
+        ln, col = pos()
+        # strings: ''' """ ' "
+        if text.startswith("'''", i) or text.startswith('"""', i):
+            q = text[i:i + 3]
+            j = text.find(q, i + 3)
+            if j < 0:
+                raise TokenizeError(f"unterminated string at line {ln}")
+            val = text[i + 3:j]
+            advance_newlines(text[i:j + 3], i)
+            tokens.append(Token(TokenType.STRING, val, ln, col))
+            i = j + 3
+            continue
+        if c in "'\"":
+            j = i + 1
+            buf = []
+            while j < n and text[j] != c:
+                if text[j] == "\n":
+                    raise TokenizeError(f"unterminated string at line {ln}")
+                if text[j] == "\\" and j + 1 < n:
+                    buf.append(text[j + 1])
+                    j += 2
+                else:
+                    buf.append(text[j])
+                    j += 1
+            if j >= n:
+                raise TokenizeError(f"unterminated string at line {ln}")
+            tokens.append(Token(TokenType.STRING, "".join(buf), ln, col))
+            i = j + 1
+            continue
+        # backtick-quoted identifier
+        if c == "`":
+            j = text.find("`", i + 1)
+            if j < 0:
+                raise TokenizeError(f"unterminated quoted identifier at line {ln}")
+            tokens.append(Token(TokenType.IDENT, text[i + 1:j], ln, col))
+            i = j + 1
+            continue
+        # script body `{ ... }` (define function); nesting + quote aware
+        if c == "{":
+            depth = 0
+            j = i
+            while j < n:
+                ch = text[j]
+                if ch in "'\"":
+                    q = ch
+                    j += 1
+                    while j < n and text[j] != q:
+                        j += 2 if text[j] == "\\" else 1
+                elif ch == "{":
+                    depth += 1
+                elif ch == "}":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                j += 1
+            if j >= n:
+                raise TokenizeError(f"unterminated '{{' block at line {ln}")
+            body = text[i + 1:j]
+            advance_newlines(text[i:j + 1], i)
+            tokens.append(Token(TokenType.SCRIPT, body, ln, col))
+            i = j + 1
+            continue
+        # numbers
+        m = _NUMBER_RE.match(text, i)
+        if m and c.isdigit():
+            num, suffix = m.group("num"), m.group("suffix")
+            if suffix in ("l", "L"):
+                tokens.append(Token(TokenType.LONG, num, ln, col))
+            elif suffix in ("f", "F"):
+                tokens.append(Token(TokenType.FLOAT, num, ln, col))
+            elif suffix in ("d", "D"):
+                tokens.append(Token(TokenType.DOUBLE, num, ln, col))
+            elif "." in num or "e" in num or "E" in num:
+                tokens.append(Token(TokenType.DOUBLE, num, ln, col))
+            else:
+                tokens.append(Token(TokenType.INT, num, ln, col))
+            i = m.end()
+            continue
+        # identifiers / keywords
+        m = _IDENT_RE.match(text, i)
+        if m:
+            tokens.append(Token(TokenType.IDENT, m.group(0), ln, col))
+            i = m.end()
+            continue
+        # operators
+        for op in _OPERATORS:
+            if text.startswith(op, i):
+                tokens.append(Token(TokenType.OP, op, ln, col))
+                i += len(op)
+                break
+        else:
+            raise TokenizeError(f"unexpected character {c!r} at line {ln}:{col}")
+    tokens.append(Token(TokenType.EOF, "", line, 1))
+    return tokens
+
+
+# time units → milliseconds (reference: SiddhiQL.g4 time_value rules)
+TIME_UNITS: dict[str, int] = {}
+for _names, _ms in [
+    (("millisecond", "milliseconds", "millisec", "ms"), 1),
+    (("second", "seconds", "sec"), 1000),
+    (("minute", "minutes", "min"), 60_000),
+    (("hour", "hours"), 3_600_000),
+    (("day", "days"), 86_400_000),
+    (("week", "weeks"), 7 * 86_400_000),
+    (("month", "months"), 30 * 86_400_000),
+    (("year", "years"), 365 * 86_400_000),
+]:
+    for _nm in _names:
+        TIME_UNITS[_nm] = _ms
+
+
+PRIMITIVE_TYPES = {
+    "string": DataType.STRING,
+    "int": DataType.INT,
+    "long": DataType.LONG,
+    "float": DataType.FLOAT,
+    "double": DataType.DOUBLE,
+    "bool": DataType.BOOL,
+    "object": DataType.OBJECT,
+}

@@ -1,0 +1,79 @@
+"""Query API: the typed AST every front end lowers to.
+
+Mirrors the role of the reference's ``siddhi-query-api`` module (92 files under
+``modules/siddhi-query-api/src/main/java/io/siddhi/query/api/``): a programmatic
+builder API plus the structures the SiddhiQL compiler produces.
+"""
+
+from .annotation import Annotation, Element, find_all_annotations, find_annotation
+from .app import SiddhiApp
+from .definition import (
+    AbstractDefinition,
+    AggregationDefinition,
+    Attribute,
+    DataType,
+    FunctionDefinition,
+    OutputEventType,
+    StreamDefinition,
+    TableDefinition,
+    TimePeriodDuration,
+    TriggerDefinition,
+    WindowDefinition,
+)
+from .execution import (
+    AbsentStreamStateElement,
+    CountStateElement,
+    DeleteStream,
+    EventOutputRate,
+    EventTrigger,
+    EveryStateElement,
+    Filter,
+    InsertIntoStream,
+    JoinInputStream,
+    JoinType,
+    LogicalStateElement,
+    LogicalType,
+    NextStateElement,
+    OnDemandQuery,
+    OnDemandQueryType,
+    OrderByAttribute,
+    OrderByOrder,
+    OutputAttribute,
+    OutputEventsFor,
+    OutputRateType,
+    Partition,
+    PartitionType,
+    Query,
+    RangePartitionProperty,
+    ReturnStream,
+    Selector,
+    SingleInputStream,
+    SnapshotOutputRate,
+    StateElement,
+    StateInputStream,
+    StateInputStreamType,
+    StreamFunction,
+    StreamStateElement,
+    TimeOutputRate,
+    UpdateOrInsertStream,
+    UpdateSetAttribute,
+    UpdateStream,
+    Window,
+)
+from .expression import (
+    And,
+    AttributeFunction,
+    Compare,
+    CompareOp,
+    Constant,
+    Expression,
+    In,
+    IsNull,
+    LAST_INDEX,
+    MathExpr,
+    MathOp,
+    Minus,
+    Not,
+    Or,
+    Variable,
+)

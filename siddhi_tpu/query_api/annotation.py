@@ -1,0 +1,53 @@
+"""Annotations: ``@name(key='value', 'indexed-value', @nested(...))``.
+
+Reference: ``io.siddhi.query.api.annotation.Annotation`` — used for @app, @async,
+@OnError, @PrimaryKey, @Index, @store, @sink, @source, @map, @attributes, @dist, @info.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+
+@dataclass
+class Element:
+    key: Optional[str]
+    value: str
+
+
+@dataclass
+class Annotation:
+    name: str
+    elements: list[Element] = field(default_factory=list)
+    annotations: list["Annotation"] = field(default_factory=list)  # nested
+
+    def element(self, key: Optional[str], value: str) -> "Annotation":
+        self.elements.append(Element(key, value))
+        return self
+
+    def get(self, key: Optional[str], default: Optional[str] = None) -> Optional[str]:
+        for e in self.elements:
+            if e.key == key:
+                return e.value
+        return default
+
+    def indexed_values(self) -> list[str]:
+        return [e.value for e in self.elements if e.key is None]
+
+    def nested(self, name: str) -> Optional["Annotation"]:
+        for a in self.annotations:
+            if a.name.lower() == name.lower():
+                return a
+        return None
+
+
+def find_annotation(annotations: list[Annotation], name: str) -> Optional[Annotation]:
+    for a in annotations:
+        if a.name.lower() == name.lower():
+            return a
+    return None
+
+
+def find_all_annotations(annotations: list[Annotation], name: str) -> list[Annotation]:
+    return [a for a in annotations if a.name.lower() == name.lower()]

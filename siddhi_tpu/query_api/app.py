@@ -1,0 +1,107 @@
+"""SiddhiApp: the top-level compiled unit (reference: ``SiddhiApp.java``)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+from .annotation import Annotation, find_annotation
+from .definition import (
+    AggregationDefinition,
+    FunctionDefinition,
+    StreamDefinition,
+    TableDefinition,
+    TriggerDefinition,
+    WindowDefinition,
+)
+from .execution import Partition, Query
+
+
+@dataclass
+class SiddhiApp:
+    stream_definitions: dict[str, StreamDefinition] = field(default_factory=dict)
+    table_definitions: dict[str, TableDefinition] = field(default_factory=dict)
+    window_definitions: dict[str, WindowDefinition] = field(default_factory=dict)
+    trigger_definitions: dict[str, TriggerDefinition] = field(default_factory=dict)
+    aggregation_definitions: dict[str, AggregationDefinition] = field(default_factory=dict)
+    function_definitions: dict[str, FunctionDefinition] = field(default_factory=dict)
+    execution_elements: list[Union[Query, Partition]] = field(default_factory=list)
+    annotations: list[Annotation] = field(default_factory=list)
+
+    @staticmethod
+    def app(name: Optional[str] = None) -> "SiddhiApp":
+        a = SiddhiApp()
+        if name:
+            a.annotations.append(Annotation("app", []).element("name", name))
+        return a
+
+    # -- builders ------------------------------------------------------------
+    def define_stream(self, d: StreamDefinition) -> "SiddhiApp":
+        self._check_unique(d.id)
+        self.stream_definitions[d.id] = d
+        return self
+
+    def define_table(self, d: TableDefinition) -> "SiddhiApp":
+        self._check_unique(d.id)
+        self.table_definitions[d.id] = d
+        return self
+
+    def define_window(self, d: WindowDefinition) -> "SiddhiApp":
+        self._check_unique(d.id)
+        self.window_definitions[d.id] = d
+        return self
+
+    def define_trigger(self, d: TriggerDefinition) -> "SiddhiApp":
+        self._check_unique(d.id)
+        self.trigger_definitions[d.id] = d
+        return self
+
+    def define_aggregation(self, d: AggregationDefinition) -> "SiddhiApp":
+        self._check_unique(d.id)
+        self.aggregation_definitions[d.id] = d
+        return self
+
+    def define_function(self, d: FunctionDefinition) -> "SiddhiApp":
+        self.function_definitions[d.id] = d
+        return self
+
+    def add_query(self, q: Query) -> "SiddhiApp":
+        self.execution_elements.append(q)
+        return self
+
+    def add_partition(self, p: Partition) -> "SiddhiApp":
+        self.execution_elements.append(p)
+        return self
+
+    def annotation(self, ann: Annotation) -> "SiddhiApp":
+        self.annotations.append(ann)
+        return self
+
+    # -- accessors -----------------------------------------------------------
+    @property
+    def queries(self) -> list[Query]:
+        return [e for e in self.execution_elements if isinstance(e, Query)]
+
+    @property
+    def partitions(self) -> list[Partition]:
+        return [e for e in self.execution_elements if isinstance(e, Partition)]
+
+    def name(self, default: str = "SiddhiApp") -> str:
+        app_ann = find_annotation(self.annotations, "app")
+        if app_ann:
+            n = app_ann.get("name")
+            if n:
+                return n
+        # legacy: @App:name('x') parsed as name='app', element key 'name'
+        return default
+
+    def _check_unique(self, id: str) -> None:
+        for m in (
+            self.stream_definitions,
+            self.table_definitions,
+            self.window_definitions,
+            self.trigger_definitions,
+            self.aggregation_definitions,
+        ):
+            if id in m:
+                raise ValueError(f"duplicate definition id '{id}'")

@@ -1,0 +1,183 @@
+"""Typed definitions: streams, tables, windows, triggers, aggregations, functions.
+
+Covers the surface of the reference's ``io.siddhi.query.api.definition`` package
+(``StreamDefinition.java``, ``TableDefinition.java``, ``WindowDefinition.java``,
+``TriggerDefinition.java``, ``AggregationDefinition.java``, ``FunctionDefinition.java``,
+``Attribute.java``) redesigned for a columnar, dtype-first runtime: every attribute type
+maps to a fixed device dtype so event batches pack into SoA arrays.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .annotation import Annotation
+
+
+class DataType(enum.Enum):
+    """Attribute types (reference: ``definition/Attribute.java`` Type enum).
+
+    Each type carries its device representation: strings are dictionary-encoded to
+    int32 codes at ingress; OBJECT attributes stay host-side only.
+    """
+
+    STRING = "string"
+    INT = "int"
+    LONG = "long"
+    FLOAT = "float"
+    DOUBLE = "double"
+    BOOL = "bool"
+    OBJECT = "object"
+
+    @property
+    def numpy_dtype(self) -> str:
+        return {
+            DataType.STRING: "int32",   # dictionary code
+            DataType.INT: "int32",
+            DataType.LONG: "int64",
+            DataType.FLOAT: "float32",
+            DataType.DOUBLE: "float64",
+            DataType.BOOL: "bool",
+            DataType.OBJECT: "object",
+        }[self]
+
+    @property
+    def is_numeric(self) -> bool:
+        return self in (DataType.INT, DataType.LONG, DataType.FLOAT, DataType.DOUBLE)
+
+
+@dataclass(frozen=True)
+class Attribute:
+    name: str
+    type: DataType
+
+    def __repr__(self) -> str:
+        return f"{self.name} {self.type.value}"
+
+
+class AbstractDefinition:
+    """Common base for all definitions (reference: ``definition/AbstractDefinition.java``)."""
+
+    def __init__(self, id: str):
+        self.id = id
+        self.attributes: list[Attribute] = []
+        self.annotations: list[Annotation] = []
+        self._index: dict[str, int] = {}
+
+    def attribute(self, name: str, type: DataType | str) -> "AbstractDefinition":
+        if isinstance(type, str):
+            type = DataType(type)
+        if name in self._index:
+            raise ValueError(f"duplicate attribute '{name}' in definition '{self.id}'")
+        self._index[name] = len(self.attributes)
+        self.attributes.append(Attribute(name, type))
+        return self
+
+    def annotation(self, ann: Annotation) -> "AbstractDefinition":
+        self.annotations.append(ann)
+        return self
+
+    # -- lookups -------------------------------------------------------------
+    def attribute_position(self, name: str) -> int:
+        try:
+            return self._index[name]
+        except KeyError:
+            raise KeyError(
+                f"attribute '{name}' not found in '{self.id}' "
+                f"(has {[a.name for a in self.attributes]})"
+            ) from None
+
+    def attribute_type(self, name: str) -> DataType:
+        return self.attributes[self.attribute_position(name)].type
+
+    @property
+    def attribute_names(self) -> list[str]:
+        return [a.name for a in self.attributes]
+
+    def same_schema(self, other: "AbstractDefinition") -> bool:
+        return [(a.name, a.type) for a in self.attributes] == [
+            (a.name, a.type) for a in other.attributes
+        ]
+
+    def __repr__(self) -> str:
+        attrs = ", ".join(repr(a) for a in self.attributes)
+        return f"define {type(self).__name__.replace('Definition','').lower()} {self.id} ({attrs})"
+
+
+class StreamDefinition(AbstractDefinition):
+    """``define stream Name (attr type, ...)``."""
+
+
+class TableDefinition(AbstractDefinition):
+    """``define table Name (attr type, ...)`` with optional @PrimaryKey/@Index/@store."""
+
+
+class WindowDefinition(AbstractDefinition):
+    """``define window Name (attrs) window(params) [output <event-type> events]``.
+
+    Reference: ``definition/WindowDefinition.java`` — carries the window handler and
+    the output event type the named window publishes.
+    """
+
+    def __init__(self, id: str):
+        super().__init__(id)
+        self.window_handler: Any = None  # compiler sets a StreamHandler (Window)
+        self.output_event_type: "OutputEventType" = OutputEventType.ALL_EVENTS
+
+
+class OutputEventType(enum.Enum):
+    CURRENT_EVENTS = "current"
+    EXPIRED_EVENTS = "expired"
+    ALL_EVENTS = "all"
+
+
+@dataclass
+class TriggerDefinition:
+    """``define trigger T at {'start' | every <time> | '<cron>'}``."""
+
+    id: str
+    at_every_ms: Optional[int] = None  # periodic interval
+    at_cron: Optional[str] = None      # cron expression
+    at_start: bool = False
+    annotations: list[Annotation] = field(default_factory=list)
+
+
+class TimePeriodDuration(enum.Enum):
+    SECONDS = "seconds"
+    MINUTES = "minutes"
+    HOURS = "hours"
+    DAYS = "days"
+    MONTHS = "months"
+    YEARS = "years"
+
+    @property
+    def order(self) -> int:
+        return list(TimePeriodDuration).index(self)
+
+
+@dataclass
+class AggregationDefinition:
+    """``define aggregation A from S select ... group by ... aggregate [by ts] every sec...year``.
+
+    Reference: ``definition/AggregationDefinition.java`` + ``aggregation/TimePeriod.java``.
+    """
+
+    id: str
+    basic_single_input_stream: Any = None   # SingleInputStream
+    selector: Any = None                    # Selector
+    aggregate_attribute: Optional[str] = None  # timestamp attribute (None = event time)
+    durations: list[TimePeriodDuration] = field(default_factory=list)
+    annotations: list[Annotation] = field(default_factory=list)
+
+
+@dataclass
+class FunctionDefinition:
+    """``define function f[lang] return type { body }`` (script functions)."""
+
+    id: str
+    language: str
+    return_type: DataType
+    body: str
+    annotations: list[Annotation] = field(default_factory=list)

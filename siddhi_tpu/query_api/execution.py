@@ -1,0 +1,401 @@
+"""Execution AST: queries, input streams, pattern state elements, selectors, outputs.
+
+Reference: ``io.siddhi.query.api.execution`` — ``query/Query.java``,
+``query/input/stream/{Single,Join,State}InputStream.java``,
+``query/input/state/*StateElement.java``, ``query/selection/Selector.java``,
+``query/output/stream/*``, ``query/output/ratelimit``, ``partition/Partition.java``.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional, Union
+
+from .annotation import Annotation
+from .expression import Expression, Variable
+
+
+# ---------------------------------------------------------------------------
+# Stream handlers (things after '#' or '[...]' on an input stream)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Filter:
+    expr: Expression
+
+
+@dataclass
+class Window:
+    namespace: Optional[str]
+    name: str
+    params: list[Expression] = field(default_factory=list)
+
+
+@dataclass
+class StreamFunction:
+    namespace: Optional[str]
+    name: str
+    params: list[Expression] = field(default_factory=list)
+
+
+StreamHandler = Union[Filter, Window, StreamFunction]
+
+
+# ---------------------------------------------------------------------------
+# Input streams
+# ---------------------------------------------------------------------------
+
+@dataclass
+class SingleInputStream:
+    stream_id: str
+    handlers: list[StreamHandler] = field(default_factory=list)
+    alias: Optional[str] = None          # `as a`
+    is_fault_stream: bool = False        # `!stream`
+    is_inner_stream: bool = False        # `#stream` (partition-local)
+
+    @property
+    def window(self) -> Optional[Window]:
+        for h in self.handlers:
+            if isinstance(h, Window):
+                return h
+        return None
+
+    def ref(self) -> str:
+        return self.alias or self.stream_id
+
+
+class JoinType(enum.Enum):
+    JOIN = "join"                    # inner
+    INNER_JOIN = "inner join"
+    LEFT_OUTER_JOIN = "left outer join"
+    RIGHT_OUTER_JOIN = "right outer join"
+    FULL_OUTER_JOIN = "full outer join"
+
+
+class EventTrigger(enum.Enum):
+    """Which side's arrivals trigger join output (``unidirectional``)."""
+    LEFT = "left"
+    RIGHT = "right"
+    ALL = "all"
+
+
+@dataclass
+class JoinInputStream:
+    left: SingleInputStream
+    join_type: JoinType
+    right: SingleInputStream
+    on_condition: Optional[Expression] = None
+    trigger: EventTrigger = EventTrigger.ALL
+    within: Optional[Expression] = None
+    per: Optional[Expression] = None
+
+
+# ---------------------------------------------------------------------------
+# Pattern / sequence state elements
+# ---------------------------------------------------------------------------
+
+class StateElement:
+    pass
+
+
+@dataclass
+class StreamStateElement(StateElement):
+    """`e1=StreamA[filter]` — a basic input stream with optional alias binding."""
+    stream: SingleInputStream
+    within: Optional[Expression] = None
+
+
+@dataclass
+class NextStateElement(StateElement):
+    """`A -> B` (pattern) or `A , B` (sequence)."""
+    first: StateElement
+    next: StateElement
+    within: Optional[Expression] = None
+
+
+@dataclass
+class EveryStateElement(StateElement):
+    """`every (A -> B)` — re-seed matching on every occurrence."""
+    inner: StateElement
+    within: Optional[Expression] = None
+
+
+class LogicalType(enum.Enum):
+    AND = "and"
+    OR = "or"
+
+
+@dataclass
+class LogicalStateElement(StateElement):
+    """`A and B` / `A or B`."""
+    first: StreamStateElement
+    type: LogicalType
+    second: StreamStateElement
+    within: Optional[Expression] = None
+
+
+@dataclass
+class CountStateElement(StateElement):
+    """`A<min:max>` (pattern) or `A*`, `A+`, `A?` (sequence)."""
+    stream: StreamStateElement
+    min_count: int = 1
+    max_count: int = -1               # -1 = unbounded
+    within: Optional[Expression] = None
+
+    ANY = -1
+
+
+@dataclass
+class AbsentStreamStateElement(StateElement):
+    """`not A [for 1 sec]` — non-occurrence."""
+    stream: SingleInputStream
+    waiting_time_ms: Optional[int] = None
+    within: Optional[Expression] = None
+
+
+class StateInputStreamType(enum.Enum):
+    PATTERN = "pattern"    # skip-till-any-match between states
+    SEQUENCE = "sequence"  # strict continuity
+
+
+@dataclass
+class StateInputStream:
+    type: StateInputStreamType
+    state: StateElement
+    within: Optional[Expression] = None
+
+    def stream_ids(self) -> list[str]:
+        out: list[str] = []
+
+        def walk(el: StateElement) -> None:
+            if isinstance(el, StreamStateElement):
+                out.append(el.stream.stream_id)
+            elif isinstance(el, AbsentStreamStateElement):
+                out.append(el.stream.stream_id)
+            elif isinstance(el, NextStateElement):
+                walk(el.first)
+                walk(el.next)
+            elif isinstance(el, EveryStateElement):
+                walk(el.inner)
+            elif isinstance(el, LogicalStateElement):
+                walk(el.first)
+                walk(el.second)
+            elif isinstance(el, CountStateElement):
+                walk(el.stream)
+
+        walk(self.state)
+        seen: set[str] = set()
+        uniq = []
+        for s in out:
+            if s not in seen:
+                seen.add(s)
+                uniq.append(s)
+        return uniq
+
+
+InputStream = Union[SingleInputStream, JoinInputStream, StateInputStream]
+
+
+# ---------------------------------------------------------------------------
+# Selector
+# ---------------------------------------------------------------------------
+
+@dataclass
+class OutputAttribute:
+    rename: Optional[str]
+    expr: Expression
+
+    @property
+    def name(self) -> str:
+        if self.rename:
+            return self.rename
+        if isinstance(self.expr, Variable):
+            return self.expr.attribute
+        raise ValueError("projection expression needs an 'as' rename")
+
+
+class OrderByOrder(enum.Enum):
+    ASC = "asc"
+    DESC = "desc"
+
+
+@dataclass
+class OrderByAttribute:
+    variable: Variable
+    order: OrderByOrder = OrderByOrder.ASC
+
+
+@dataclass
+class Selector:
+    select_all: bool = False                       # `select *`
+    attributes: list[OutputAttribute] = field(default_factory=list)
+    group_by: list[Variable] = field(default_factory=list)
+    having: Optional[Expression] = None
+    order_by: list[OrderByAttribute] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# Output streams & rate limiting
+# ---------------------------------------------------------------------------
+
+class OutputEventsFor(enum.Enum):
+    """`insert into X for current events / expired events / all events`."""
+    CURRENT_EVENTS = "current"
+    EXPIRED_EVENTS = "expired"
+    ALL_EVENTS = "all"
+
+
+@dataclass
+class InsertIntoStream:
+    target_id: str
+    events_for: OutputEventsFor = OutputEventsFor.CURRENT_EVENTS
+    is_fault_stream: bool = False
+    is_inner_stream: bool = False
+
+
+@dataclass
+class ReturnStream:
+    events_for: OutputEventsFor = OutputEventsFor.CURRENT_EVENTS
+
+
+@dataclass
+class DeleteStream:
+    target_id: str
+    on_condition: Expression = None
+
+
+@dataclass
+class UpdateSetAttribute:
+    table_variable: Variable
+    value_expr: Expression
+
+
+@dataclass
+class UpdateStream:
+    target_id: str
+    on_condition: Expression = None
+    set_attributes: list[UpdateSetAttribute] = field(default_factory=list)
+
+
+@dataclass
+class UpdateOrInsertStream:
+    target_id: str
+    on_condition: Expression = None
+    set_attributes: list[UpdateSetAttribute] = field(default_factory=list)
+
+
+OutputStream = Union[InsertIntoStream, ReturnStream, DeleteStream, UpdateStream, UpdateOrInsertStream]
+
+
+class OutputRateType(enum.Enum):
+    ALL = "all"
+    FIRST = "first"
+    LAST = "last"
+
+
+@dataclass
+class EventOutputRate:
+    """`output [all|first|last] every N events`."""
+    value: int
+    type: OutputRateType = OutputRateType.ALL
+
+
+@dataclass
+class TimeOutputRate:
+    """`output [all|first|last] every <time>`."""
+    value_ms: int
+    type: OutputRateType = OutputRateType.ALL
+
+
+@dataclass
+class SnapshotOutputRate:
+    """`output snapshot every <time>`."""
+    value_ms: int
+
+
+OutputRate = Union[EventOutputRate, TimeOutputRate, SnapshotOutputRate, None]
+
+
+# ---------------------------------------------------------------------------
+# Query / partition / on-demand query
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Query:
+    input_stream: InputStream = None
+    selector: Selector = field(default_factory=Selector)
+    output_stream: OutputStream = None
+    output_rate: OutputRate = None
+    annotations: list[Annotation] = field(default_factory=list)
+
+    # fluent builder API (reference: Query.query().from_(...).select(...)...)
+    @staticmethod
+    def query() -> "Query":
+        return Query()
+
+    def from_(self, input_stream: InputStream) -> "Query":
+        self.input_stream = input_stream
+        return self
+
+    def select(self, selector: Selector) -> "Query":
+        self.selector = selector
+        return self
+
+    def insert_into(self, target: str,
+                    events_for: OutputEventsFor = OutputEventsFor.CURRENT_EVENTS) -> "Query":
+        self.output_stream = InsertIntoStream(target, events_for)
+        return self
+
+    def annotation(self, ann: Annotation) -> "Query":
+        self.annotations.append(ann)
+        return self
+
+    def name(self) -> Optional[str]:
+        from .annotation import find_annotation
+        info = find_annotation(self.annotations, "info")
+        return info.get("name") if info else None
+
+
+@dataclass
+class RangePartitionProperty:
+    partition_key: str                 # range label, e.g. 'LessValue'
+    condition: Expression = None
+
+
+@dataclass
+class PartitionType:
+    stream_id: str
+    # exactly one of:
+    value_expr: Optional[Expression] = None
+    ranges: list[RangePartitionProperty] = field(default_factory=list)
+
+
+@dataclass
+class Partition:
+    partition_types: list[PartitionType] = field(default_factory=list)
+    queries: list[Query] = field(default_factory=list)
+    annotations: list[Annotation] = field(default_factory=list)
+
+
+class OnDemandQueryType(enum.Enum):
+    FIND = "find"
+    INSERT = "insert"
+    DELETE = "delete"
+    UPDATE = "update"
+    UPDATE_OR_INSERT = "update or insert"
+
+
+@dataclass
+class OnDemandQuery:
+    """Pull query against a table/window/aggregation (`runtime.query(...)`)."""
+    type: OnDemandQueryType
+    input_store_id: Optional[str] = None
+    on_condition: Optional[Expression] = None
+    selector: Selector = field(default_factory=Selector)
+    output_stream: OutputStream = None
+    # aggregation on-demand extras: `within <t1>, <t2> per 'seconds'`
+    within: Optional[tuple] = None
+    per: Optional[Expression] = None

@@ -1,0 +1,147 @@
+"""Expression AST.
+
+Reference: ``io.siddhi.query.api.expression`` (Expression, Variable, constants,
+condition/Compare..., math/Add..., AttributeFunction). Redesigned as plain dataclasses;
+the same tree is consumed by both the host interpreter executor builder
+(``core/executor.py``) and the TPU expression compiler (``tpu/expr_compile.py``).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional, Union
+
+from .definition import DataType
+
+
+class Expression:
+    """Base class; factory helpers (``Expression.value``/``Expression.variable``,
+    mirroring the reference's fluent API) are attached below the dataclass
+    definitions to avoid colliding with dataclass field names."""
+
+    # comparison / logic sugar
+    def __and__(self, other: "Expression") -> "And":
+        return And(self, other)
+
+    def __or__(self, other: "Expression") -> "Or":
+        return Or(self, other)
+
+
+class CompareOp(enum.Enum):
+    LT = "<"
+    LE = "<="
+    GT = ">"
+    GE = ">="
+    EQ = "=="
+    NEQ = "!="
+
+
+class MathOp(enum.Enum):
+    ADD = "+"
+    SUB = "-"
+    MUL = "*"
+    DIV = "/"
+    MOD = "%"
+
+
+@dataclass
+class Constant(Expression):
+    value: Any
+    type: DataType
+
+    # time constants (e.g. ``10 sec``) parse to Constant(millis, LONG) with is_time=True
+    is_time: bool = False
+
+
+# sentinel for ``e[last]`` style indexes
+LAST_INDEX = -1
+
+
+@dataclass
+class Variable(Expression):
+    attribute: str
+    stream_id: Optional[str] = None       # stream id or pattern alias ("e1")
+    stream_index: Optional[int] = None    # e1[0] / e1[last] (LAST_INDEX)
+    function_id: Optional[str] = None     # aggregation function references
+
+
+@dataclass
+class Compare(Expression):
+    left: Expression
+    op: CompareOp
+    right: Expression
+
+
+@dataclass
+class And(Expression):
+    left: Expression
+    right: Expression
+
+
+@dataclass
+class Or(Expression):
+    left: Expression
+    right: Expression
+
+
+@dataclass
+class Not(Expression):
+    expr: Expression
+
+
+@dataclass
+class IsNull(Expression):
+    expr: Optional[Expression] = None
+    stream_id: Optional[str] = None       # ``e1 is null`` (pattern absent check)
+    stream_index: Optional[int] = None
+
+
+@dataclass
+class In(Expression):
+    expr: Expression
+    source_id: str                        # table/window id
+
+
+@dataclass
+class MathExpr(Expression):
+    left: Expression
+    op: MathOp
+    right: Expression
+
+
+@dataclass
+class Minus(Expression):                  # unary minus
+    expr: Expression
+
+
+@dataclass
+class AttributeFunction(Expression):
+    """``ns:name(arg, ...)`` — built-in function, aggregator, or extension call."""
+
+    namespace: Optional[str]
+    name: str
+    args: list[Expression] = field(default_factory=list)
+
+
+# -- fluent factory API (reference: Expression.value/variable static methods) ----
+
+def _expr_value(v: Any) -> Constant:
+    if isinstance(v, bool):
+        return Constant(v, DataType.BOOL)
+    if isinstance(v, int):
+        return Constant(v, DataType.LONG if abs(v) > 2**31 - 1 else DataType.INT)
+    if isinstance(v, float):
+        return Constant(v, DataType.DOUBLE)
+    if isinstance(v, str):
+        return Constant(v, DataType.STRING)
+    raise TypeError(f"unsupported constant {v!r}")
+
+
+def _expr_variable(name: str, stream: Optional[str] = None,
+                   index: Optional[int] = None) -> Variable:
+    return Variable(attribute=name, stream_id=stream, stream_index=index)
+
+
+Expression.value = staticmethod(_expr_value)
+Expression.variable = staticmethod(_expr_variable)

@@ -1,0 +1,302 @@
+"""SiddhiQL parser tests.
+
+Shape mirrors the reference's compiler round-trip tests
+(``modules/siddhi-query-compiler/src/test/.../SimpleQueryTestCase.java`` etc.):
+parse a query string, assert the AST structure.
+"""
+
+import pytest
+
+from siddhi_tpu import parse, parse_on_demand_query, parse_query
+from siddhi_tpu.compiler import SiddhiParserError, update_variables
+from siddhi_tpu.query_api import (
+    AbsentStreamStateElement,
+    And,
+    AttributeFunction,
+    Compare,
+    CompareOp,
+    Constant,
+    CountStateElement,
+    DataType,
+    DeleteStream,
+    EventOutputRate,
+    EveryStateElement,
+    Filter,
+    InsertIntoStream,
+    JoinInputStream,
+    JoinType,
+    LAST_INDEX,
+    LogicalStateElement,
+    LogicalType,
+    NextStateElement,
+    OnDemandQueryType,
+    OutputEventsFor,
+    OutputEventType,
+    OutputRateType,
+    SingleInputStream,
+    SnapshotOutputRate,
+    StateInputStream,
+    StateInputStreamType,
+    StreamStateElement,
+    TimeOutputRate,
+    TimePeriodDuration,
+    Variable,
+    Window,
+)
+
+
+def test_define_stream():
+    app = parse("define stream StockStream (symbol string, price float, volume long);")
+    d = app.stream_definitions["StockStream"]
+    assert d.attribute_names == ["symbol", "price", "volume"]
+    assert d.attribute_type("price") == DataType.FLOAT
+    assert d.attribute_position("volume") == 2
+
+
+def test_filter_query_structure():
+    q = parse_query(
+        "from StockStream[price > 100 and volume > 50] select symbol, price insert into Out"
+    )
+    s = q.input_stream
+    assert isinstance(s, SingleInputStream)
+    assert s.stream_id == "StockStream"
+    (f,) = s.handlers
+    assert isinstance(f, Filter)
+    assert isinstance(f.expr, And)
+    assert isinstance(f.expr.left, Compare)
+    assert f.expr.left.op == CompareOp.GT
+    assert isinstance(q.output_stream, InsertIntoStream)
+    assert q.output_stream.target_id == "Out"
+    assert [a.name for a in q.selector.attributes] == ["symbol", "price"]
+
+
+def test_window_and_aggregation_select():
+    q = parse_query(
+        "from S#window.length(5) select sym, avg(price) as ap, sum(vol) as v "
+        "group by sym having ap > 10 order by sym desc limit 3 offset 1 insert into O"
+    )
+    w = q.input_stream.window
+    assert isinstance(w, Window)
+    assert w.name == "length"
+    assert w.params[0].value == 5
+    sel = q.selector
+    assert sel.group_by[0].attribute == "sym"
+    assert sel.having is not None
+    assert sel.limit == 3 and sel.offset == 1
+    agg = sel.attributes[1].expr
+    assert isinstance(agg, AttributeFunction) and agg.name == "avg"
+
+
+def test_time_window_params():
+    q = parse_query("from S#window.time(1 min 30 sec) select * insert into O")
+    w = q.input_stream.window
+    assert w.params[0].value == 90_000
+    assert w.params[0].is_time
+
+
+def test_insert_events_for():
+    q = parse_query("from S#window.time(1 sec) select * insert expired events into O")
+    assert q.output_stream.events_for == OutputEventsFor.EXPIRED_EVENTS
+
+
+def test_pattern_query():
+    q = parse_query(
+        "from every e1=S1[price>20] -> e2=S2[price>e1.price] within 10 sec "
+        "select e1.price as p1, e2.price as p2 insert into O"
+    )
+    st = q.input_stream
+    assert isinstance(st, StateInputStream)
+    assert st.type == StateInputStreamType.PATTERN
+    assert st.within.value == 10_000
+    nxt = st.state
+    assert isinstance(nxt, NextStateElement)
+    assert isinstance(nxt.first, EveryStateElement)
+    inner = nxt.first.inner
+    assert isinstance(inner, StreamStateElement)
+    assert inner.stream.alias == "e1"
+    assert isinstance(nxt.next, StreamStateElement)
+    # cross-state reference e1.price parsed as Variable with stream_id
+    f = nxt.next.stream.handlers[0]
+    assert isinstance(f.expr.right, Variable) and f.expr.right.stream_id == "e1"
+
+
+def test_pattern_count_and_index():
+    q = parse_query(
+        "from e1=S1 -> e2=S2<2:5> select e2[0].p as a, e2[last].p as b insert into O"
+    )
+    cnt = q.input_stream.state.next
+    assert isinstance(cnt, CountStateElement)
+    assert cnt.min_count == 2 and cnt.max_count == 5
+    a, b = q.selector.attributes
+    assert a.expr.stream_index == 0
+    assert b.expr.stream_index == LAST_INDEX
+
+
+def test_pattern_logical_and_absent():
+    q = parse_query(
+        "from e1=S1 and e2=S2 -> not S3[x=='q'] for 5 sec select e1.a insert into O"
+    )
+    nxt = q.input_stream.state
+    log = nxt.first
+    assert isinstance(log, LogicalStateElement) and log.type == LogicalType.AND
+    absent = nxt.next
+    assert isinstance(absent, AbsentStreamStateElement)
+    assert absent.waiting_time_ms == 5000
+
+
+def test_sequence_query():
+    q = parse_query("from e1=A, e2=B*, e3=C select e1.x, e3.y insert into O")
+    st = q.input_stream
+    assert st.type == StateInputStreamType.SEQUENCE
+    mid = st.state.next.first
+    assert isinstance(mid, CountStateElement)
+    assert mid.min_count == 0 and mid.max_count == -1
+
+
+def test_join_query():
+    q = parse_query(
+        "from S1#window.time(1 min) as a join S2#window.length(10) as b "
+        "on a.x == b.y within 5 sec select a.x, b.y insert into O"
+    )
+    j = q.input_stream
+    assert isinstance(j, JoinInputStream)
+    assert j.join_type == JoinType.JOIN
+    assert j.left.alias == "a" and j.right.alias == "b"
+    assert j.on_condition is not None
+    assert j.within.value == 5000
+
+
+def test_left_outer_join():
+    q = parse_query("from A as l left outer join B as r on l.x == r.x select l.x insert into O")
+    assert q.input_stream.join_type == JoinType.LEFT_OUTER_JOIN
+
+
+def test_output_rates():
+    q = parse_query("from S select a output first every 5 events insert into O")
+    assert isinstance(q.output_rate, EventOutputRate)
+    assert q.output_rate.type == OutputRateType.FIRST and q.output_rate.value == 5
+    q = parse_query("from S select a output last every 2 sec insert into O")
+    assert isinstance(q.output_rate, TimeOutputRate) and q.output_rate.value_ms == 2000
+    q = parse_query("from S select a output snapshot every 1 min insert into O")
+    assert isinstance(q.output_rate, SnapshotOutputRate)
+
+
+def test_table_actions():
+    app = parse("""
+        define stream S (symbol string, price float);
+        define table T (symbol string, price float);
+        from S delete T on T.symbol == symbol;
+        from S update T set T.price = price on T.symbol == symbol;
+        from S update or insert into T set T.price = price on T.symbol == symbol;
+    """)
+    d, u, uoi = app.queries
+    assert isinstance(d.output_stream, DeleteStream)
+    assert u.output_stream.set_attributes[0].table_variable.stream_id == "T"
+    assert uoi.output_stream.target_id == "T"
+
+
+def test_partition():
+    app = parse("""
+        define stream S (k string, v int);
+        partition with (k of S)
+        begin
+            from S select k, sum(v) as t insert into #I;
+            from #I select * insert into Out;
+        end;
+    """)
+    (p,) = app.partitions
+    assert p.partition_types[0].stream_id == "S"
+    assert len(p.queries) == 2
+    assert p.queries[0].output_stream.is_inner_stream
+    assert p.queries[1].input_stream.is_inner_stream
+
+
+def test_range_partition():
+    app = parse("""
+        define stream S (v double);
+        partition with (v < 100 as 'small' or v >= 100 as 'large' of S)
+        begin from S select v insert into Out; end;
+    """)
+    pt = app.partitions[0].partition_types[0]
+    assert [r.partition_key for r in pt.ranges] == ["small", "large"]
+
+
+def test_define_window_trigger_aggregation_function():
+    app = parse("""
+        define window W (a int) length(5) output all events;
+        define trigger T at every 5 sec;
+        define trigger T2 at 'start';
+        define trigger T3 at '*/5 * * * * ?';
+        define aggregation Agg from S select sym, avg(p) as ap group by sym
+            aggregate by ts every sec ... day;
+        define function f[javascript] return string { return x; };
+    """)
+    w = app.window_definitions["W"]
+    assert w.window_handler.name == "length"
+    assert w.output_event_type == OutputEventType.ALL_EVENTS
+    assert app.trigger_definitions["T"].at_every_ms == 5000
+    assert app.trigger_definitions["T2"].at_start
+    assert app.trigger_definitions["T3"].at_cron == "*/5 * * * * ?"
+    agg = app.aggregation_definitions["Agg"]
+    assert agg.aggregate_attribute == "ts"
+    assert agg.durations == [
+        TimePeriodDuration.SECONDS, TimePeriodDuration.MINUTES,
+        TimePeriodDuration.HOURS, TimePeriodDuration.DAYS,
+    ]
+    assert app.function_definitions["f"].language == "javascript"
+
+
+def test_annotations():
+    app = parse("""
+        @app:name('MyApp')
+        @source(type='inMemory', topic='t1', @map(type='passThrough'))
+        define stream S (a int);
+    """)
+    assert app.name() == "MyApp"
+    src = app.stream_definitions["S"].annotations[0]
+    assert src.name == "source"
+    assert src.get("type") == "inMemory"
+    assert src.nested("map").get("type") == "passThrough"
+
+
+def test_on_demand_query():
+    odq = parse_on_demand_query("from T on price > 10 select symbol, price")
+    assert odq.type == OnDemandQueryType.FIND
+    assert odq.input_store_id == "T"
+    odq = parse_on_demand_query("select 'x' as symbol, 1.0 as price insert into T")
+    assert odq.type == OnDemandQueryType.INSERT
+
+
+def test_var_substitution():
+    text = update_variables("define stream S (a ${T});", {"T": "int"})
+    assert "a int" in text
+    with pytest.raises(SiddhiParserError):
+        update_variables("define stream S (a ${MISSING_XYZ});", {})
+
+
+def test_string_literals_and_comments():
+    app = parse("""
+        -- line comment
+        /* block
+           comment */
+        define stream S (a string);
+        from S[a == 'hello' or a == "world"] select a insert into O;
+    """)
+    assert len(app.queries) == 1
+
+
+def test_parse_error_reports_location():
+    with pytest.raises(SiddhiParserError) as e:
+        parse("define stream S (a int;")
+    assert "line" in str(e.value)
+
+
+def test_fault_stream_reference():
+    q = parse_query("from !S select a insert into O")
+    assert q.input_stream.is_fault_stream
+
+
+def test_unidirectional_join():
+    q = parse_query("from A unidirectional join B on A.x == B.x select A.x insert into O")
+    from siddhi_tpu.query_api import EventTrigger
+    assert q.input_stream.trigger == EventTrigger.LEFT
