@@ -11,3 +11,14 @@ __version__ = "0.1.0"
 
 from . import query_api
 from .compiler import SiddhiCompiler, parse, parse_on_demand_query, parse_query
+from .core import (
+    Event,
+    InMemoryBroker,
+    InMemoryPersistenceStore,
+    InputHandler,
+    QueryCallback,
+    SiddhiAppRuntime,
+    SiddhiManager,
+    StreamCallback,
+    extension,
+)

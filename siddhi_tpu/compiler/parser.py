@@ -880,12 +880,12 @@ class Parser:
         while True:
             if self.at_kw("is") and self.at_kw("null", offset=1):
                 self.next(); self.next()
-                if isinstance(left, Variable) and left.stream_id is None and left.attribute[0].islower() is False:
-                    # `e1 is null` — bare stream/alias reference
-                    left = IsNull(None, left.attribute, left.stream_index)
-                elif isinstance(left, Variable) and left.stream_id is None:
+                if isinstance(left, Variable) and left.stream_id is None \
+                        and left.stream_index is not None:
+                    # `e1[1] is null` — unambiguous alias reference
                     left = IsNull(None, left.attribute, left.stream_index)
                 else:
+                    # bare name: executor context decides attribute vs alias
                     left = IsNull(left)
             elif self.accept_kw("in"):
                 left = In(left, self.expect_ident())
@@ -987,7 +987,8 @@ class Parser:
 
     # ------------------------------------------------------------- time values
     def parse_time_value(self) -> int:
-        """`1 hour 20 min` → milliseconds (sums unit terms)."""
+        """`1 hour 20 min` → milliseconds (sums unit terms). A bare integer is
+        accepted as milliseconds (superset of SiddhiQL)."""
         total = 0
         seen = False
         while self.peek().type in (TokenType.INT, TokenType.LONG) and (
@@ -999,5 +1000,7 @@ class Parser:
             total += n * TIME_UNITS[unit]
             seen = True
         if not seen:
+            if self.peek().type in (TokenType.INT, TokenType.LONG):
+                return int(self.next().value)
             self.fail("expected time value")
         return total

@@ -1,0 +1,23 @@
+"""Host interpreter runtime — the semantic oracle and cold-path engine.
+
+Mirrors the reference's ``siddhi-core`` module structure: event model, stream
+junctions, processor chains, windows, NFA pattern engine, joins, selectors,
+tables, partitions, triggers, snapshots, sources/sinks.
+"""
+
+from .event import Event, EventType, StateEvent, StreamEvent
+from .manager import SiddhiManager
+from .app_runtime import SiddhiAppRuntime
+from .stream import InputHandler, QueryCallback, StreamCallback
+from .snapshot import (
+    FileSystemPersistenceStore,
+    InMemoryPersistenceStore,
+    PersistenceStore,
+)
+from .extension import (
+    ScalarFunctionExtension,
+    StreamFunctionExtension,
+    extension,
+)
+from .io import InMemoryBroker
+from .metrics import Level

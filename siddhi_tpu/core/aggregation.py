@@ -1,0 +1,225 @@
+"""Incremental aggregations: multi-duration rollup cascade.
+
+Reference: ``core/aggregation/`` — ``AggregationRuntime.java``,
+``IncrementalExecutor.java`` (bucket rollover), per-duration stores, on-demand
+``within ... per ...`` retrieval. Redesigned: buckets are keyed dicts of running
+aggregator states per duration; rollups happen by bucketing the event timestamp
+directly into every requested duration (equivalent results, no cascade chain —
+the cascade is an optimization the TPU path reintroduces as segmented scans).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Any, Optional
+
+from ..query_api import (
+    AttributeFunction,
+    OnDemandQuery,
+    OutputAttribute,
+    Variable,
+)
+from ..query_api.definition import AggregationDefinition, TimePeriodDuration
+from .aggregators import AGGREGATOR_NAMES, aggregator_return_type, make_aggregator
+from .event import Event, EventType, StreamEvent
+from .executor import ExecutorBuilder, StreamFrame, StreamResolver
+
+_MS = {
+    TimePeriodDuration.SECONDS: 1000,
+    TimePeriodDuration.MINUTES: 60_000,
+    TimePeriodDuration.HOURS: 3_600_000,
+    TimePeriodDuration.DAYS: 86_400_000,
+}
+
+
+def bucket_start(ts: int, duration: TimePeriodDuration) -> int:
+    if duration in _MS:
+        return ts - ts % _MS[duration]
+    dt = _dt.datetime.fromtimestamp(ts / 1000.0, tz=_dt.timezone.utc)
+    if duration == TimePeriodDuration.MONTHS:
+        dt = dt.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+    else:  # YEARS
+        dt = dt.replace(month=1, day=1, hour=0, minute=0, second=0, microsecond=0)
+    return int(dt.timestamp() * 1000)
+
+
+class AggregationRuntime:
+    def __init__(self, definition: AggregationDefinition, app_context,
+                 stream_defs: dict):
+        self.definition = definition
+        self.app_context = app_context
+        stream = definition.basic_single_input_stream
+        sid = stream.stream_id
+        if sid not in stream_defs:
+            raise KeyError(f"aggregation '{definition.id}': undefined stream '{sid}'")
+        self.input_def = stream_defs[sid]
+        builder = ExecutorBuilder(StreamResolver(self.input_def), app_context)
+
+        # timestamp executor
+        if definition.aggregate_attribute is not None:
+            self.ts_fn, _ = builder.build(
+                Variable(attribute=definition.aggregate_attribute))
+        else:
+            self.ts_fn = None
+
+        # selector decomposition
+        self.group_fns = [builder.build(v)[0] for v in definition.selector.group_by]
+        self.attr_specs = []     # (name, kind, fn, agg_name, dtype)
+        for oa in definition.selector.attributes:
+            e = oa.expr
+            if isinstance(e, AttributeFunction) and e.namespace is None \
+                    and e.name in AGGREGATOR_NAMES:
+                arg_fn, arg_t = builder.build(e.args[0]) if e.args else ((lambda f: None), None)
+                self.attr_specs.append(
+                    (oa.name, "agg", arg_fn, e.name,
+                     aggregator_return_type(e.name, arg_t), arg_t))
+            else:
+                fn, t = builder.build(e)
+                self.attr_specs.append((oa.name, "value", fn, None, t, t))
+
+        # duration -> {bucket_start -> {group_key -> state}}
+        # state = {"aggs": {name: Aggregator}, "values": {name: last value}}
+        self.stores: dict[TimePeriodDuration, dict[int, dict[Any, dict]]] = {
+            d: {} for d in definition.durations
+        }
+        app_context.register_state(f"aggregation-{definition.id}", self)
+
+        # subscribe via a junction receiver
+        junction = app_context.stream_junctions.get(sid)
+        if junction is not None:
+            junction.subscribe(self)
+
+        # honor filters on the input stream
+        from ..query_api import Filter as _F
+        self.filter_fn = None
+        for h in stream.handlers:
+            if isinstance(h, _F):
+                self.filter_fn, _ = builder.build(h.expr)
+
+    # -- junction receiver ----------------------------------------------------
+    def receive(self, event: StreamEvent) -> None:
+        if event.type != EventType.CURRENT:
+            return
+        frame = StreamFrame(event)
+        if self.filter_fn is not None and not bool(self.filter_fn(frame)):
+            return
+        ts = int(self.ts_fn(frame)) if self.ts_fn is not None else event.timestamp
+        key = tuple(fn(frame) for fn in self.group_fns) if self.group_fns else None
+        for duration, buckets in self.stores.items():
+            bs = bucket_start(ts, duration)
+            bucket = buckets.setdefault(bs, {})
+            state = bucket.get(key)
+            if state is None:
+                state = {
+                    "aggs": {
+                        name: make_aggregator(agg_name, arg_t)
+                        for name, kind, fn, agg_name, rt, arg_t in self.attr_specs
+                        if kind == "agg"
+                    },
+                    "values": {},
+                }
+                bucket[key] = state
+            for name, kind, fn, agg_name, rt, arg_t in self.attr_specs:
+                if kind == "agg":
+                    state["aggs"][name].add(fn(frame))
+                else:
+                    state["values"][name] = fn(frame)
+
+    # -- retrieval ------------------------------------------------------------
+    @property
+    def output_names(self) -> list[str]:
+        return ["AGG_TIMESTAMP"] + [s[0] for s in self.attr_specs]
+
+    def rows_for(self, duration: TimePeriodDuration,
+                 start: Optional[int] = None, end: Optional[int] = None) -> list[list]:
+        buckets = self.stores.get(duration)
+        if buckets is None:
+            raise KeyError(
+                f"aggregation '{self.definition.id}' has no duration {duration}")
+        rows = []
+        for bs in sorted(buckets):
+            if start is not None and bs < start:
+                continue
+            if end is not None and bs >= end:
+                continue
+            for key, state in buckets[bs].items():
+                row = [bs]
+                for name, kind, fn, agg_name, rt, arg_t in self.attr_specs:
+                    if kind == "agg":
+                        row.append(state["aggs"][name].value())
+                    else:
+                        row.append(state["values"].get(name))
+                rows.append(row)
+        return rows
+
+    def on_demand_find(self, odq: OnDemandQuery, now: int) -> list[Event]:
+        # `within t1 [, t2] per 'duration'`
+        duration = self.definition.durations[0]
+        if odq.per is not None:
+            per = str(odq.per.value).rstrip("s")
+            dur_map = {
+                "second": TimePeriodDuration.SECONDS, "sec": TimePeriodDuration.SECONDS,
+                "minute": TimePeriodDuration.MINUTES, "min": TimePeriodDuration.MINUTES,
+                "hour": TimePeriodDuration.HOURS, "day": TimePeriodDuration.DAYS,
+                "month": TimePeriodDuration.MONTHS, "year": TimePeriodDuration.YEARS,
+            }
+            duration = dur_map.get(per, duration)
+        start = end = None
+        if odq.within:
+            vals = [v.value for v in odq.within]
+            start = vals[0]
+            end = vals[1] if len(vals) > 1 else None
+        rows = self.rows_for(duration, start, end)
+
+        names = self.output_names
+        from .executor import RowFrame, RowResolver
+        from ..query_api.definition import DataType
+        types = [DataType.LONG] + [s[4] for s in self.attr_specs]
+        builder = ExecutorBuilder(RowResolver(names, types), self.app_context)
+        if odq.on_condition is not None:
+            cond, _ = builder.build(odq.on_condition)
+            rows = [r for r in rows if bool(cond(RowFrame(r, now)))]
+        attrs = list(odq.selector.attributes)
+        if odq.selector.select_all or not attrs:
+            return [Event(now, list(r)) for r in rows]
+        out = []
+        for r in rows:
+            frame = RowFrame(r, now)
+            out.append(Event(now, [builder.build(a.expr)[0](frame) for a in attrs]))
+        return out
+
+    # -- state ----------------------------------------------------------------
+    def snapshot_state(self) -> dict:
+        enc = {}
+        for duration, buckets in self.stores.items():
+            enc[duration.value] = {
+                bs: {
+                    repr(key): {
+                        "aggs": {n: a.snapshot() for n, a in st["aggs"].items()},
+                        "values": dict(st["values"]),
+                        "_key": key,
+                    }
+                    for key, st in bucket.items()
+                }
+                for bs, bucket in buckets.items()
+            }
+        return enc
+
+    def restore_state(self, state: dict) -> None:
+        for duration in self.stores:
+            self.stores[duration] = {}
+            for bs, bucket in state.get(duration.value, {}).items():
+                dst = self.stores[duration].setdefault(int(bs), {})
+                for _, st in bucket.items():
+                    key = st["_key"]
+                    new_state = {
+                        "aggs": {
+                            name: make_aggregator(agg_name, arg_t)
+                            for name, kind, fn, agg_name, rt, arg_t in self.attr_specs
+                            if kind == "agg"
+                        },
+                        "values": dict(st["values"]),
+                    }
+                    for n, a in new_state["aggs"].items():
+                        a.restore(st["aggs"][n])
+                    dst[key] = new_state

@@ -1,0 +1,322 @@
+"""SiddhiAppRuntime: build + lifecycle for one app.
+
+Reference: ``core/SiddhiAppRuntime.java`` / ``SiddhiAppRuntimeImpl.java`` (start:449,
+shutdown:552, persist:686, query:309) and ``util/SiddhiAppRuntimeBuilder`` +
+``util/parser/SiddhiAppParser`` (definitions, fault streams :382, queries,
+partitions).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..compiler import parse_on_demand_query
+from ..query_api import Partition, Query, SiddhiApp, Window
+from ..query_api.annotation import find_annotation
+from ..query_api.definition import DataType, StreamDefinition
+from .context import SiddhiAppContext, SiddhiContext
+from .errors import SiddhiAppCreationError
+from .event import Event
+from .extension import ScriptFunction
+from .io import (
+    SINK_MAPPERS,
+    SINKS,
+    SOURCE_MAPPERS,
+    SOURCES,
+    parse_io_annotations,
+)
+from .metrics import Level, StatisticsManager
+from .named_window import NamedWindow
+from .on_demand import OnDemandQueryRuntime
+from .partition import PartitionRuntime
+from .query_runtime import QueryRuntime, build_query_runtime, make_window_processor
+from .scheduler import SystemTicker
+from .snapshot import PersistenceManager, SnapshotService
+from .stream import (
+    InputHandler,
+    OnErrorAction,
+    QueryCallback,
+    StreamCallback,
+    StreamJunction,
+    _StreamCallbackReceiver,
+)
+from .table import InMemoryTable
+from .trigger import TriggerRuntime, trigger_stream_definition
+
+log = logging.getLogger("siddhi_tpu.app")
+
+
+class SiddhiAppRuntime:
+    def __init__(self, app: SiddhiApp, siddhi_context: SiddhiContext,
+                 playback: Optional[bool] = None, start_time: int = 0):
+        self.app = app
+        app_ann = find_annotation(app.annotations, "app")
+        playback_ann = find_annotation(app.annotations, "playback")
+        if playback is None:
+            playback = playback_ann is not None or (
+                app_ann is not None and app_ann.get("playback") == "true")
+        self.name = app.name()
+        self.ctx = SiddhiAppContext(siddhi_context, self.name, playback, start_time)
+        self.ctx.runtime = self
+        self.ctx.statistics_manager = StatisticsManager(self.name)
+        self.input_handlers: dict[str, InputHandler] = {}
+        self.query_runtimes: dict[str, QueryRuntime] = {}
+        self.partition_runtimes: list[PartitionRuntime] = []
+        self.trigger_runtimes: list[TriggerRuntime] = []
+        self.sources: list = []
+        self.sinks: list = []
+        self._started = False
+        self._ondemand_cache: dict[str, OnDemandQueryRuntime] = {}
+
+        self.snapshot_service = SnapshotService(self.ctx)
+        self.persistence = PersistenceManager(
+            self.ctx, self.snapshot_service, siddhi_context.persistence_store)
+
+        self._build()
+
+    # ------------------------------------------------------------------ build
+    def _build(self) -> None:
+        app, ctx = self.app, self.ctx
+        # script functions
+        for fd in app.function_definitions.values():
+            ctx.script_functions[fd.id] = ScriptFunction(
+                fd.id, fd.language, fd.return_type, fd.body)
+        # tables
+        for td in app.table_definitions.values():
+            store_ann = find_annotation(td.annotations, "store")
+            if store_ann is not None:
+                store_type = store_ann.get("type")
+                cls = ctx.siddhi_context.extensions.get(f"store:{store_type}")
+                if cls is None:
+                    raise SiddhiAppCreationError(
+                        f"no store extension '{store_type}' for table '{td.id}'")
+                table = cls(td, ctx)
+                table.init(td, {e.key: e.value for e in store_ann.elements if e.key})
+            else:
+                table = InMemoryTable(td, ctx)
+            ctx.tables[td.id] = table
+        # streams + junctions (+ fault streams)
+        for sd in app.stream_definitions.values():
+            self._get_junction(sd.id, define=sd)
+            onerror = find_annotation(sd.annotations, "OnError")
+            if onerror is not None:
+                action = (onerror.get("action") or "log").lower()
+                junction = ctx.stream_junctions[sd.id]
+                junction.on_error_action = action
+                if action == OnErrorAction.STREAM:
+                    fault_def = StreamDefinition("!" + sd.id)
+                    for a in sd.attributes:
+                        fault_def.attribute(a.name, a.type)
+                    fault_def.attribute("_error", DataType.OBJECT)
+                    fj = self._get_junction("!" + sd.id, define=fault_def)
+                    junction.fault_junction = fj
+        # named windows
+        for wd in app.window_definitions.values():
+            handler = wd.window_handler or Window(None, "length", [])
+            proc = make_window_processor(handler, wd, ctx, f"window-{wd.id}")
+            ctx.named_windows[wd.id] = NamedWindow(wd, proc, ctx)
+        # triggers
+        for td in app.trigger_definitions.values():
+            sd = trigger_stream_definition(td.id)
+            j = self._get_junction(td.id, define=sd)
+            self.trigger_runtimes.append(TriggerRuntime(td, j, ctx))
+        # aggregations
+        from .aggregation import AggregationRuntime
+        for ad in app.aggregation_definitions.values():
+            ctx.aggregations[ad.id] = AggregationRuntime(ad, ctx, self._stream_defs())
+        # queries & partitions in definition order
+        q_count = 0
+        for element in app.execution_elements:
+            if isinstance(element, Query):
+                q_count += 1
+                name = element.name() or f"query-{q_count}"
+                rt = build_query_runtime(
+                    element, ctx, self._stream_defs(), self._get_junction, name)
+                self.query_runtimes[name] = rt
+                for sid, receiver in rt.subscriptions:
+                    if sid in ctx.named_windows:
+                        ctx.named_windows[sid].subscribe(receiver)
+                    elif sid in ctx.aggregations:
+                        raise SiddhiAppCreationError(
+                            "aggregations are queried via joins/on-demand")
+                    else:
+                        self._get_junction(sid).subscribe(receiver)
+                self._fill_implicit(element, rt)
+            elif isinstance(element, Partition):
+                q_count += 1
+                name = f"partition-{q_count}"
+                prt = PartitionRuntime(element, ctx, self._stream_defs(),
+                                       lambda sid, inner=False: self._get_junction(sid),
+                                       name)
+                # pre-fill implicit defs for partition outputs
+                prt.subscribe_all(lambda sid, inner=False: self._get_junction(sid))
+                self.partition_runtimes.append(prt)
+        # sources & sinks from stream annotations
+        self._wire_io()
+
+    def _stream_defs(self) -> dict:
+        defs = dict(self.app.stream_definitions)
+        for sid, j in self.ctx.stream_junctions.items():
+            defs.setdefault(sid, j.definition)
+        return defs
+
+    def _get_junction(self, stream_id: str, inner: bool = False,
+                      define: Optional[StreamDefinition] = None) -> StreamJunction:
+        j = self.ctx.stream_junctions.get(stream_id)
+        if j is None:
+            d = define or self.app.stream_definitions.get(stream_id) \
+                or StreamDefinition(stream_id)
+            j = StreamJunction(d, self.ctx)
+            self.ctx.stream_junctions[stream_id] = j
+        elif define is not None and not j.definition.attributes:
+            j.definition = define
+        return j
+
+    def _fill_implicit(self, query: Query, rt: QueryRuntime) -> None:
+        from ..query_api import InsertIntoStream
+        os = query.output_stream
+        if isinstance(os, InsertIntoStream):
+            j = self.ctx.stream_junctions.get(os.target_id)
+            if j is not None and not j.definition.attributes:
+                names, types = rt.output_schema
+                d = StreamDefinition(os.target_id)
+                for n, t in zip(names, types):
+                    d.attribute(n, t)
+                j.definition = d
+
+    def _wire_io(self) -> None:
+        ctx = self.ctx
+        for sd in self.app.stream_definitions.values():
+            sources, sinks = parse_io_annotations(sd)
+            for s in sources:
+                cls = SOURCES.get(s["type"]) or \
+                    ctx.siddhi_context.extensions.get(f"source:{s['type']}")
+                if cls is None:
+                    raise SiddhiAppCreationError(f"unknown source type '{s['type']}'")
+                mapper_cls = SOURCE_MAPPERS.get(s["map"]) or \
+                    ctx.siddhi_context.extensions.get(f"sourceMapper:{s['map']}")
+                mapper = mapper_cls()
+                mapper.init(sd, s["options"])
+                src = cls()
+                handler = self._make_source_handler(sd.id, mapper)
+                src.init(sd, s["options"], mapper, handler)
+                self.sources.append(src)
+            for s in sinks:
+                cls = SINKS.get(s["type"]) or \
+                    ctx.siddhi_context.extensions.get(f"sink:{s['type']}")
+                if cls is None:
+                    raise SiddhiAppCreationError(f"unknown sink type '{s['type']}'")
+                mapper_cls = SINK_MAPPERS.get(s["map"]) or \
+                    ctx.siddhi_context.extensions.get(f"sinkMapper:{s['map']}")
+                mapper = mapper_cls()
+                mapper.init(sd, s["options"])
+                sink = cls()
+                sink.init(sd, s["options"], mapper)
+                self.sinks.append(sink)
+                cb = StreamCallback(lambda events, sk=sink: [
+                    sk.on_event(e) for e in events])
+                self.add_callback(sd.id, cb)
+
+    def _make_source_handler(self, stream_id: str, mapper):
+        def handler(payload):
+            ih = self.input_handler(stream_id)
+            for row in mapper.map(payload):
+                ih.send(row)
+        return handler
+
+    # -------------------------------------------------------------- public API
+    def input_handler(self, stream_id: str) -> InputHandler:
+        ih = self.input_handlers.get(stream_id)
+        if ih is None:
+            if stream_id not in self.ctx.stream_junctions:
+                raise KeyError(f"stream '{stream_id}' is not defined")
+            ih = InputHandler(stream_id, self.ctx.stream_junctions[stream_id], self.ctx)
+            self.input_handlers[stream_id] = ih
+        return ih
+
+    # reference-style alias
+    getInputHandler = input_handler
+
+    def add_callback(self, stream_id: str, callback: StreamCallback) -> None:
+        if stream_id not in self.ctx.stream_junctions:
+            raise KeyError(f"stream '{stream_id}' is not defined")
+        self.ctx.stream_junctions[stream_id].subscribe(
+            _StreamCallbackReceiver(callback))
+
+    def add_query_callback(self, query_name: str, callback: QueryCallback) -> None:
+        rt = self.query_runtimes.get(query_name)
+        if rt is not None:
+            rt.add_callback(callback)
+            return
+        for prt in self.partition_runtimes:
+            for q in prt.partition_ast.queries:
+                if q.name() == query_name:
+                    prt.add_query_callback(query_name, callback)
+                    return
+        raise KeyError(f"no query named '{query_name}'")
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        for rt in self.query_runtimes.values():
+            rt.start()
+        for tr in self.trigger_runtimes:
+            tr.start()
+        for src in self.sources:
+            src.connect_with_retry()
+        if not self.ctx.timestamp_generator.playback:
+            self.ctx.ticker = SystemTicker(self.ctx.scheduler)
+            self.ctx.ticker.start()
+
+    def shutdown(self) -> None:
+        for src in self.sources:
+            src.disconnect()
+        for sink in self.sinks:
+            sink.disconnect()
+        if self.ctx.ticker is not None:
+            self.ctx.ticker.stop()
+        self._started = False
+
+    # -- time (playback) ------------------------------------------------------
+    def advance_time(self, ts: int) -> None:
+        """Advance the playback clock (fires due timers) without an event."""
+        self.ctx.advance_time(ts)
+
+    # -- snapshots ------------------------------------------------------------
+    def snapshot(self) -> bytes:
+        return self.snapshot_service.full_snapshot()
+
+    def restore(self, blob: bytes) -> None:
+        self.snapshot_service.restore(blob)
+
+    def persist(self) -> str:
+        return self.persistence.persist()
+
+    def restore_revision(self, revision: str) -> None:
+        self.persistence.restore_revision(revision)
+
+    def restore_last_revision(self) -> Optional[str]:
+        return self.persistence.restore_last_revision()
+
+    def clear_all_revisions(self) -> None:
+        self.persistence.clear_all_revisions()
+
+    # -- on-demand queries ----------------------------------------------------
+    def query(self, text: str) -> list[Event]:
+        rt = self._ondemand_cache.get(text)
+        if rt is None:
+            odq = parse_on_demand_query(text)
+            rt = OnDemandQueryRuntime(odq, self.ctx)
+            if len(self._ondemand_cache) > 100:
+                self._ondemand_cache.clear()
+            self._ondemand_cache[text] = rt
+        return rt.execute()
+
+    # -- stats / errors -------------------------------------------------------
+    def set_statistics_level(self, level: Level) -> None:
+        self.ctx.statistics_manager.set_level(level)
+
+    def set_exception_listener(self, listener) -> None:
+        self.ctx.exception_listener = listener

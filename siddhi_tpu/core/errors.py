@@ -1,0 +1,74 @@
+"""Exception types + error store.
+
+Reference: ``core/exception/`` (23 typed exceptions) and
+``util/error/handler/store/ErrorStore.java`` — failed events persisted for replay.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+class SiddhiAppCreationError(Exception):
+    pass
+
+
+class SiddhiAppRuntimeError(Exception):
+    pass
+
+
+class DefinitionNotExistError(SiddhiAppCreationError):
+    pass
+
+
+class QueryableRecordTableError(SiddhiAppRuntimeError):
+    pass
+
+
+class CannotRestoreStateError(SiddhiAppRuntimeError):
+    pass
+
+
+@dataclass
+class ErrorEntry:
+    id: int
+    timestamp: int
+    app_name: str
+    stream_name: str
+    event_data: Any
+    error: str
+    occurrence: str = "before"
+
+
+class ErrorStore:
+    """In-memory error store (reference ``ErrorStore`` abstract, saveEntry:160)."""
+
+    def __init__(self, capacity: int = 10000):
+        self.capacity = capacity
+        self.entries: list[ErrorEntry] = []
+        self._next_id = 1
+
+    def save(self, app_name: str, stream_name: str, event, error: Exception) -> None:
+        entry = ErrorEntry(
+            id=self._next_id,
+            timestamp=int(time.time() * 1000),
+            app_name=app_name,
+            stream_name=stream_name,
+            event_data=list(getattr(event, "data", []) or []),
+            error=repr(error),
+        )
+        self._next_id += 1
+        self.entries.append(entry)
+        if len(self.entries) > self.capacity:
+            self.entries.pop(0)
+
+    def load(self, app_name: str, stream_name: Optional[str] = None) -> list[ErrorEntry]:
+        return [
+            e for e in self.entries
+            if e.app_name == app_name and (stream_name is None or e.stream_name == stream_name)
+        ]
+
+    def discard(self, entry_id: int) -> None:
+        self.entries = [e for e in self.entries if e.id != entry_id]

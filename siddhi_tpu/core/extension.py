@@ -1,0 +1,103 @@
+"""Extension registry: the ``@extension`` decorator ≈ the reference's ``@Extension``
+annotation + ``SiddhiExtensionLoader`` (annotation-scanned classpath loading,
+``util/SiddhiExtensionLoader.java:99``). Python entry points replace classpath
+scanning; kinds mirror the reference's extension types.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..query_api.definition import DataType, StreamDefinition
+
+GLOBAL_EXTENSIONS: dict[str, type] = {}
+
+EXTENSION_KINDS = {
+    "function",          # scalar function (FunctionExecutor)
+    "aggregator",        # attribute aggregator
+    "window",            # window processor
+    "stream_function",   # stream processor / stream function
+    "source", "sink", "source_mapper", "sink_mapper", "store",
+}
+
+
+def extension(name: str, kind: str = "function"):
+    """Class decorator: ``@extension("str:concat", kind="function")``."""
+    if kind not in EXTENSION_KINDS:
+        raise ValueError(f"unknown extension kind '{kind}'")
+
+    def deco(cls):
+        cls.extension_kind = kind
+        cls.extension_name = name
+        GLOBAL_EXTENSIONS[name] = cls
+        return cls
+
+    return deco
+
+
+class ScalarFunctionExtension:
+    """Base for scalar function extensions.
+
+    Subclasses implement ``execute(args) -> value`` and set ``return_type``.
+    """
+
+    extension_kind = "function"
+    return_type: DataType = DataType.OBJECT
+
+    def execute(self, args: list) -> Any:
+        raise NotImplementedError
+
+    def bind(self, arg_fns: list[Callable], arg_types: list[DataType]):
+        def run(frame):
+            return self.execute([fn(frame) for fn in arg_fns])
+        return run, self.return_type
+
+
+class StreamFunctionExtension:
+    """Base for stream functions: N input attrs → appended output attrs.
+
+    ``init`` returns the output StreamDefinition; ``process`` returns payload
+    rows (input data + appended values).
+    """
+
+    extension_kind = "stream_function"
+
+    def init(self, input_def: StreamDefinition, params, param_fns) -> StreamDefinition:
+        raise NotImplementedError
+
+    def process(self, event, param_values: list):
+        raise NotImplementedError
+
+
+class ScriptFunction:
+    """``define function f[lang] return type { body }`` — script-language UDF.
+
+    Supported languages: ``python`` (body is an expression or function body using
+    ``data`` — the argument list). JavaScript bodies are not executable without a
+    JS engine; defining them raises at build time (reference parity would need
+    Nashorn/GraalJS).
+    """
+
+    def __init__(self, fid: str, language: str, return_type: DataType, body: str):
+        self.id = fid
+        self.language = language.lower()
+        self.return_type = return_type
+        self.body = body
+        if self.language not in ("python", "py"):
+            raise ValueError(
+                f"script language '{language}' not supported (use python)")
+        src = body.strip()
+        ns: dict[str, Any] = {}
+        try:
+            code = compile(src, f"<function {fid}>", "eval")
+            self._fn = lambda data: eval(code, {"__builtins__": {}}, {"data": data})  # noqa: S307
+        except SyntaxError:
+            indented = "\n".join("    " + line for line in src.splitlines())
+            exec(compile(f"def __udf__(data):\n{indented}\n",  # noqa: S102
+                         f"<function {fid}>", "exec"), ns)
+            self._fn = ns["__udf__"]
+
+    def bind(self, arg_fns: list[Callable], arg_types: list[DataType]):
+        def run(frame):
+            return self._fn([fn(frame) for fn in arg_fns])
+        return run, self.return_type

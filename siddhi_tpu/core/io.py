@@ -1,0 +1,247 @@
+"""Sources, sinks, mappers, and the in-memory broker.
+
+Reference: ``core/stream/input/source/`` (``Source.java`` with connect/retry,
+``SourceMapper``), ``core/stream/output/sink/`` (``Sink.java``, ``SinkMapper``,
+``LogSink``, ``InMemorySink``), ``core/util/transport/InMemoryBroker.java``.
+Transports are host-side by design — on TPU they feed the batching ingress.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ..query_api.annotation import Annotation
+from ..query_api.definition import StreamDefinition
+from .event import Event
+
+log = logging.getLogger("siddhi_tpu.io")
+
+
+# ---------------------------------------------------------------------------
+# In-memory broker (static topic pub/sub, test transport)
+# ---------------------------------------------------------------------------
+
+class InMemoryBroker:
+    _topics: dict[str, list[Callable[[Any], None]]] = {}
+    _lock = threading.RLock()
+
+    @classmethod
+    def subscribe(cls, topic: str, receiver: Callable[[Any], None]) -> Callable[[], None]:
+        with cls._lock:
+            cls._topics.setdefault(topic, []).append(receiver)
+
+        def unsubscribe():
+            with cls._lock:
+                if receiver in cls._topics.get(topic, []):
+                    cls._topics[topic].remove(receiver)
+
+        return unsubscribe
+
+    @classmethod
+    def publish(cls, topic: str, payload: Any) -> None:
+        with cls._lock:
+            receivers = list(cls._topics.get(topic, []))
+        for r in receivers:
+            r(payload)
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._topics.clear()
+
+
+# ---------------------------------------------------------------------------
+# Mappers
+# ---------------------------------------------------------------------------
+
+class SourceMapper:
+    """payload → list of event payload lists."""
+
+    def init(self, definition: StreamDefinition, options: dict) -> None:
+        self.definition = definition
+        self.options = options
+
+    def map(self, payload: Any) -> list[list]:
+        raise NotImplementedError
+
+
+class PassThroughSourceMapper(SourceMapper):
+    def map(self, payload: Any) -> list[list]:
+        if isinstance(payload, Event):
+            return [list(payload.data)]
+        if isinstance(payload, (list, tuple)):
+            if payload and isinstance(payload[0], (list, tuple, Event)):
+                return [list(p.data) if isinstance(p, Event) else list(p)
+                        for p in payload]
+            return [list(payload)]
+        raise ValueError(f"passThrough cannot map {type(payload).__name__}")
+
+
+class JsonSourceMapper(SourceMapper):
+    def map(self, payload: Any) -> list[list]:
+        obj = json.loads(payload) if isinstance(payload, (str, bytes)) else payload
+        events = obj if isinstance(obj, list) else [obj]
+        out = []
+        for e in events:
+            if isinstance(e, dict):
+                body = e.get("event", e)
+                out.append([body.get(a.name) for a in self.definition.attributes])
+            else:
+                out.append(list(e))
+        return out
+
+
+class SinkMapper:
+    def init(self, definition: StreamDefinition, options: dict) -> None:
+        self.definition = definition
+        self.options = options
+
+    def map(self, event: Event) -> Any:
+        raise NotImplementedError
+
+
+class PassThroughSinkMapper(SinkMapper):
+    def map(self, event: Event) -> Any:
+        return event
+
+
+class JsonSinkMapper(SinkMapper):
+    def map(self, event: Event) -> Any:
+        return json.dumps({
+            "event": {a.name: v for a, v in zip(self.definition.attributes, event.data)}
+        })
+
+
+class TextSinkMapper(SinkMapper):
+    def map(self, event: Event) -> Any:
+        return ", ".join(
+            f"{a.name}:{v}" for a, v in zip(self.definition.attributes, event.data))
+
+
+SOURCE_MAPPERS = {
+    "passThrough": PassThroughSourceMapper,
+    "json": JsonSourceMapper,
+}
+SINK_MAPPERS = {
+    "passThrough": PassThroughSinkMapper,
+    "json": JsonSinkMapper,
+    "text": TextSinkMapper,
+}
+
+
+# ---------------------------------------------------------------------------
+# Source / Sink SPI
+# ---------------------------------------------------------------------------
+
+class ConnectionUnavailableError(Exception):
+    pass
+
+
+class Source:
+    """Transport-agnostic ingress (reference ``Source.java:50``).
+
+    Subclasses implement connect/disconnect and call ``self.handler(payload)``.
+    ``connect_with_retry`` applies exponential backoff like the reference
+    (``connectWithRetry:155``).
+    """
+
+    extension_kind = "source"
+    RETRY_DELAYS = [0.1, 0.5, 1.0, 5.0]
+
+    def init(self, definition: StreamDefinition, options: dict,
+             mapper: SourceMapper, handler: Callable[[Any], None]) -> None:
+        self.definition = definition
+        self.options = options
+        self.mapper = mapper
+        self.handler = handler
+
+    def connect(self) -> None:
+        raise NotImplementedError
+
+    def disconnect(self) -> None:
+        pass
+
+    def pause(self) -> None:
+        pass
+
+    def resume(self) -> None:
+        pass
+
+    def connect_with_retry(self) -> None:
+        for i, delay in enumerate([0.0] + self.RETRY_DELAYS):
+            if delay:
+                time.sleep(delay)
+            try:
+                self.connect()
+                return
+            except ConnectionUnavailableError as e:
+                log.warning("source connect failed (attempt %d): %s", i + 1, e)
+        raise ConnectionUnavailableError(
+            f"source for stream '{self.definition.id}' could not connect")
+
+
+class InMemorySource(Source):
+    def connect(self) -> None:
+        topic = self.options.get("topic")
+        if topic is None:
+            raise ValueError("inMemory source needs topic")
+        self._unsub = InMemoryBroker.subscribe(topic, self.handler)
+
+    def disconnect(self) -> None:
+        if hasattr(self, "_unsub"):
+            self._unsub()
+
+
+class Sink:
+    extension_kind = "sink"
+
+    def init(self, definition: StreamDefinition, options: dict,
+             mapper: SinkMapper) -> None:
+        self.definition = definition
+        self.options = options
+        self.mapper = mapper
+
+    def connect(self) -> None:
+        pass
+
+    def disconnect(self) -> None:
+        pass
+
+    def publish(self, payload: Any) -> None:
+        raise NotImplementedError
+
+    def on_event(self, event: Event) -> None:
+        self.publish(self.mapper.map(event))
+
+
+class InMemorySink(Sink):
+    def publish(self, payload: Any) -> None:
+        InMemoryBroker.publish(self.options["topic"], payload)
+
+
+class LogSink(Sink):
+    def publish(self, payload: Any) -> None:
+        prefix = self.options.get("prefix", self.definition.id)
+        log.info("%s : %s", prefix, payload)
+
+
+SOURCES = {"inMemory": InMemorySource}
+SINKS = {"inMemory": InMemorySink, "log": LogSink}
+
+
+def parse_io_annotations(definition: StreamDefinition):
+    """Extract (@source, @sink) configs from a stream definition's annotations."""
+    sources, sinks = [], []
+    for ann in definition.annotations:
+        low = ann.name.lower()
+        if low in ("source", "sink"):
+            opts = {e.key: e.value for e in ann.elements if e.key}
+            map_ann = ann.nested("map")
+            map_type = map_ann.get("type") if map_ann else "passThrough"
+            entry = {"type": opts.get("type"), "options": opts, "map": map_type}
+            (sources if low == "source" else sinks).append(entry)
+    return sources, sinks

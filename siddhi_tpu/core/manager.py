@@ -1,0 +1,55 @@
+"""SiddhiManager: engine façade.
+
+Reference: ``core/SiddhiManager.java`` — extension registry, persistence store,
+app lifecycle, ``createSiddhiAppRuntime`` (parse → build).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from ..compiler import parse as _parse, update_variables
+from ..query_api import SiddhiApp
+from .app_runtime import SiddhiAppRuntime
+from .context import SiddhiContext
+from .errors import ErrorStore
+from .extension import GLOBAL_EXTENSIONS
+from .snapshot import PersistenceStore
+
+
+class SiddhiManager:
+    def __init__(self):
+        self.context = SiddhiContext()
+        self.context.extensions.update(GLOBAL_EXTENSIONS)
+        self.context.error_store = ErrorStore()
+        self.runtimes: dict[str, SiddhiAppRuntime] = {}
+
+    def create_siddhi_app_runtime(
+            self, app: Union[str, SiddhiApp],
+            playback: Optional[bool] = None,
+            start_time: int = 0,
+            env: Optional[dict] = None) -> SiddhiAppRuntime:
+        if isinstance(app, str):
+            app = _parse(update_variables(app, env) if "${" in app else app)
+        runtime = SiddhiAppRuntime(app, self.context, playback, start_time)
+        self.runtimes[runtime.name] = runtime
+        return runtime
+
+    # reference-style alias
+    createSiddhiAppRuntime = create_siddhi_app_runtime
+
+    def set_extension(self, name: str, cls: type) -> None:
+        self.context.extensions[name] = cls
+
+    def set_persistence_store(self, store: PersistenceStore) -> None:
+        self.context.persistence_store = store
+        for rt in self.runtimes.values():
+            rt.persistence.store = store
+
+    def get_siddhi_app_runtime(self, name: str) -> Optional[SiddhiAppRuntime]:
+        return self.runtimes.get(name)
+
+    def shutdown(self) -> None:
+        for rt in self.runtimes.values():
+            rt.shutdown()
+        self.runtimes.clear()

@@ -1,0 +1,72 @@
+"""Statistics: throughput / latency trackers with runtime on/off levels.
+
+Reference: ``core/util/statistics/`` SPI + ``metrics/`` Dropwizard impl
+(``SiddhiStatisticsManager.java``, ``Level.java`` OFF/BASIC/DETAIL).
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from typing import Optional
+
+
+class Level(enum.Enum):
+    OFF = 0
+    BASIC = 1
+    DETAIL = 2
+
+
+class ThroughputTracker:
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+
+    def event_in(self, n: int = 1) -> None:
+        self.count += n
+
+
+class LatencyTracker:
+    def __init__(self, name: str):
+        self.name = name
+        self.total_ns = 0
+        self.count = 0
+        self._start: Optional[int] = None
+
+    def mark_in(self) -> None:
+        self._start = time.perf_counter_ns()
+
+    def mark_out(self) -> None:
+        if self._start is not None:
+            self.total_ns += time.perf_counter_ns() - self._start
+            self.count += 1
+            self._start = None
+
+    @property
+    def avg_ms(self) -> float:
+        return (self.total_ns / self.count) / 1e6 if self.count else 0.0
+
+
+class StatisticsManager:
+    def __init__(self, app_name: str):
+        self.app_name = app_name
+        self.level = Level.OFF
+        self.throughput: dict[str, ThroughputTracker] = {}
+        self.latency: dict[str, LatencyTracker] = {}
+
+    def throughput_tracker(self, name: str) -> ThroughputTracker:
+        return self.throughput.setdefault(name, ThroughputTracker(name))
+
+    def latency_tracker(self, name: str) -> LatencyTracker:
+        return self.latency.setdefault(name, LatencyTracker(name))
+
+    def set_level(self, level: Level) -> None:
+        self.level = level
+
+    def report(self) -> dict:
+        return {
+            "app": self.app_name,
+            "level": self.level.name,
+            "throughput": {k: v.count for k, v in self.throughput.items()},
+            "latency_avg_ms": {k: v.avg_ms for k, v in self.latency.items()},
+        }

@@ -1,0 +1,446 @@
+"""Query planner: AST → processor chains.
+
+Reference: ``core/util/parser/`` — ``QueryParser.parse`` (QueryParser.java:90),
+``SingleInputStreamParser`` (per-stream chains), ``StateInputStreamParser`` (NFA),
+``JoinInputStreamParser``, ``SelectorParser``, ``OutputParser``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..query_api import (
+    Constant,
+    DataType,
+    DeleteStream,
+    EventTrigger,
+    Filter,
+    InsertIntoStream,
+    JoinInputStream,
+    OutputEventsFor,
+    Query,
+    ReturnStream,
+    SingleInputStream,
+    StateInputStream,
+    StreamFunction,
+    UpdateOrInsertStream,
+    UpdateStream,
+    Variable,
+    Window,
+)
+from ..query_api.definition import StreamDefinition
+from .event import EventType, StreamEvent
+from .executor import (
+    ExecutorBuilder,
+    JoinResolver,
+    StateResolver,
+    StreamFrame,
+    StreamResolver,
+)
+from .join import JoinRuntime, JoinSide
+from .named_window import NamedWindow
+from .output import (
+    DeleteTableCallback,
+    FanoutProcessor,
+    InsertIntoStreamCallback,
+    InsertIntoTableCallback,
+    InsertIntoWindowCallback,
+    QueryCallbackAdapter,
+    UpdateOrInsertTableCallback,
+    UpdateTableCallback,
+)
+from .pattern import CompiledPattern, PatternCompiler, PatternRuntime
+from .processors import FilterProcessor, Processor, SinkProcessor
+from .ratelimit import build_rate_limiter
+from .selector import build_selector
+from .table import compile_table_condition
+from . import windows as W
+
+
+class QueryBuildError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Window factory
+# ---------------------------------------------------------------------------
+
+def _const(p, what: str):
+    if not isinstance(p, Constant):
+        raise QueryBuildError(f"{what} expects constant parameter")
+    return p.value
+
+
+def make_window_processor(win: Window, definition: StreamDefinition,
+                          app_context, query_ctx_id: str) -> W.WindowProcessor:
+    """Instantiate a window processor from its AST node.
+
+    Reference catalog: ``query/processor/stream/window/*WindowProcessor``.
+    """
+    name = win.name
+    params = win.params
+    builder = ExecutorBuilder(StreamResolver(definition), app_context)
+
+    def executor(i):
+        return builder.build(params[i])[0]
+
+    if name == "length":
+        proc = W.LengthWindow(int(_const(params[0], "length")))
+    elif name == "lengthBatch":
+        proc = W.LengthBatchWindow(int(_const(params[0], "lengthBatch")))
+    elif name == "time":
+        proc = W.TimeWindow(int(_const(params[0], "time")))
+    elif name == "timeBatch":
+        start = int(_const(params[1], "timeBatch")) if len(params) > 1 else None
+        proc = W.TimeBatchWindow(int(_const(params[0], "timeBatch")), start)
+    elif name == "timeLength":
+        proc = W.TimeLengthWindow(int(_const(params[0], "timeLength")),
+                                  int(_const(params[1], "timeLength")))
+    elif name == "externalTime":
+        proc = W.ExternalTimeWindow(executor(0), int(_const(params[1], "externalTime")))
+    elif name == "externalTimeBatch":
+        start = int(_const(params[2], "externalTimeBatch")) if len(params) > 2 else None
+        proc = W.ExternalTimeBatchWindow(
+            executor(0), int(_const(params[1], "externalTimeBatch")), start)
+    elif name == "session":
+        gap = int(_const(params[0], "session"))
+        key_fn = executor(1) if len(params) > 1 else None
+        latency = int(_const(params[2], "session")) if len(params) > 2 else 0
+        proc = W.SessionWindow(gap, key_fn, latency)
+    elif name == "batch":
+        proc = W.BatchWindow()
+    elif name == "delay":
+        proc = W.DelayWindow(int(_const(params[0], "delay")))
+    elif name == "sort":
+        n = int(_const(params[0], "sort"))
+        key_fns, orders = [], []
+        i = 1
+        while i < len(params):
+            key_fns.append(builder.build(params[i])[0])
+            i += 1
+            if i < len(params) and isinstance(params[i], Constant) \
+                    and str(params[i].value).lower() in ("asc", "desc"):
+                orders.append(str(params[i].value).lower())
+                i += 1
+            else:
+                orders.append("asc")
+        proc = W.SortWindow(n, key_fns, orders)
+    elif name == "frequent":
+        n = int(_const(params[0], "frequent"))
+        key_fns = [builder.build(p)[0] for p in params[1:]] or None
+        proc = W.FrequentWindow(n, key_fns)
+    elif name == "lossyFrequent":
+        support = float(_const(params[0], "lossyFrequent"))
+        error = float(_const(params[1], "lossyFrequent")) if len(params) > 1 and \
+            isinstance(params[1], Constant) and not isinstance(params[1].value, str) else None
+        key_start = 2 if error is not None else 1
+        key_fns = [builder.build(p)[0] for p in params[key_start:]] or None
+        proc = W.LossyFrequentWindow(support, error, key_fns)
+    elif name == "cron":
+        proc = W.CronWindow(str(_const(params[0], "cron")))
+    elif name == "hopping":
+        proc = W.HoppingWindow(int(_const(params[0], "hopping")),
+                               int(_const(params[1], "hopping")))
+    elif name == "":
+        proc = W.EmptyWindow()
+    else:
+        # extension windows
+        ext = app_context.siddhi_context.extensions.get(f"window:{name}")
+        if ext is None:
+            raise QueryBuildError(f"unknown window type '{name}'")
+        proc = ext(params, definition, app_context)
+    proc.setup(app_context, app_context.element_id(f"{query_ctx_id}-window-{name}"))
+    return proc
+
+
+# ---------------------------------------------------------------------------
+# Stream function factory
+# ---------------------------------------------------------------------------
+
+def make_stream_function(sf: StreamFunction, definition: StreamDefinition,
+                         app_context):
+    """Returns (processor, output_definition)."""
+    key = f"{sf.namespace}:{sf.name}" if sf.namespace else sf.name
+    ext = app_context.siddhi_context.extensions.get(key)
+    if ext is None or getattr(ext, "extension_kind", None) != "stream_function":
+        raise QueryBuildError(f"unknown stream function '{key}'")
+    inst = ext()
+    builder = ExecutorBuilder(StreamResolver(definition), app_context)
+    param_fns = [builder.build(p)[0] for p in sf.params]
+    out_def = inst.init(definition, sf.params, param_fns)
+    from .processors import StreamFunctionProcessor
+
+    def fn(ev: StreamEvent):
+        return inst.process(ev, [p(StreamFrame(ev)) for p in param_fns])
+
+    return StreamFunctionProcessor(fn), out_def
+
+
+# ---------------------------------------------------------------------------
+# Single-stream chain
+# ---------------------------------------------------------------------------
+
+class StreamReceiver:
+    """Junction subscriber feeding a query's processor chain."""
+
+    def __init__(self, head: Processor):
+        self.head = head
+
+    def receive(self, event: StreamEvent) -> None:
+        self.head.process([event])
+
+    def receive_chunk(self, events: list[StreamEvent]) -> None:
+        self.head.process(list(events))
+
+
+class _ChainHead(Processor):
+    def process(self, events):
+        self.forward(events)
+
+
+def build_single_chain(stream: SingleInputStream, definition: StreamDefinition,
+                       app_context, query_id: str):
+    """Build filter/window/function chain. Returns (head, tail, effective_def,
+    window_processor_or_None)."""
+    head = _ChainHead()
+    tail: Processor = head
+    eff_def = definition
+    window_proc = None
+    for h in stream.handlers:
+        if isinstance(h, Filter):
+            builder = ExecutorBuilder(StreamResolver(eff_def), app_context)
+            cond, _ = builder.build(h.expr)
+            tail = tail.set_next(FilterProcessor(cond))
+        elif isinstance(h, Window):
+            window_proc = make_window_processor(h, eff_def, app_context, query_id)
+            tail = tail.set_next(window_proc)
+        elif isinstance(h, StreamFunction):
+            proc, eff_def = make_stream_function(h, eff_def, app_context)
+            tail = tail.set_next(proc)
+    return head, tail, eff_def, window_proc
+
+
+# ---------------------------------------------------------------------------
+# QueryRuntime
+# ---------------------------------------------------------------------------
+
+class QueryRuntime:
+    def __init__(self, query: Query, name: str):
+        self.query = query
+        self.name = name
+        self.callback_adapter = QueryCallbackAdapter()
+        self.subscriptions: list[tuple[str, object]] = []   # (stream_id, receiver)
+        self.output_schema: tuple[list[str], list[DataType]] = ([], [])
+        self.pattern_runtime: Optional[PatternRuntime] = None
+
+    def add_callback(self, cb) -> None:
+        self.callback_adapter.callbacks.append(cb)
+
+    def start(self) -> None:
+        if self.pattern_runtime is not None:
+            self.pattern_runtime.start()
+
+
+def build_query_runtime(query: Query, app_context, stream_defs: dict,
+                        get_junction: Callable, name: str,
+                        inner_defs: Optional[dict] = None) -> QueryRuntime:
+    """Construct a QueryRuntime. ``get_junction(stream_id, inner)`` resolves
+    junctions (partition-local for inner streams)."""
+    rt = QueryRuntime(query, name)
+    qid = name
+    ist = query.input_stream
+
+    def stream_def(sid: str, inner: bool) -> StreamDefinition:
+        defs = inner_defs if inner and inner_defs is not None else stream_defs
+        if sid in app_context.named_windows:
+            return app_context.named_windows[sid].definition
+        if sid not in defs:
+            raise QueryBuildError(f"query '{name}': undefined stream '{sid}'")
+        return defs[sid]
+
+    # ---------------- input side -------------------------------------------
+    if isinstance(ist, SingleInputStream):
+        sid_eff = ("!" + ist.stream_id) if ist.is_fault_stream else ist.stream_id
+        d = stream_def(sid_eff, ist.is_inner_stream)
+        head, tail, eff_def, _ = build_single_chain(ist, d, app_context, qid)
+        selector_builder = ExecutorBuilder(StreamResolver(eff_def), app_context)
+        selector = build_selector(query.selector, selector_builder,
+                                  eff_def.attribute_names,
+                                  [a.type for a in eff_def.attributes],
+                                  app_context.element_id(f"{qid}-selector"))
+        app_context.register_state(selector.element_id, selector)
+        tail.set_next(_SelectorBridge(selector))
+        receiver = StreamReceiver(head)
+        rt.subscriptions.append((sid_eff, receiver))
+
+    elif isinstance(ist, StateInputStream):
+        defs_for_pattern = dict(stream_defs)
+        compiler = PatternCompiler(ist, defs_for_pattern)
+        compiled = compiler.compile()
+        pattern_rt = PatternRuntime(
+            compiled, app_context, app_context.element_id(f"{qid}-pattern"))
+        rt.pattern_runtime = pattern_rt
+        resolver = StateResolver(compiled.alias_defs)
+        selector_builder = ExecutorBuilder(resolver, app_context)
+        # pattern output schema: alias attributes referenced via select
+        names, types = _selector_schema_from_alias(compiled)
+        selector = build_selector(query.selector, selector_builder, names, types,
+                                  app_context.element_id(f"{qid}-selector"))
+        app_context.register_state(selector.element_id, selector)
+        pattern_rt.next = selector
+        from .pattern import PatternStreamReceiver
+        for sid in compiled.stream_ids:
+            rt.subscriptions.append((sid, PatternStreamReceiver(pattern_rt, sid)))
+
+    elif isinstance(ist, JoinInputStream):
+        selector = _build_join(ist, rt, app_context, stream_defs, stream_def,
+                               query, qid)
+    else:
+        raise QueryBuildError(f"unsupported input stream {type(ist).__name__}")
+
+    # ---------------- output side ------------------------------------------
+    out_names = selector.output_names
+    out_types = selector.output_types
+    rt.output_schema = (out_names, out_types)
+
+    limiter = build_rate_limiter(query.output_rate, app_context)
+    app_context.register_state(app_context.element_id(f"{qid}-ratelimit"), limiter)
+    selector.next = limiter
+
+    targets: list = [rt.callback_adapter]
+    os = query.output_stream
+    if isinstance(os, InsertIntoStream):
+        if os.target_id in app_context.tables:
+            targets.append(InsertIntoTableCallback(
+                app_context.tables[os.target_id], os.events_for))
+        elif os.target_id in app_context.named_windows:
+            targets.append(InsertIntoWindowCallback(
+                app_context.named_windows[os.target_id], os.events_for))
+        else:
+            junction = get_junction(os.target_id, os.is_inner_stream)
+            targets.append(InsertIntoStreamCallback(junction, os.events_for))
+    elif isinstance(os, DeleteStream):
+        table = app_context.get_table(os.target_id)
+        cond = compile_table_condition(table, os.on_condition, out_names,
+                                       out_types, app_context)
+        targets.append(DeleteTableCallback(table, cond))
+    elif isinstance(os, (UpdateStream, UpdateOrInsertStream)):
+        table = app_context.get_table(os.target_id)
+        cond = compile_table_condition(table, os.on_condition, out_names,
+                                       out_types, app_context)
+        setters = _build_setters(os.set_attributes, table, out_names, out_types,
+                                 app_context)
+        cls = UpdateTableCallback if isinstance(os, UpdateStream) \
+            else UpdateOrInsertTableCallback
+        targets.append(cls(table, cond, setters))
+    elif isinstance(os, ReturnStream) or os is None:
+        pass
+    limiter.next = FanoutProcessor(targets)
+    return rt
+
+
+class _SelectorBridge(Processor):
+    def __init__(self, selector):
+        super().__init__()
+        self.selector = selector
+
+    def process(self, events):
+        self.selector.process(events)
+
+
+def _selector_schema_from_alias(compiled: CompiledPattern):
+    names: list[str] = []
+    types: list[DataType] = []
+    for alias, d in compiled.alias_defs.items():
+        for a in d.attributes:
+            if a.name not in names:
+                names.append(a.name)
+                types.append(a.type)
+    return names, types
+
+
+def _build_setters(set_attributes, table, out_names, out_types, app_context):
+    from .table import TableMatchResolver
+    resolver = TableMatchResolver(table.definition, out_names, out_types)
+    builder = ExecutorBuilder(resolver, app_context)
+    setters = []
+    for sa in set_attributes:
+        pos = table.definition.attribute_position(sa.table_variable.attribute)
+        fn, _ = builder.build(sa.value_expr)
+        setters.append((pos, fn))
+    if not setters:
+        # no SET clause: update every column from the matching event by name
+        for i, n in enumerate(out_names):
+            if n in table.definition.attribute_names:
+                pos = table.definition.attribute_position(n)
+                setters.append((pos, lambda f, i=i: f.out[i]))
+    return setters
+
+
+def _build_join(ist: JoinInputStream, rt: QueryRuntime, app_context,
+                stream_defs: dict, stream_def_fn, query: Query, qid: str):
+    sides = {}
+    for label, s in (("left", ist.left), ("right", ist.right)):
+        sid = s.stream_id
+        if sid in app_context.tables:
+            table = app_context.tables[sid]
+            sides[label] = {
+                "kind": "table", "def": table.definition, "ref": s.ref(),
+                "find": (lambda t=table: t.all_events()), "stream": s,
+            }
+        elif sid in app_context.named_windows:
+            nw = app_context.named_windows[sid]
+            sides[label] = {
+                "kind": "window", "def": nw.definition, "ref": s.ref(),
+                "find": nw.find_events, "stream": s,
+            }
+        else:
+            d = stream_def_fn(sid, s.is_inner_stream)
+            head, tail, eff_def, win = build_single_chain(s, d, app_context, qid)
+            if win is None:
+                win = W.EmptyWindow()
+                win.setup(app_context, app_context.element_id(f"{qid}-joinwin"))
+                tail = tail.set_next(win)
+            sides[label] = {
+                "kind": "stream", "def": eff_def, "ref": s.ref(),
+                "find": win.find_events, "stream": s, "head": head, "tail": tail,
+            }
+
+    resolver = JoinResolver(sides["left"]["ref"], sides["left"]["def"],
+                            sides["right"]["ref"], sides["right"]["def"])
+    builder = ExecutorBuilder(resolver, app_context)
+    cond_fn = None
+    if ist.on_condition is not None:
+        cond_fn, _ = builder.build(ist.on_condition)
+
+    within_ms = ist.within.value if ist.within is not None else None
+    jr = JoinRuntime(ist.join_type, ist.trigger, cond_fn,
+                     sides["left"]["find"], sides["right"]["find"], within_ms)
+
+    # selector over the combined schema
+    names = (sides["left"]["def"].attribute_names
+             + [n for n in sides["right"]["def"].attribute_names
+                if n not in sides["left"]["def"].attribute_names])
+    types = []
+    for n in names:
+        d = sides["left"]["def"] if n in sides["left"]["def"].attribute_names \
+            else sides["right"]["def"]
+        types.append(d.attribute_type(n))
+    selector = build_selector(query.selector, builder, names, types,
+                              app_context.element_id(f"{qid}-selector"))
+    app_context.register_state(selector.element_id, selector)
+    jr.next = selector
+
+    for label, is_left in (("left", True), ("right", False)):
+        side = sides[label]
+        if side["kind"] == "stream":
+            side["tail"].set_next(JoinSide(jr, is_left))
+            rt.subscriptions.append((side["stream"].stream_id,
+                                    StreamReceiver(side["head"])))
+        elif side["kind"] == "window":
+            nw = app_context.named_windows[side["stream"].stream_id]
+            bridge = _ChainHead()
+            bridge.set_next(JoinSide(jr, is_left))
+            nw.subscribe(StreamReceiver(bridge))
+        # table sides are passive: probed only
+    return selector

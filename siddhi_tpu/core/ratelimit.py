@@ -1,0 +1,171 @@
+"""Output rate limiters.
+
+Reference: ``core/query/output/ratelimit/`` — event/ (per-N-events), time/
+(per-period), snapshot/ (periodic state snapshot). Time-driven limiters use the
+deterministic Scheduler.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..query_api import (
+    EventOutputRate,
+    OutputRateType,
+    SnapshotOutputRate,
+    TimeOutputRate,
+)
+from .event import EventType, StreamEvent
+
+
+class PassThroughRateLimiter:
+    def __init__(self):
+        self.next = None
+
+    def process(self, events: list[StreamEvent]) -> None:
+        if self.next is not None and events:
+            self.next.process(events)
+
+    def snapshot_state(self) -> dict:
+        return {}
+
+    def restore_state(self, state: dict) -> None:
+        pass
+
+
+class EventRateLimiter(PassThroughRateLimiter):
+    """`output [all|first|last] every N events`."""
+
+    def __init__(self, n: int, mode: OutputRateType):
+        super().__init__()
+        self.n = n
+        self.mode = mode
+        self.counter = 0
+        self.pending: list[StreamEvent] = []
+        self.last: Optional[StreamEvent] = None
+
+    def process(self, events: list[StreamEvent]) -> None:
+        out: list[StreamEvent] = []
+        for ev in events:
+            self.counter += 1
+            if self.mode == OutputRateType.ALL:
+                self.pending.append(ev)
+                if self.counter == self.n:
+                    out.extend(self.pending)
+                    self.pending = []
+                    self.counter = 0
+            elif self.mode == OutputRateType.FIRST:
+                if self.counter == 1:
+                    out.append(ev)
+                if self.counter == self.n:
+                    self.counter = 0
+            else:  # LAST
+                self.last = ev
+                if self.counter == self.n:
+                    out.append(self.last)
+                    self.last = None
+                    self.counter = 0
+        if self.next is not None and out:
+            self.next.process(out)
+
+    def snapshot_state(self) -> dict:
+        enc = lambda e: (e.timestamp, list(e.data), e.type.value)  # noqa: E731
+        return {"counter": self.counter,
+                "pending": [enc(e) for e in self.pending],
+                "last": enc(self.last) if self.last is not None else None}
+
+    def restore_state(self, state: dict) -> None:
+        self.counter = state["counter"]
+        self.pending = [StreamEvent(t, d, EventType(ty)) for t, d, ty in state["pending"]]
+        self.last = StreamEvent(*state["last"][:2], EventType(state["last"][2])) \
+            if state.get("last") else None
+
+
+class TimeRateLimiter(PassThroughRateLimiter):
+    """`output [all|first|last] every <time>` — flush on scheduler ticks."""
+
+    def __init__(self, period_ms: int, mode: OutputRateType, app_context):
+        super().__init__()
+        self.period = period_ms
+        self.mode = mode
+        self.app_context = app_context
+        self.pending: list[StreamEvent] = []
+        self.first_sent = False
+        self.last: Optional[StreamEvent] = None
+        self.window_end: Optional[int] = None
+
+    def _arm(self, ts: int) -> None:
+        if self.window_end is None:
+            self.window_end = ts + self.period
+            self.app_context.scheduler.notify_at(self.window_end, self._on_timer)
+
+    def process(self, events: list[StreamEvent]) -> None:
+        out: list[StreamEvent] = []
+        for ev in events:
+            self._arm(ev.timestamp)
+            if self.mode == OutputRateType.ALL:
+                self.pending.append(ev)
+            elif self.mode == OutputRateType.FIRST:
+                if not self.first_sent:
+                    out.append(ev)
+                    self.first_sent = True
+            else:
+                self.last = ev
+        if self.next is not None and out:
+            self.next.process(out)
+
+    def _on_timer(self, ts: int) -> None:
+        out: list[StreamEvent] = []
+        if self.mode == OutputRateType.ALL:
+            out, self.pending = self.pending, []
+        elif self.mode == OutputRateType.FIRST:
+            self.first_sent = False
+        else:
+            if self.last is not None:
+                out = [self.last]
+                self.last = None
+        self.window_end = ts + self.period
+        self.app_context.scheduler.notify_at(self.window_end, self._on_timer)
+        if self.next is not None and out:
+            self.next.process(out)
+
+
+class SnapshotRateLimiter(PassThroughRateLimiter):
+    """`output snapshot every <time>` — emits the latest value (per group when the
+    output has repeating keys is approximated by last event) each period."""
+
+    def __init__(self, period_ms: int, app_context):
+        super().__init__()
+        self.period = period_ms
+        self.app_context = app_context
+        self.latest: Optional[StreamEvent] = None
+        self.window_end: Optional[int] = None
+
+    def process(self, events: list[StreamEvent]) -> None:
+        for ev in events:
+            if self.window_end is None:
+                self.window_end = ev.timestamp + self.period
+                self.app_context.scheduler.notify_at(self.window_end, self._on_timer)
+            if ev.type == EventType.CURRENT:
+                self.latest = ev
+
+    def _on_timer(self, ts: int) -> None:
+        out = []
+        if self.latest is not None:
+            out = [StreamEvent(ts, self.latest.data, EventType.CURRENT)]
+        self.window_end = ts + self.period
+        self.app_context.scheduler.notify_at(self.window_end, self._on_timer)
+        if self.next is not None and out:
+            self.next.process(out)
+
+
+def build_rate_limiter(output_rate, app_context):
+    if output_rate is None:
+        return PassThroughRateLimiter()
+    if isinstance(output_rate, EventOutputRate):
+        return EventRateLimiter(output_rate.value, output_rate.type)
+    if isinstance(output_rate, TimeOutputRate):
+        return TimeRateLimiter(output_rate.value_ms, output_rate.type, app_context)
+    if isinstance(output_rate, SnapshotOutputRate):
+        return SnapshotRateLimiter(output_rate.value_ms, app_context)
+    raise ValueError(f"unknown output rate {output_rate!r}")

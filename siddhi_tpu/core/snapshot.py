@@ -1,0 +1,143 @@
+"""Snapshot service & persistence stores — checkpoint/restore.
+
+Reference: ``core/util/snapshot/SnapshotService.java`` (fullSnapshot:90,
+restore:333), ``util/persistence/`` (in-memory + filesystem stores, revisions).
+Design: every stateful element registered in ``app_context.state_registry``
+exposes ``snapshot_state() -> dict`` / ``restore_state(dict)``; a full snapshot is
+the pickled map of all of them, taken under the app's root lock (the reference's
+ThreadBarrier quiesce). On the TPU path the same protocol serializes device
+pytrees fetched with ``jax.device_get``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from typing import Optional
+
+
+class SnapshotService:
+    def __init__(self, app_context):
+        self.app_context = app_context
+
+    def full_snapshot(self) -> bytes:
+        with self.app_context.root_lock:
+            states = {}
+            for element_id, holder in self.app_context.state_registry.items():
+                states[element_id] = holder.snapshot_state()
+            return pickle.dumps({
+                "app": self.app_context.name,
+                "states": states,
+                "time": self.app_context.current_time(),
+            })
+
+    def restore(self, blob: bytes) -> None:
+        data = pickle.loads(blob)
+        with self.app_context.root_lock:
+            for element_id, state in data["states"].items():
+                holder = self.app_context.state_registry.get(element_id)
+                if holder is not None:
+                    holder.restore_state(state)
+            if self.app_context.timestamp_generator.playback:
+                self.app_context.timestamp_generator.advance(data.get("time", 0))
+
+
+class PersistenceStore:
+    def save(self, app_name: str, revision: str, blob: bytes) -> None:
+        raise NotImplementedError
+
+    def load(self, app_name: str, revision: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def last_revision(self, app_name: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def clear_all_revisions(self, app_name: str) -> None:
+        raise NotImplementedError
+
+
+class InMemoryPersistenceStore(PersistenceStore):
+    def __init__(self):
+        self._store: dict[str, dict[str, bytes]] = {}
+
+    def save(self, app_name, revision, blob):
+        self._store.setdefault(app_name, {})[revision] = blob
+
+    def load(self, app_name, revision):
+        return self._store.get(app_name, {}).get(revision)
+
+    def last_revision(self, app_name):
+        revs = self._store.get(app_name)
+        if not revs:
+            return None
+        return sorted(revs)[-1]
+
+    def clear_all_revisions(self, app_name):
+        self._store.pop(app_name, None)
+
+
+class FileSystemPersistenceStore(PersistenceStore):
+    def __init__(self, base_dir: str):
+        self.base_dir = base_dir
+
+    def _dir(self, app_name: str) -> str:
+        d = os.path.join(self.base_dir, app_name)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def save(self, app_name, revision, blob):
+        with open(os.path.join(self._dir(app_name), revision), "wb") as f:
+            f.write(blob)
+
+    def load(self, app_name, revision):
+        path = os.path.join(self._dir(app_name), revision)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    def last_revision(self, app_name):
+        files = sorted(os.listdir(self._dir(app_name)))
+        return files[-1] if files else None
+
+    def clear_all_revisions(self, app_name):
+        d = self._dir(app_name)
+        for f in os.listdir(d):
+            os.remove(os.path.join(d, f))
+
+
+class PersistenceManager:
+    """persist()/restoreRevision()/restoreLastRevision() façade."""
+
+    def __init__(self, app_context, snapshot_service: SnapshotService,
+                 store: Optional[PersistenceStore]):
+        self.app_context = app_context
+        self.snapshot_service = snapshot_service
+        self.store = store
+        self._counter = 0
+
+    def persist(self) -> str:
+        if self.store is None:
+            raise RuntimeError("no persistence store configured")
+        self._counter += 1
+        revision = f"{int(time.time() * 1000)}_{self._counter:06d}"
+        blob = self.snapshot_service.full_snapshot()
+        self.store.save(self.app_context.name, revision, blob)
+        return revision
+
+    def restore_revision(self, revision: str) -> None:
+        blob = self.store.load(self.app_context.name, revision)
+        if blob is None:
+            raise KeyError(f"no revision {revision!r}")
+        self.snapshot_service.restore(blob)
+
+    def restore_last_revision(self) -> Optional[str]:
+        rev = self.store.last_revision(self.app_context.name)
+        if rev is not None:
+            self.restore_revision(rev)
+        return rev
+
+    def clear_all_revisions(self) -> None:
+        self.store.clear_all_revisions(self.app_context.name)

@@ -1,0 +1,153 @@
+"""Stream junctions, input handlers, callbacks — the event bus.
+
+Reference: ``core/stream/StreamJunction.java`` (pub/sub per stream, fault routing),
+``stream/input/InputHandler.java``, ``stream/output/StreamCallback.java``,
+``query/output/callback/QueryCallback.java``. The reference's optional LMAX
+Disruptor async mode is replaced by the TPU path's micro-batching ingress; the
+interpreter junction is synchronous and deterministic.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from ..query_api.definition import AbstractDefinition
+from .event import Event, EventType, StreamEvent
+
+log = logging.getLogger("siddhi_tpu.stream")
+
+
+class OnErrorAction:
+    LOG = "log"
+    STREAM = "stream"
+    STORE = "store"
+
+
+class StreamJunction:
+    """Per-stream event bus: receivers subscribe; publishers send."""
+
+    def __init__(self, definition: AbstractDefinition, app_context,
+                 on_error_action: str = OnErrorAction.LOG):
+        self.definition = definition
+        self.app_context = app_context
+        self.receivers: list = []          # objects with .receive(StreamEvent)
+        self.on_error_action = on_error_action
+        self.fault_junction: Optional["StreamJunction"] = None
+        self.throughput = 0
+
+    def subscribe(self, receiver) -> None:
+        if receiver not in self.receivers:
+            self.receivers.append(receiver)
+
+    def send_event(self, event: StreamEvent) -> None:
+        self.throughput += 1
+        try:
+            for r in self.receivers:
+                r.receive(event)
+        except Exception as e:  # noqa: BLE001 — boundary: route per @OnError
+            self.handle_error(event, e)
+
+    def send_events(self, events: list[StreamEvent]) -> None:
+        """Deliver a chunk, preserving batch identity for chunk-aware receivers
+        (``#window.batch()`` semantics depend on it)."""
+        if not events:
+            return
+        self.throughput += len(events)
+        try:
+            for r in self.receivers:
+                if hasattr(r, "receive_chunk"):
+                    r.receive_chunk(events)
+                else:
+                    for ev in events:
+                        r.receive(ev)
+        except Exception as e:  # noqa: BLE001
+            self.handle_error(events[-1], e)
+
+    def handle_error(self, event: StreamEvent, e: Exception) -> None:
+        if self.on_error_action == OnErrorAction.STREAM and self.fault_junction:
+            fault_ev = StreamEvent(
+                event.timestamp, list(event.data) + [str(e)], event.type
+            )
+            self.fault_junction.send_event(fault_ev)
+            return
+        if self.on_error_action == OnErrorAction.STORE:
+            store = getattr(self.app_context.siddhi_context, "error_store", None)
+            if store is not None:
+                store.save(self.app_context.name, self.definition.id, event, e)
+                return
+        listener = self.app_context.exception_listener
+        if listener is not None:
+            listener(e)
+        else:
+            log.error("error on stream '%s': %s", self.definition.id, e)
+            raise e
+
+
+class InputHandler:
+    """User-facing ingress for one stream (reference ``InputHandler.java``)."""
+
+    def __init__(self, stream_id: str, junction: StreamJunction, app_context):
+        self.stream_id = stream_id
+        self.junction = junction
+        self.app_context = app_context
+
+    def send(self, data, timestamp: Optional[int] = None) -> None:
+        """Accepts ``[a, b, c]``, ``Event``, or ``list[Event]``."""
+        with self.app_context.root_lock:
+            if isinstance(data, Event):
+                self._send_one(data.timestamp, data.data)
+            elif data and isinstance(data[0], Event):
+                for ev in data:
+                    self.app_context.advance_time(ev.timestamp)
+                self.junction.send_events([
+                    StreamEvent(ev.timestamp, list(ev.data), EventType.CURRENT)
+                    for ev in data
+                ])
+            else:
+                ts = timestamp if timestamp is not None else self.app_context.current_time()
+                self._send_one(ts, list(data))
+
+    def _send_one(self, ts: int, data: list) -> None:
+        # watermark: advance clock & fire due timers before the event itself
+        self.app_context.advance_time(ts)
+        self.junction.send_event(StreamEvent(ts, data, EventType.CURRENT))
+
+
+class StreamCallback:
+    """Subscribe to a stream's output events (subclass or wrap a function)."""
+
+    def __init__(self, fn: Optional[Callable[[list[Event]], None]] = None):
+        self._fn = fn
+
+    def receive(self, events: list[Event]) -> None:
+        if self._fn:
+            self._fn(events)
+
+    # junction receiver adapter
+    def receive_stream_event(self, event: StreamEvent) -> None:
+        self.receive([Event(event.timestamp, event.data,
+                            event.type == EventType.EXPIRED)])
+
+
+class _StreamCallbackReceiver:
+    """Adapts a StreamCallback to the junction receiver interface."""
+
+    def __init__(self, callback: StreamCallback):
+        self.callback = callback
+
+    def receive(self, event: StreamEvent) -> None:
+        if event.type in (EventType.CURRENT, EventType.EXPIRED):
+            self.callback.receive_stream_event(event)
+
+
+class QueryCallback:
+    """Per-query callback: receive(timestamp, current_events, expired_events)."""
+
+    def __init__(self, fn: Optional[Callable] = None):
+        self._fn = fn
+
+    def receive(self, timestamp: int, in_events: Optional[list[Event]],
+                out_events: Optional[list[Event]]) -> None:
+        if self._fn:
+            self._fn(timestamp, in_events, out_events)

@@ -1,0 +1,317 @@
+"""Tables: in-memory storage with primary-key/index acceleration + record SPI.
+
+Reference: ``core/table/`` — ``InMemoryTable.java``, ``holder/IndexEventHolder.java``
+(primaryKeyData map + indexData TreeMaps), ``record/AbstractRecordTable.java``
+(external store SPI), compiled conditions via ``util/collection/``. The
+interpreter's "compiled condition" is a closure over (row, matching event) frames;
+the PK fast path mirrors IndexOperator.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..query_api import (
+    Compare,
+    CompareOp,
+    DataType,
+    Expression,
+    Variable,
+)
+from ..query_api.annotation import find_annotation
+from ..query_api.definition import TableDefinition
+from .event import Event, StreamEvent
+from .executor import ExecutorBuilder, VariableResolver
+
+
+class TableMatchFrame:
+    """Frame pairing a table row with the matching (output) event."""
+
+    __slots__ = ("row", "out", "ts")
+
+    def __init__(self, row: Optional[list], out: Optional[list], ts: int = 0):
+        self.row = row
+        self.out = out
+        self.ts = ts
+
+    def timestamp(self) -> int:
+        return self.ts
+
+
+class TableMatchResolver(VariableResolver):
+    """``T.attr`` → row side; bare/other → matching-event side."""
+
+    def __init__(self, table_def: TableDefinition, out_names: list[str],
+                 out_types: list[DataType], stream_ref: Optional[str] = None):
+        self.table_def = table_def
+        self.out_names = out_names
+        self.out_types = out_types
+        self.stream_ref = stream_ref
+
+    def resolve(self, var: Variable):
+        sid = var.stream_id
+        if sid == self.table_def.id:
+            pos = self.table_def.attribute_position(var.attribute)
+            return (lambda f: None if f.row is None else f.row[pos]), \
+                self.table_def.attributes[pos].type
+        if sid is None and var.attribute not in self.out_names \
+                and var.attribute in self.table_def.attribute_names:
+            pos = self.table_def.attribute_position(var.attribute)
+            return (lambda f: None if f.row is None else f.row[pos]), \
+                self.table_def.attributes[pos].type
+        if var.attribute in self.out_names:
+            pos = self.out_names.index(var.attribute)
+            return (lambda f: None if f.out is None else f.out[pos]), self.out_types[pos]
+        raise KeyError(f"cannot resolve '{var.attribute}' in table condition")
+
+
+class CompiledTableCondition:
+    """condition fn + optional primary-key fast path."""
+
+    def __init__(self, fn: Callable[[TableMatchFrame], bool],
+                 pk_extractor: Optional[Callable[[list], Any]] = None):
+        self.fn = fn
+        self.pk_extractor = pk_extractor    # out_data -> pk value
+
+
+class Table:
+    """Base table API (reference ``table/Table.java``)."""
+
+    def __init__(self, definition: TableDefinition, app_context):
+        self.definition = definition
+        self.app_context = app_context
+        self.id = definition.id
+
+    def add(self, rows: list[list], ts: int = 0) -> None:
+        raise NotImplementedError
+
+    def find(self, cond: Optional[CompiledTableCondition],
+             out_data: Optional[list], ts: int = 0) -> list[list]:
+        raise NotImplementedError
+
+    def contains(self, cond: CompiledTableCondition, out_data: list, ts: int = 0) -> bool:
+        return bool(self.find(cond, out_data, ts))
+
+    def delete(self, cond: CompiledTableCondition, out_data: list, ts: int = 0) -> int:
+        raise NotImplementedError
+
+    def update(self, cond: CompiledTableCondition, out_data: list,
+               setters: list[tuple[int, Callable]], ts: int = 0) -> int:
+        raise NotImplementedError
+
+    def update_or_add(self, cond: CompiledTableCondition, out_data: list,
+                      setters: list[tuple[int, Callable]], ts: int = 0) -> None:
+        raise NotImplementedError
+
+
+class InMemoryTable(Table):
+    def __init__(self, definition: TableDefinition, app_context):
+        super().__init__(definition, app_context)
+        self.rows: list[list] = []
+        # @PrimaryKey('attr'[, 'attr2']) / @Index('attr')
+        self.pk_positions: list[int] = []
+        pk = find_annotation(definition.annotations, "PrimaryKey")
+        if pk:
+            self.pk_positions = [
+                definition.attribute_position(v) for v in pk.indexed_values()
+            ]
+        self.pk_map: dict[Any, list] = {}
+        self.index_positions: list[int] = []
+        for idx_ann in definition.annotations:
+            if idx_ann.name.lower() == "index":
+                for v in idx_ann.indexed_values():
+                    self.index_positions.append(definition.attribute_position(v))
+        self.indexes: dict[int, dict[Any, list[list]]] = {
+            p: {} for p in self.index_positions
+        }
+        app_context.register_state(f"table-{self.id}", self)
+
+    # -- helpers --------------------------------------------------------------
+    def _pk_of_row(self, row: list) -> Any:
+        if len(self.pk_positions) == 1:
+            return row[self.pk_positions[0]]
+        return tuple(row[p] for p in self.pk_positions)
+
+    def _index_add(self, row: list) -> None:
+        for p in self.index_positions:
+            self.indexes[p].setdefault(row[p], []).append(row)
+
+    def _index_remove(self, row: list) -> None:
+        for p in self.index_positions:
+            lst = self.indexes[p].get(row[p])
+            if lst and row in lst:
+                lst.remove(row)
+
+    # -- operations -----------------------------------------------------------
+    def add(self, rows: list[list], ts: int = 0) -> None:
+        for r in rows:
+            row = list(r)
+            if self.pk_positions:
+                key = self._pk_of_row(row)
+                if key in self.pk_map:
+                    raise ValueError(
+                        f"primary key violation on table '{self.id}': {key!r}")
+                self.pk_map[key] = row
+            self.rows.append(row)
+            self._index_add(row)
+
+    def _candidates(self, cond: Optional[CompiledTableCondition],
+                    out_data: Optional[list]) -> list[list]:
+        if cond is None:
+            return self.rows
+        if cond.pk_extractor is not None and self.pk_positions:
+            key = cond.pk_extractor(out_data)
+            row = self.pk_map.get(key)
+            return [row] if row is not None else []
+        return self.rows
+
+    def find(self, cond, out_data, ts: int = 0) -> list[list]:
+        if cond is None:
+            return [list(r) for r in self.rows]
+        return [
+            list(r) for r in self._candidates(cond, out_data)
+            if cond.fn(TableMatchFrame(r, out_data, ts))
+        ]
+
+    def delete(self, cond, out_data, ts: int = 0) -> int:
+        victims = [
+            r for r in self._candidates(cond, out_data)
+            if cond.fn(TableMatchFrame(r, out_data, ts))
+        ]
+        for r in victims:
+            self.rows.remove(r)
+            self._index_remove(r)
+            if self.pk_positions:
+                self.pk_map.pop(self._pk_of_row(r), None)
+        return len(victims)
+
+    def update(self, cond, out_data, setters, ts: int = 0) -> int:
+        n = 0
+        for r in self._candidates(cond, out_data):
+            if cond is None or cond.fn(TableMatchFrame(r, out_data, ts)):
+                self._apply_set(r, out_data, setters, ts)
+                n += 1
+        return n
+
+    def _apply_set(self, row: list, out_data: list, setters, ts: int) -> None:
+        if self.pk_positions:
+            old_key = self._pk_of_row(row)
+        self._index_remove(row)
+        for pos, value_fn in setters:
+            row[pos] = value_fn(TableMatchFrame(row, out_data, ts))
+        self._index_add(row)
+        if self.pk_positions:
+            new_key = self._pk_of_row(row)
+            if new_key != old_key:
+                self.pk_map.pop(old_key, None)
+                self.pk_map[new_key] = row
+
+    def update_or_add(self, cond, out_data, setters, ts: int = 0) -> None:
+        if self.update(cond, out_data, setters, ts) == 0:
+            # insert the matching event's payload (schema-aligned)
+            self.add([list(out_data)], ts)
+
+    def contains_value(self, value: Any) -> bool:
+        """`expr in Table` — single-attribute membership (first column or PK)."""
+        if self.pk_positions and len(self.pk_positions) == 1:
+            return value in self.pk_map
+        return any(value in r for r in self.rows)
+
+    def all_events(self, ts: int = 0) -> list[StreamEvent]:
+        return [StreamEvent(ts, list(r)) for r in self.rows]
+
+    # -- state ----------------------------------------------------------------
+    def snapshot_state(self) -> dict:
+        return {"rows": [list(r) for r in self.rows]}
+
+    def restore_state(self, state: dict) -> None:
+        self.rows = []
+        self.pk_map = {}
+        self.indexes = {p: {} for p in self.index_positions}
+        self.add(state["rows"])
+
+
+class AbstractRecordTable(Table):
+    """External store SPI (reference ``record/AbstractRecordTable.java:57``).
+
+    Subclass and implement the ``record_*`` hooks to back a table with an external
+    store; register via the extension registry under ``store:<type>``.
+    """
+
+    extension_kind = "store"
+
+    def init(self, definition: TableDefinition, options: dict) -> None:
+        pass
+
+    def record_add(self, rows: list[list]) -> None:
+        raise NotImplementedError
+
+    def record_find(self, condition_params: dict) -> list[list]:
+        raise NotImplementedError
+
+    def record_delete(self, condition_params: dict) -> int:
+        raise NotImplementedError
+
+    def record_update(self, condition_params: dict, values: dict) -> int:
+        raise NotImplementedError
+
+    def add(self, rows, ts: int = 0) -> None:
+        self.record_add(rows)
+
+    def find(self, cond, out_data, ts: int = 0) -> list[list]:
+        rows = self.record_find({})
+        if cond is None:
+            return rows
+        return [r for r in rows if cond.fn(TableMatchFrame(r, out_data, ts))]
+
+
+def compile_table_condition(table: Table, on_condition: Optional[Expression],
+                            out_names: list[str], out_types: list[DataType],
+                            app_context) -> Optional[CompiledTableCondition]:
+    if on_condition is None:
+        return None
+    resolver = TableMatchResolver(table.definition, out_names, out_types)
+    builder = ExecutorBuilder(resolver, app_context)
+    fn, _ = builder.build(on_condition)
+
+    # PK fast path: `T.pk == <expr-over-out>` at top level of an AND chain
+    pk_extractor = None
+    if isinstance(table, InMemoryTable) and len(table.pk_positions) == 1:
+        pk_pos = table.pk_positions[0]
+        pk_name = table.definition.attributes[pk_pos].name
+        eq = _find_pk_equality(on_condition, table.id, pk_name)
+        if eq is not None:
+            out_builder = ExecutorBuilder(
+                TableMatchResolver(table.definition, out_names, out_types),
+                app_context)
+            val_fn, _ = out_builder.build(eq)
+            pk_extractor = lambda out: val_fn(TableMatchFrame(None, out))  # noqa: E731
+    return CompiledTableCondition(fn, pk_extractor)
+
+
+def _find_pk_equality(expr: Expression, table_id: str, pk_name: str):
+    """Finds `T.pk == rhs` (rhs not referencing the table) in a top-level AND chain."""
+    from ..query_api import And
+    if isinstance(expr, And):
+        return _find_pk_equality(expr.left, table_id, pk_name) or \
+            _find_pk_equality(expr.right, table_id, pk_name)
+    if isinstance(expr, Compare) and expr.op == CompareOp.EQ:
+        for a, b in ((expr.left, expr.right), (expr.right, expr.left)):
+            if isinstance(a, Variable) and a.attribute == pk_name and \
+                    (a.stream_id == table_id or a.stream_id is None) and \
+                    not _references_table(b, table_id):
+                return b
+    return None
+
+
+def _references_table(expr: Expression, table_id: str) -> bool:
+    from ..query_api import And, AttributeFunction, MathExpr, Minus, Not, Or
+    if isinstance(expr, Variable):
+        return expr.stream_id == table_id
+    for attr in ("left", "right", "expr"):
+        sub = getattr(expr, attr, None)
+        if isinstance(sub, Expression) and _references_table(sub, table_id):
+            return True
+    if isinstance(expr, AttributeFunction):
+        return any(_references_table(a, table_id) for a in expr.args)
+    return False

@@ -1,0 +1,118 @@
+"""Join behavioral tests (reference: ``core/query/join/`` suites)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def setup(manager, app, out="O"):
+    rt = manager.create_siddhi_app_runtime(app, playback=True)
+    got = []
+    rt.add_callback(out, StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    return rt, got
+
+
+def test_window_join(manager):
+    rt, got = setup(manager, """
+        define stream L (sym string, v int);
+        define stream R (sym string, w int);
+        from L#window.length(10) join R#window.length(10) on L.sym == R.sym
+        select L.sym as s, v, w insert into O;
+    """)
+    l, r = rt.input_handler("L"), rt.input_handler("R")
+    l.send(["x", 1], timestamp=1)
+    r.send(["x", 9], timestamp=2)
+    r.send(["y", 8], timestamp=3)
+    l.send(["y", 2], timestamp=4)
+    assert [e.data for e in got] == [["x", 1, 9], ["y", 2, 8]]
+
+
+def test_join_within(manager):
+    rt, got = setup(manager, """
+        define stream L (sym string); define stream R (sym string);
+        from L#window.length(10) join R#window.length(10) on L.sym == R.sym
+        within 100 select L.sym as s insert into O;
+    """)
+    l, r = rt.input_handler("L"), rt.input_handler("R")
+    l.send(["x"], timestamp=1000)
+    r.send(["x"], timestamp=1050)   # within 100 → join
+    r.send(["x"], timestamp=1500)   # too far from L event
+    assert len(got) == 1
+
+
+def test_left_outer_join(manager):
+    rt, got = setup(manager, """
+        define stream L (sym string, v int);
+        define stream R (sym string, w int);
+        from L#window.length(5) as a left outer join R#window.length(5) as b
+        on a.sym == b.sym
+        select a.sym as s, b.w as w insert into O;
+    """)
+    l, r = rt.input_handler("L"), rt.input_handler("R")
+    l.send(["x", 1], timestamp=1)     # no match on right → [x, None]
+    r.send(["x", 5], timestamp=2)     # right probe matches left window
+    assert got[0].data == ["x", None]
+    assert got[1].data == ["x", 5]
+
+
+def test_unidirectional_join(manager):
+    rt, got = setup(manager, """
+        define stream L (sym string); define stream R (sym string);
+        from L#window.length(5) unidirectional join R#window.length(5)
+        on L.sym == R.sym select L.sym as s insert into O;
+    """)
+    l, r = rt.input_handler("L"), rt.input_handler("R")
+    r.send(["x"], timestamp=1)     # right arrivals don't trigger
+    l.send(["x"], timestamp=2)     # left does
+    assert len(got) == 1
+
+
+def test_table_join(manager):
+    rt, got = setup(manager, """
+        define stream Price (sym string, p float);
+        define stream S (sym string, qty int);
+        define table T (sym string, p float);
+        from Price insert into T;
+        from S join T on S.sym == T.sym
+        select S.sym as s, qty, T.p as price insert into O;
+    """)
+    rt.input_handler("Price").send(["x", 9.5], timestamp=1)
+    rt.input_handler("S").send(["x", 3], timestamp=2)
+    rt.input_handler("S").send(["y", 4], timestamp=3)   # not in table
+    assert [e.data for e in got] == [["x", 3, 9.5]]
+
+
+def test_named_window_join(manager):
+    rt, got = setup(manager, """
+        define stream S1 (sym string, v int);
+        define stream S2 (sym string);
+        define window W (sym string, v int) length(5);
+        from S1 insert into W;
+        from S2 join W on S2.sym == W.sym
+        select S2.sym as s, W.v as v insert into O;
+    """)
+    rt.input_handler("S1").send(["x", 7], timestamp=1)
+    rt.input_handler("S2").send(["x"], timestamp=2)
+    assert [e.data for e in got] == [["x", 7]]
+
+
+def test_join_aggregation(manager):
+    rt, got = setup(manager, """
+        define stream L (sym string, v int);
+        define stream R (sym string, w int);
+        from L#window.length(10) join R#window.length(10) on L.sym == R.sym
+        select L.sym as s, sum(w) as total group by L.sym insert into O;
+    """)
+    l, r = rt.input_handler("L"), rt.input_handler("R")
+    r.send(["x", 1], timestamp=1)
+    r.send(["x", 2], timestamp=2)
+    l.send(["x", 0], timestamp=3)   # joins both right rows → totals 1, 3
+    assert [e.data for e in got] == [["x", 1], ["x", 3]]

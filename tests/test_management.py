@@ -1,0 +1,325 @@
+"""Management behavioral tests: group-by, partitions, rate limiting, triggers,
+snapshots/persistence, sources/sinks, aggregations, extensions.
+
+Mirrors the reference's ``core/managment/``, ``core/partition/``, ``core/ratelimit/``,
+``core/transport/`` and ``core/aggregation/`` suites.
+"""
+
+import pytest
+
+from siddhi_tpu import (
+    InMemoryBroker,
+    InMemoryPersistenceStore,
+    SiddhiManager,
+    StreamCallback,
+)
+from siddhi_tpu.core import ScalarFunctionExtension, StreamFunctionExtension
+from siddhi_tpu.query_api.definition import DataType, StreamDefinition
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+    InMemoryBroker.reset()
+
+
+def setup(manager, app, out="O"):
+    rt = manager.create_siddhi_app_runtime(app, playback=True)
+    got = []
+    rt.add_callback(out, StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    return rt, got
+
+
+# ---------------------------------------------------------------- group by
+
+def test_group_by_aggregation(manager):
+    rt, got = setup(manager, """
+        define stream S (k string, v long);
+        from S#window.length(4) select k, sum(v) as total, avg(v) as a,
+            min(v) as mn, max(v) as mx, count() as c
+        group by k insert into O;
+    """)
+    ih = rt.input_handler("S")
+    for i, (k, v) in enumerate([("a", 1), ("b", 10), ("a", 3)]):
+        ih.send([k, v], timestamp=100 + i)
+    assert got[0].data == ["a", 1, 1.0, 1, 1, 1]
+    assert got[1].data == ["b", 10, 10.0, 10, 10, 1]
+    assert got[2].data == ["a", 4, 2.0, 1, 3, 2]
+
+
+def test_having(manager):
+    rt, got = setup(manager, """
+        define stream S (k string, v long);
+        from S select k, sum(v) as total group by k having total > 10 insert into O;
+    """)
+    ih = rt.input_handler("S")
+    for i, (k, v) in enumerate([("a", 5), ("a", 4), ("a", 3), ("b", 1)]):
+        ih.send([k, v], timestamp=100 + i)
+    assert [e.data for e in got] == [["a", 12]]
+
+
+def test_stddev_distinct_count(manager):
+    rt, got = setup(manager, """
+        define stream S (k string, v double);
+        from S select stdDev(v) as sd, distinctCount(k) as dc insert into O;
+    """)
+    ih = rt.input_handler("S")
+    for i, (k, v) in enumerate([("a", 2.0), ("b", 4.0), ("a", 6.0)]):
+        ih.send([k, v], timestamp=100 + i)
+    assert got[-1].data[0] == pytest.approx(1.632993, abs=1e-5)
+    assert got[-1].data[1] == 2
+
+
+# ---------------------------------------------------------------- partitions
+
+def test_partition_isolated_state(manager):
+    rt, got = setup(manager, """
+        define stream S (k string, v long);
+        partition with (k of S)
+        begin
+            from S#window.length(2) select k, sum(v) as total insert into O;
+        end;
+    """)
+    ih = rt.input_handler("S")
+    rows = [("a", 1), ("b", 10), ("a", 2), ("b", 20), ("a", 4)]
+    for i, (k, v) in enumerate(rows):
+        ih.send([k, v], timestamp=100 + i)
+    assert [e.data for e in got] == [
+        ["a", 1], ["b", 10], ["a", 3], ["b", 30], ["a", 6]]
+
+
+def test_partition_inner_stream(manager):
+    rt, got = setup(manager, """
+        define stream S (k string, v long);
+        partition with (k of S)
+        begin
+            from S select k, v * 2 as d insert into #Mid;
+            from #Mid select k, d insert into O;
+        end;
+    """)
+    rt.input_handler("S").send(["a", 5], timestamp=1)
+    assert [e.data for e in got] == [["a", 10]]
+
+
+def test_range_partition(manager):
+    rt, got = setup(manager, """
+        define stream S (v double);
+        partition with (v < 100.0 as 'small' or v >= 100.0 as 'big' of S)
+        begin
+            from S select v, count() as c insert into O;
+        end;
+    """)
+    ih = rt.input_handler("S")
+    for i, v in enumerate([50.0, 150.0, 60.0]):
+        ih.send([v], timestamp=100 + i)
+    assert [e.data for e in got] == [[50.0, 1], [150.0, 1], [60.0, 2]]
+
+
+# ---------------------------------------------------------------- rate limit
+
+def test_output_first_every_n(manager):
+    rt, got = setup(manager, """
+        define stream S (v int);
+        from S select v output first every 3 events insert into O;
+    """)
+    ih = rt.input_handler("S")
+    for i in range(7):
+        ih.send([i], timestamp=100 + i)
+    assert [e.data[0] for e in got] == [0, 3, 6]
+
+
+def test_output_all_every_n(manager):
+    rt, got = setup(manager, """
+        define stream S (v int);
+        from S select v output all every 2 events insert into O;
+    """)
+    ih = rt.input_handler("S")
+    for i in range(5):
+        ih.send([i], timestamp=100 + i)
+    assert [e.data[0] for e in got] == [0, 1, 2, 3]
+
+
+def test_output_last_every_time(manager):
+    rt, got = setup(manager, """
+        define stream S (v int);
+        from S select v output last every 100 insert into O;
+    """)
+    ih = rt.input_handler("S")
+    ih.send([1], timestamp=1000)
+    ih.send([2], timestamp=1050)
+    rt.advance_time(1150)
+    assert [e.data[0] for e in got] == [2]
+
+
+def test_output_snapshot(manager):
+    rt, got = setup(manager, """
+        define stream S (v long);
+        from S select sum(v) as total output snapshot every 100 insert into O;
+    """)
+    ih = rt.input_handler("S")
+    ih.send([1], timestamp=1000)
+    ih.send([2], timestamp=1050)
+    rt.advance_time(1120)
+    assert [e.data[0] for e in got] == [3]
+
+
+# ---------------------------------------------------------------- triggers
+
+def test_periodic_trigger(manager):
+    rt, got = setup(manager, """
+        define trigger T at every 100;
+        from T select triggered_time insert into O;
+    """)
+    rt.advance_time(350)
+    assert len(got) == 3
+
+
+def test_start_trigger(manager):
+    rt = manager.create_siddhi_app_runtime("""
+        define trigger T at 'start';
+        from T select triggered_time insert into O;
+    """, playback=True)
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    assert len(got) == 1
+
+
+# ---------------------------------------------------------------- persistence
+
+def test_persist_restore_roundtrip(manager):
+    manager.set_persistence_store(InMemoryPersistenceStore())
+    app = """
+        define stream S (v long);
+        from S#window.length(5) select sum(v) as total insert into O;
+    """
+    rt, got = setup(manager, app)
+    ih = rt.input_handler("S")
+    ih.send([10], timestamp=1)
+    ih.send([20], timestamp=2)
+    rev = rt.persist()
+    assert rev is not None
+
+    rt2 = manager.create_siddhi_app_runtime(app, playback=True)
+    got2 = []
+    rt2.add_callback("O", StreamCallback(lambda evs: got2.extend(evs)))
+    rt2.start()
+    assert rt2.restore_last_revision() == rev
+    rt2.input_handler("S").send([5], timestamp=3)
+    assert [e.data[0] for e in got2] == [35]
+
+
+def test_table_snapshot(manager):
+    app = """
+        define stream S (sym string);
+        define table T (sym string);
+        from S insert into T;
+    """
+    rt = manager.create_siddhi_app_runtime(app, playback=True)
+    rt.start()
+    rt.input_handler("S").send(["a"], timestamp=1)
+    blob = rt.snapshot()
+    rt2 = manager.create_siddhi_app_runtime(app, playback=True)
+    rt2.start()
+    rt2.restore(blob)
+    assert [e.data for e in rt2.query("from T select sym")] == [["a"]]
+
+
+# ---------------------------------------------------------------- sources/sinks
+
+def test_inmemory_source_sink(manager):
+    rt = manager.create_siddhi_app_runtime("""
+        @source(type='inMemory', topic='in', @map(type='passThrough'))
+        define stream S (v int);
+        @sink(type='inMemory', topic='out', @map(type='passThrough'))
+        define stream O (v int);
+        from S[v > 0] select v insert into O;
+    """, playback=True)
+    received = []
+    InMemoryBroker.subscribe("out", received.append)
+    rt.start()
+    InMemoryBroker.publish("in", [5])
+    InMemoryBroker.publish("in", [-1])
+    InMemoryBroker.publish("in", [7])
+    assert [e.data for e in received] == [[5], [7]]
+
+
+def test_json_mappers(manager):
+    rt = manager.create_siddhi_app_runtime("""
+        @source(type='inMemory', topic='jin', @map(type='json'))
+        define stream S (sym string, v int);
+        @sink(type='inMemory', topic='jout', @map(type='json'))
+        define stream O (sym string, v int);
+        from S select * insert into O;
+    """, playback=True)
+    received = []
+    InMemoryBroker.subscribe("jout", received.append)
+    rt.start()
+    InMemoryBroker.publish("jin", '{"event": {"sym": "a", "v": 3}}')
+    assert received == ['{"event": {"sym": "a", "v": 3}}']
+
+
+# ---------------------------------------------------------------- aggregations
+
+def test_incremental_aggregation(manager):
+    rt = manager.create_siddhi_app_runtime("""
+        define stream Trades (sym string, price double, vol long, ts long);
+        define aggregation TradeAgg
+        from Trades select sym, avg(price) as ap, sum(vol) as tv
+        group by sym aggregate by ts every sec ... hour;
+    """, playback=True)
+    rt.start()
+    ih = rt.input_handler("Trades")
+    base = 1_700_000_000_000
+    ih.send(["a", 10.0, 1, base], timestamp=1)
+    ih.send(["a", 20.0, 2, base + 100], timestamp=2)        # same second
+    ih.send(["a", 30.0, 4, base + 1000], timestamp=3)       # next second
+    rows = rt.query(f"from TradeAgg within {base}L, {base + 10_000}L per 'seconds' "
+                    "select AGG_TIMESTAMP, sym, ap, tv")
+    assert [e.data for e in rows] == [
+        [base, "a", 15.0, 3],
+        [base + 1000, "a", 30.0, 4],
+    ]
+
+
+# ---------------------------------------------------------------- extensions
+
+def test_scalar_function_extension(manager):
+    class Concat(ScalarFunctionExtension):
+        return_type = DataType.STRING
+
+        def execute(self, args):
+            return "".join(str(a) for a in args)
+
+    manager.set_extension("str:concat", Concat)
+    rt, got = setup(manager, """
+        define stream S (a string, b string);
+        from S select str:concat(a, b) as c insert into O;
+    """)
+    rt.input_handler("S").send(["x", "y"], timestamp=1)
+    assert [e.data for e in got] == [["xy"]]
+
+
+def test_stream_function_extension(manager):
+    class Explode(StreamFunctionExtension):
+        def init(self, input_def, params, param_fns):
+            out = StreamDefinition(input_def.id + "_exploded")
+            for a in input_def.attributes:
+                out.attribute(a.name, a.type)
+            out.attribute("part", DataType.INT)
+            return out
+
+        def process(self, event, param_values):
+            n = param_values[0]
+            return [list(event.data) + [i] for i in range(n)]
+
+    manager.set_extension("custom:explode", Explode)
+    rt, got = setup(manager, """
+        define stream S (v int);
+        from S#custom:explode(2) select v, part insert into O;
+    """)
+    rt.input_handler("S").send([7], timestamp=1)
+    assert [e.data for e in got] == [[7, 0], [7, 1]]

@@ -1,0 +1,187 @@
+"""Pattern matching behavioral tests.
+
+Mirrors the reference's ``core/query/pattern/`` suites (EveryPatternTestCase,
+LogicalPatternTestCase, CountPatternTestCase, AbsentPatternTestCase,
+PatternWithinTestCase) — assertions derived from the documented NFA semantics.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def setup(manager, app, out="O"):
+    rt = manager.create_siddhi_app_runtime(app, playback=True)
+    got = []
+    rt.add_callback(out, StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    return rt, got
+
+
+def test_basic_pattern_once(manager):
+    """Without `every`, only the first e1 candidate starts the match."""
+    rt, got = setup(manager, """
+        define stream S1 (p float); define stream S2 (p float);
+        from e1=S1[p > 20] -> e2=S2[p > e1.p]
+        select e1.p as p1, e2.p as p2 insert into O;
+    """)
+    s1, s2 = rt.input_handler("S1"), rt.input_handler("S2")
+    s1.send([25.0], timestamp=1)
+    s1.send([30.0], timestamp=2)          # ignored: start already consumed
+    s2.send([27.0], timestamp=3)
+    s2.send([100.0], timestamp=4)         # pattern complete; no second match
+    assert [e.data for e in got] == [[25.0, 27.0]]
+
+
+def test_every_pattern_overlapping(manager):
+    rt, got = setup(manager, """
+        define stream S1 (p float); define stream S2 (p float);
+        from every e1=S1[p > 20] -> e2=S2[p > e1.p]
+        select e1.p as p1, e2.p as p2 insert into O;
+    """)
+    s1, s2 = rt.input_handler("S1"), rt.input_handler("S2")
+    s1.send([25.0], timestamp=1)
+    s1.send([30.0], timestamp=2)
+    s2.send([28.0], timestamp=3)          # matches e1=25 only
+    s2.send([55.0], timestamp=4)          # matches remaining e1=30 partial
+    assert [e.data for e in got] == [[25.0, 28.0], [30.0, 55.0]]
+
+
+def test_every_group_reseeds_after_completion(manager):
+    rt, got = setup(manager, """
+        define stream A (v int); define stream B (v int); define stream C (v int);
+        from every (e1=A -> e2=B) -> e3=C
+        select e1.v as a, e2.v as b, e3.v as c insert into O;
+    """)
+    a, b, c = (rt.input_handler(x) for x in "ABC")
+    a.send([1], timestamp=1)
+    a.send([2], timestamp=2)      # group in progress: not a new seed yet
+    b.send([3], timestamp=3)      # group (1,3) completes → reseed
+    a.send([4], timestamp=4)
+    b.send([5], timestamp=5)      # group (4,5) completes
+    c.send([6], timestamp=6)      # fires for both completed groups
+    assert [e.data for e in got] == [[1, 3, 6], [4, 5, 6]]
+
+
+def test_count_pattern(manager):
+    rt, got = setup(manager, """
+        define stream A (v int); define stream B (v int);
+        from e1=A<2:4> -> e2=B
+        select e1[0].v as first, e1[last].v as last_v, e2.v as bv insert into O;
+    """)
+    a, b = rt.input_handler("A"), rt.input_handler("B")
+    a.send([1], timestamp=1)
+    b.send([99], timestamp=2)     # only 1 occurrence: below min → no match
+    a.send([2], timestamp=3)
+    a.send([3], timestamp=4)
+    b.send([100], timestamp=5)
+    (m,) = got
+    assert m.data == [1, 3, 100]
+
+
+def test_logical_and_pattern(manager):
+    rt, got = setup(manager, """
+        define stream A (v int); define stream B (v int); define stream C (v int);
+        from e1=A and e2=B -> e3=C
+        select e1.v as a, e2.v as b, e3.v as c insert into O;
+    """)
+    a, b, c = (rt.input_handler(x) for x in "ABC")
+    b.send([2], timestamp=1)      # order-independent
+    a.send([1], timestamp=2)
+    c.send([3], timestamp=3)
+    assert [e.data for e in got] == [[1, 2, 3]]
+
+
+def test_logical_or_pattern(manager):
+    rt, got = setup(manager, """
+        define stream A (v int); define stream B (v int); define stream C (v int);
+        from e1=A or e2=B -> e3=C
+        select e1.v as a, e2.v as b, e3.v as c insert into O;
+    """)
+    a, b, c = (rt.input_handler(x) for x in "ABC")
+    b.send([2], timestamp=1)
+    c.send([3], timestamp=2)
+    (m,) = got
+    assert m.data == [None, 2, 3]     # e1 unbound → null
+
+
+def test_absent_pattern_with_for(manager):
+    rt, got = setup(manager, """
+        define stream A (v int); define stream B (v int);
+        from e1=A -> not B for 100
+        select e1.v as a insert into O;
+    """)
+    a, b = rt.input_handler("A"), rt.input_handler("B")
+    a.send([1], timestamp=1000)
+    rt.advance_time(1200)          # no B within 100ms → non-occurrence match
+    assert [e.data for e in got] == [[1]]
+
+
+def test_absent_pattern_killed_by_occurrence(manager):
+    rt, got = setup(manager, """
+        define stream A (v int); define stream B (v int);
+        from e1=A -> not B for 100
+        select e1.v as a insert into O;
+    """)
+    a, b = rt.input_handler("A"), rt.input_handler("B")
+    a.send([1], timestamp=1000)
+    b.send([9], timestamp=1050)    # B arrived → partial killed
+    rt.advance_time(1200)
+    assert got == []
+
+
+def test_within_expires_partials(manager):
+    rt, got = setup(manager, """
+        define stream A (v int); define stream B (v int);
+        from every e1=A -> e2=B within 100
+        select e1.v as a, e2.v as b insert into O;
+    """)
+    a, b = rt.input_handler("A"), rt.input_handler("B")
+    a.send([1], timestamp=1000)
+    b.send([2], timestamp=1150)    # too late (150 > 100)
+    a.send([3], timestamp=1200)
+    b.send([4], timestamp=1250)    # in time
+    assert [e.data for e in got] == [[3, 4]]
+
+
+def test_pattern_same_stream_both_states(manager):
+    rt, got = setup(manager, """
+        define stream S (v int);
+        from every e1=S[v > 10] -> e2=S[v > e1.v]
+        select e1.v as a, e2.v as b insert into O;
+    """)
+    s = rt.input_handler("S")
+    s.send([20], timestamp=1)
+    s.send([30], timestamp=2)      # completes (20,30) AND seeds e1=30
+    s.send([25], timestamp=3)      # completes (... 30? no: 25<30) → nothing? e1=25 seeded? 25>10 yes
+    s.send([40], timestamp=4)      # completes (30,40) and (25,40)
+    datas = [e.data for e in got]
+    assert [20, 30] in datas
+    assert [30, 40] in datas
+    assert [25, 40] in datas
+
+
+def test_pattern_snapshot_restore(manager):
+    app = """
+        define stream A (v int); define stream B (v int);
+        from every e1=A -> e2=B select e1.v as a, e2.v as b insert into O;
+    """
+    rt, got = setup(manager, app)
+    a = rt.input_handler("A")
+    a.send([1], timestamp=1)
+    blob = rt.snapshot()
+
+    rt2 = manager.create_siddhi_app_runtime(app, playback=True)
+    got2 = []
+    rt2.add_callback("O", StreamCallback(lambda evs: got2.extend(evs)))
+    rt2.start()
+    rt2.restore(blob)
+    rt2.input_handler("B").send([2], timestamp=5)
+    assert [e.data for e in got2] == [[1, 2]]

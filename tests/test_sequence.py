@@ -1,0 +1,109 @@
+"""Sequence (strict continuity) behavioral tests.
+
+Mirrors the reference's ``core/query/sequence/`` suites.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def setup(manager, app, out="O"):
+    rt = manager.create_siddhi_app_runtime(app, playback=True)
+    got = []
+    rt.add_callback(out, StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    return rt, got
+
+
+def test_strict_continuity(manager):
+    rt, got = setup(manager, """
+        define stream A (v int); define stream B (v int);
+        from every e1=A, e2=B select e1.v as a, e2.v as b insert into O;
+    """)
+    a, b = rt.input_handler("A"), rt.input_handler("B")
+    a.send([1], timestamp=1)
+    b.send([2], timestamp=2)    # match (1,2)
+    a.send([3], timestamp=3)
+    a.send([4], timestamp=4)    # A again → kills partial with e1=3
+    b.send([5], timestamp=5)    # match (4,5)
+    assert [e.data for e in got] == [[1, 2], [4, 5]]
+
+
+def test_sequence_without_every_matches_once(manager):
+    rt, got = setup(manager, """
+        define stream A (v int); define stream B (v int);
+        from e1=A, e2=B select e1.v as a, e2.v as b insert into O;
+    """)
+    a, b = rt.input_handler("A"), rt.input_handler("B")
+    a.send([1], timestamp=1)
+    b.send([2], timestamp=2)
+    a.send([3], timestamp=3)
+    b.send([4], timestamp=4)
+    assert [e.data for e in got] == [[1, 2]]
+
+
+def test_kleene_star(manager):
+    rt, got = setup(manager, """
+        define stream A (v int); define stream B (v int); define stream C (v int);
+        from every e1=A, e2=B*, e3=C
+        select e1.v as a, e3.v as c insert into O;
+    """)
+    a, b, c = (rt.input_handler(x) for x in "ABC")
+    a.send([1], timestamp=1)
+    b.send([2], timestamp=2)
+    b.send([3], timestamp=3)
+    c.send([4], timestamp=4)    # A B B C → match
+    a.send([5], timestamp=5)
+    c.send([6], timestamp=6)    # A C (zero Bs) → match
+    datas = [e.data for e in got]
+    assert [1, 4] in datas
+    assert [5, 6] in datas
+
+
+def test_kleene_plus_requires_one(manager):
+    rt, got = setup(manager, """
+        define stream A (v int); define stream B (v int); define stream C (v int);
+        from every e1=A, e2=B+, e3=C
+        select e1.v as a, e2[0].v as b0, e3.v as c insert into O;
+    """)
+    a, b, c = (rt.input_handler(x) for x in "ABC")
+    a.send([1], timestamp=1)
+    c.send([2], timestamp=2)    # zero Bs → no match, partial killed (strict)
+    a.send([3], timestamp=3)
+    b.send([4], timestamp=4)
+    c.send([5], timestamp=5)    # match
+    assert [e.data for e in got] == [[3, 4, 5]]
+
+
+def test_optional_question(manager):
+    rt, got = setup(manager, """
+        define stream A (v int); define stream B (v int); define stream C (v int);
+        from every e1=A, e2=B?, e3=C
+        select e1.v as a, e3.v as c insert into O;
+    """)
+    a, b, c = (rt.input_handler(x) for x in "ABC")
+    a.send([1], timestamp=1)
+    c.send([2], timestamp=2)    # zero Bs allowed → match
+    assert [e.data for e in got] == [[1, 2]]
+
+
+def test_sequence_filter_reference(manager):
+    rt, got = setup(manager, """
+        define stream S (p float);
+        from every e1=S, e2=S[p > e1.p]
+        select e1.p as a, e2.p as b insert into O;
+    """)
+    s = rt.input_handler("S")
+    s.send([10.0], timestamp=1)
+    s.send([20.0], timestamp=2)   # (10,20) match; also seeds e1=20
+    s.send([15.0], timestamp=3)   # 15 < 20 → kills e1=20 partial; seeds e1=15
+    s.send([25.0], timestamp=4)   # (15,25) match
+    assert [e.data for e in got] == [[10.0, 20.0], [15.0, 25.0]]

@@ -1,0 +1,131 @@
+"""Table + on-demand query behavioral tests (reference: ``core/query/table/``,
+``core/store/`` suites)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def test_insert_and_find(manager):
+    rt = manager.create_siddhi_app_runtime("""
+        define stream S (sym string, p float);
+        define table T (sym string, p float);
+        from S insert into T;
+    """, playback=True)
+    rt.start()
+    ih = rt.input_handler("S")
+    ih.send(["a", 1.0], timestamp=1)
+    ih.send(["b", 2.0], timestamp=2)
+    rows = rt.query("from T select sym, p")
+    assert [e.data for e in rows] == [["a", 1.0], ["b", 2.0]]
+
+
+def test_delete(manager):
+    rt = manager.create_siddhi_app_runtime("""
+        define stream S (sym string, p float);
+        define stream D (sym string);
+        define table T (sym string, p float);
+        from S insert into T;
+        from D delete T on T.sym == sym;
+    """, playback=True)
+    rt.start()
+    rt.input_handler("S").send(["a", 1.0], timestamp=1)
+    rt.input_handler("S").send(["b", 2.0], timestamp=2)
+    rt.input_handler("D").send(["a"], timestamp=3)
+    rows = rt.query("from T select sym")
+    assert [e.data for e in rows] == [["b"]]
+
+
+def test_update(manager):
+    rt = manager.create_siddhi_app_runtime("""
+        define stream S (sym string, p float);
+        define stream U (sym string, p float);
+        define table T (sym string, p float);
+        from S insert into T;
+        from U update T set T.p = p on T.sym == sym;
+    """, playback=True)
+    rt.start()
+    rt.input_handler("S").send(["a", 1.0], timestamp=1)
+    rt.input_handler("U").send(["a", 9.0], timestamp=2)
+    rows = rt.query("from T select p")
+    assert rows[0].data == [9.0]
+
+
+def test_update_or_insert(manager):
+    rt = manager.create_siddhi_app_runtime("""
+        define stream U (sym string, p float);
+        define table T (sym string, p float);
+        from U update or insert into T set T.p = p on T.sym == sym;
+    """, playback=True)
+    rt.start()
+    u = rt.input_handler("U")
+    u.send(["a", 1.0], timestamp=1)   # insert
+    u.send(["a", 2.0], timestamp=2)   # update
+    u.send(["b", 3.0], timestamp=3)   # insert
+    rows = rt.query("from T select sym, p")
+    assert [e.data for e in rows] == [["a", 2.0], ["b", 3.0]]
+
+
+def test_primary_key_and_in_expression(manager):
+    rt = manager.create_siddhi_app_runtime("""
+        define stream S (sym string, p float);
+        define stream Q (sym string);
+        @PrimaryKey('sym')
+        define table T (sym string, p float);
+        from S insert into T;
+        from Q[Q.sym in T] select sym insert into O;
+    """, playback=True)
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    rt.input_handler("S").send(["a", 1.0], timestamp=1)
+    rt.input_handler("Q").send(["a"], timestamp=2)
+    rt.input_handler("Q").send(["zzz"], timestamp=3)
+    assert [e.data for e in got] == [["a"]]
+
+
+def test_primary_key_violation(manager):
+    rt = manager.create_siddhi_app_runtime("""
+        define stream S (sym string, p float);
+        @PrimaryKey('sym')
+        define table T (sym string, p float);
+        from S insert into T;
+    """, playback=True)
+    rt.start()
+    errors = []
+    rt.set_exception_listener(errors.append)
+    rt.input_handler("S").send(["a", 1.0], timestamp=1)
+    rt.input_handler("S").send(["a", 2.0], timestamp=2)
+    assert len(errors) == 1
+
+
+def test_on_demand_aggregation(manager):
+    rt = manager.create_siddhi_app_runtime("""
+        define stream S (sym string, v long);
+        define table T (sym string, v long);
+        from S insert into T;
+    """, playback=True)
+    rt.start()
+    ih = rt.input_handler("S")
+    for row in [["a", 1], ["a", 2], ["b", 10]]:
+        ih.send(row, timestamp=1)
+    rows = rt.query("from T select sym, sum(v) as total group by sym")
+    assert [e.data for e in rows] == [["a", 3], ["b", 10]]
+
+
+def test_on_demand_update(manager):
+    rt = manager.create_siddhi_app_runtime("""
+        define table T (sym string, p float);
+    """, playback=True)
+    rt.start()
+    rt.query("select 'a' as sym, 1.0 as p insert into T")
+    rt.query("from T update T set T.p = 5.0 on T.sym == 'a'")
+    rows = rt.query("from T select p")
+    assert rows[0].data == [5.0]
