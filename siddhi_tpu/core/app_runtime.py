@@ -196,6 +196,9 @@ class SiddhiAppRuntime:
                     raise SiddhiAppCreationError(f"unknown source type '{s['type']}'")
                 mapper_cls = SOURCE_MAPPERS.get(s["map"]) or \
                     ctx.siddhi_context.extensions.get(f"sourceMapper:{s['map']}")
+                if mapper_cls is None:
+                    raise SiddhiAppCreationError(
+                        f"unknown source mapper type '{s['map']}'")
                 mapper = mapper_cls()
                 mapper.init(sd, s["options"])
                 src = cls()
@@ -209,6 +212,9 @@ class SiddhiAppRuntime:
                     raise SiddhiAppCreationError(f"unknown sink type '{s['type']}'")
                 mapper_cls = SINK_MAPPERS.get(s["map"]) or \
                     ctx.siddhi_context.extensions.get(f"sinkMapper:{s['map']}")
+                if mapper_cls is None:
+                    raise SiddhiAppCreationError(
+                        f"unknown sink mapper type '{s['map']}'")
                 mapper = mapper_cls()
                 mapper.init(sd, s["options"])
                 sink = cls()

@@ -103,10 +103,11 @@ class OnDemandQueryRuntime:
         group_fns = [builder.build(v)[0] for v in sel.group_by]
 
         if not has_agg:
+            fns = [builder.build(a.expr)[0] for a in attrs]
             out = []
             for r in rows:
                 frame = RowFrame(r, now)
-                out.append(Event(now, [builder.build(a.expr)[0](frame) for a in attrs]))
+                out.append(Event(now, [fn(frame) for fn in fns]))
             return self._post(out, attrs, now)
 
         # fold aggregation per group
@@ -120,22 +121,28 @@ class OnDemandQueryRuntime:
                 order.append(key)
             groups[key].append(r)
 
+        # compile each attribute once; fold per group
+        compiled = []
+        for a in attrs:
+            e = a.expr
+            if isinstance(e, AttributeFunction) and e.namespace is None \
+                    and e.name in AGGREGATOR_NAMES:
+                arg_fn, arg_t = builder.build(e.args[0]) if e.args \
+                    else ((lambda f: None), None)
+                compiled.append(("agg", e.name, arg_fn, arg_t))
+            else:
+                compiled.append(("value", None, builder.build(e)[0], None))
         out = []
         for key in order:
             grows = groups[key]
             data = []
-            for a in attrs:
-                e = a.expr
-                if isinstance(e, AttributeFunction) and e.namespace is None \
-                        and e.name in AGGREGATOR_NAMES:
-                    arg_fn = builder.build(e.args[0])[0] if e.args else (lambda f: None)
-                    arg_t = builder.build(e.args[0])[1] if e.args else None
-                    agg = make_aggregator(e.name, arg_t)
+            for kind, agg_name, fn, arg_t in compiled:
+                if kind == "agg":
+                    agg = make_aggregator(agg_name, arg_t)
                     for r in grows:
-                        agg.add(arg_fn(RowFrame(r, now)))
+                        agg.add(fn(RowFrame(r, now)))
                     data.append(agg.value())
                 else:
-                    fn = builder.build(e)[0]
                     data.append(fn(RowFrame(grows[-1], now)))
             out.append(Event(now, data))
         return self._post(out, attrs, now)

@@ -481,6 +481,18 @@ class PatternRuntime:
 
         self.pending = [[dec_state(p) for p in lst] for lst in state["pending"]]
         self.started = state["started"]
+        # re-arm absent-state non-occurrence timers (fresh scheduler)
+        for node_idx, lst in enumerate(self.pending):
+            node = self.c.nodes[node_idx]
+            if node.waiting_time_ms is None:
+                continue
+            for partial in lst:
+                arrival = partial.meta.get(f"absent_arrival_{node.index}")
+                if arrival is not None:
+                    self.app_context.scheduler.notify_at(
+                        arrival + node.waiting_time_ms,
+                        lambda ts, ni=node_idx, pp=partial: self._absent_timer(
+                            ni, pp, ts))
 
 
 class PatternStreamReceiver:

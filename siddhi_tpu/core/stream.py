@@ -42,11 +42,16 @@ class StreamJunction:
 
     def send_event(self, event: StreamEvent) -> None:
         self.throughput += 1
-        try:
-            for r in self.receivers:
+        first_error = None
+        for r in self.receivers:
+            try:
                 r.receive(event)
-        except Exception as e:  # noqa: BLE001 — boundary: route per @OnError
-            self.handle_error(event, e)
+            except Exception as e:  # noqa: BLE001 — per-receiver isolation:
+                # one faulty query must not starve the other subscribers
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            self.handle_error(event, first_error)
 
     def send_events(self, events: list[StreamEvent]) -> None:
         """Deliver a chunk, preserving batch identity for chunk-aware receivers
@@ -54,15 +59,19 @@ class StreamJunction:
         if not events:
             return
         self.throughput += len(events)
-        try:
-            for r in self.receivers:
+        first_error = None
+        for r in self.receivers:
+            try:
                 if hasattr(r, "receive_chunk"):
                     r.receive_chunk(events)
                 else:
                     for ev in events:
                         r.receive(ev)
-        except Exception as e:  # noqa: BLE001
-            self.handle_error(events[-1], e)
+            except Exception as e:  # noqa: BLE001
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            self.handle_error(events[-1], first_error)
 
     def handle_error(self, event: StreamEvent, e: Exception) -> None:
         if self.on_error_action == OnErrorAction.STREAM and self.fault_junction:
@@ -80,8 +89,10 @@ class StreamJunction:
         if listener is not None:
             listener(e)
         else:
-            log.error("error on stream '%s': %s", self.definition.id, e)
-            raise e
+            # LOG action (the default): record and continue — the event is
+            # dropped, the app keeps running (reference OnErrorAction.LOG)
+            log.error("error on stream '%s': %s", self.definition.id, e,
+                      exc_info=True)
 
 
 class InputHandler:
@@ -98,12 +109,15 @@ class InputHandler:
             if isinstance(data, Event):
                 self._send_one(data.timestamp, data.data)
             elif data and isinstance(data[0], Event):
-                for ev in data:
-                    self.app_context.advance_time(ev.timestamp)
+                # watermark: only advance to the chunk's FIRST timestamp before
+                # delivery — firing later timers first would reorder events
+                # around window boundaries; the rest advances after the chunk
+                self.app_context.advance_time(min(ev.timestamp for ev in data))
                 self.junction.send_events([
                     StreamEvent(ev.timestamp, list(ev.data), EventType.CURRENT)
                     for ev in data
                 ])
+                self.app_context.advance_time(max(ev.timestamp for ev in data))
             else:
                 ts = timestamp if timestamp is not None else self.app_context.current_time()
                 self._send_one(ts, list(data))

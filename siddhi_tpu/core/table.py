@@ -274,12 +274,15 @@ def compile_table_condition(table: Table, on_condition: Optional[Expression],
     builder = ExecutorBuilder(resolver, app_context)
     fn, _ = builder.build(on_condition)
 
-    # PK fast path: `T.pk == <expr-over-out>` at top level of an AND chain
+    # PK fast path: `T.pk == <expr-over-out>` at top level of an AND chain.
+    # A bare variable named like the PK only counts as the table side when the
+    # resolver would NOT bind it to the matching event (out side wins there).
     pk_extractor = None
     if isinstance(table, InMemoryTable) and len(table.pk_positions) == 1:
         pk_pos = table.pk_positions[0]
         pk_name = table.definition.attributes[pk_pos].name
-        eq = _find_pk_equality(on_condition, table.id, pk_name)
+        allow_bare = pk_name not in out_names
+        eq = _find_pk_equality(on_condition, table.id, pk_name, allow_bare)
         if eq is not None:
             out_builder = ExecutorBuilder(
                 TableMatchResolver(table.definition, out_names, out_types),
@@ -289,16 +292,18 @@ def compile_table_condition(table: Table, on_condition: Optional[Expression],
     return CompiledTableCondition(fn, pk_extractor)
 
 
-def _find_pk_equality(expr: Expression, table_id: str, pk_name: str):
+def _find_pk_equality(expr: Expression, table_id: str, pk_name: str,
+                      allow_bare: bool = True):
     """Finds `T.pk == rhs` (rhs not referencing the table) in a top-level AND chain."""
     from ..query_api import And
     if isinstance(expr, And):
-        return _find_pk_equality(expr.left, table_id, pk_name) or \
-            _find_pk_equality(expr.right, table_id, pk_name)
+        return _find_pk_equality(expr.left, table_id, pk_name, allow_bare) or \
+            _find_pk_equality(expr.right, table_id, pk_name, allow_bare)
     if isinstance(expr, Compare) and expr.op == CompareOp.EQ:
         for a, b in ((expr.left, expr.right), (expr.right, expr.left)):
             if isinstance(a, Variable) and a.attribute == pk_name and \
-                    (a.stream_id == table_id or a.stream_id is None) and \
+                    (a.stream_id == table_id
+                     or (a.stream_id is None and allow_bare)) and \
                     not _references_table(b, table_id):
                 return b
     return None

@@ -149,6 +149,12 @@ class BatchWindow(WindowProcessor):
     def find_events(self) -> list[StreamEvent]:
         return list(self.last_batch)
 
+    def snapshot_state(self) -> dict:
+        return {"last": [(e.timestamp, list(e.data)) for e in self.last_batch]}
+
+    def restore_state(self, state: dict) -> None:
+        self.last_batch = [StreamEvent(t, d) for t, d in state["last"]]
+
 
 # ---------------------------------------------------------------------------
 # time / timeBatch / timeLength / delay
@@ -196,6 +202,10 @@ class TimeWindow(WindowProcessor):
 
     def restore_state(self, state: dict) -> None:
         self.buffer = [StreamEvent(ts, d) for ts, d in state["buffer"]]
+        # re-arm expiry timers (fresh scheduler after restore)
+        for e in self.buffer:
+            self.app_context.scheduler.notify_at(
+                e.timestamp + self.duration, self._on_timer)
 
 
 class TimeBatchWindow(WindowProcessor):
@@ -249,6 +259,18 @@ class TimeBatchWindow(WindowProcessor):
         return list(self.last_batch) + list(self.pending)
 
     def snapshot_state(self) -> dict:
+        return {"pending": [(e.timestamp, list(e.data)) for e in self.pending],
+                "last": [(e.timestamp, list(e.data)) for e in self.last_batch],
+                "armed": self._armed}
+
+    def restore_state(self, state: dict) -> None:
+        self.pending = [StreamEvent(t, d) for t, d in state["pending"]]
+        self.last_batch = [StreamEvent(t, d) for t, d in state["last"]]
+        self._armed = False
+        if state.get("armed"):
+            self._arm(self.app_context.current_time())
+
+    def snapshot_state(self) -> dict:
         return {
             "pending": [(e.timestamp, list(e.data)) for e in self.pending],
             "last": [(e.timestamp, list(e.data)) for e in self.last_batch],
@@ -259,6 +281,8 @@ class TimeBatchWindow(WindowProcessor):
         self.pending = [StreamEvent(t, d) for t, d in state["pending"]]
         self.last_batch = [StreamEvent(t, d) for t, d in state["last"]]
         self.boundary = state["boundary"]
+        if self.boundary is not None:
+            self.app_context.scheduler.notify_at(self.boundary, self._on_timer)
 
 
 class TimeLengthWindow(WindowProcessor):
@@ -301,6 +325,15 @@ class TimeLengthWindow(WindowProcessor):
     def find_events(self) -> list[StreamEvent]:
         return list(self.buffer)
 
+    def snapshot_state(self) -> dict:
+        return {"buffer": [(e.timestamp, list(e.data)) for e in self.buffer]}
+
+    def restore_state(self, state: dict) -> None:
+        self.buffer = [StreamEvent(t, d) for t, d in state["buffer"]]
+        for e in self.buffer:
+            self.app_context.scheduler.notify_at(
+                e.timestamp + self.duration, self._on_timer)
+
 
 class DelayWindow(WindowProcessor):
     """Events pass through after a fixed delay (reference ``DelayWindowProcessor``)."""
@@ -332,6 +365,15 @@ class DelayWindow(WindowProcessor):
     def find_events(self) -> list[StreamEvent]:
         return list(self.held)
 
+    def snapshot_state(self) -> dict:
+        return {"held": [(e.timestamp, list(e.data)) for e in self.held]}
+
+    def restore_state(self, state: dict) -> None:
+        self.held = [StreamEvent(t, d) for t, d in state["held"]]
+        for e in self.held:
+            self.app_context.scheduler.notify_at(
+                e.timestamp + self.delay, self._on_timer)
+
 
 # ---------------------------------------------------------------------------
 # externalTime / externalTimeBatch — event-time attribute driven
@@ -360,6 +402,13 @@ class ExternalTimeWindow(WindowProcessor):
 
     def find_events(self) -> list[StreamEvent]:
         return [e for _, e in self.buffer]
+
+    def snapshot_state(self) -> dict:
+        return {"buffer": [(ets, e.timestamp, list(e.data))
+                           for ets, e in self.buffer]}
+
+    def restore_state(self, state: dict) -> None:
+        self.buffer = [(ets, StreamEvent(t, d)) for ets, t, d in state["buffer"]]
 
 
 class ExternalTimeBatchWindow(WindowProcessor):
@@ -398,6 +447,16 @@ class ExternalTimeBatchWindow(WindowProcessor):
 
     def find_events(self) -> list[StreamEvent]:
         return list(self.last_batch) + list(self.pending)
+
+    def snapshot_state(self) -> dict:
+        return {"pending": [(e.timestamp, list(e.data)) for e in self.pending],
+                "last": [(e.timestamp, list(e.data)) for e in self.last_batch],
+                "boundary": self.boundary}
+
+    def restore_state(self, state: dict) -> None:
+        self.pending = [StreamEvent(t, d) for t, d in state["pending"]]
+        self.last_batch = [StreamEvent(t, d) for t, d in state["last"]]
+        self.boundary = state["boundary"]
 
 
 # ---------------------------------------------------------------------------
@@ -453,6 +512,21 @@ class SessionWindow(WindowProcessor):
     def find_events(self) -> list[StreamEvent]:
         return [e for s in self.sessions.values() for e in s["events"]]
 
+    def snapshot_state(self) -> dict:
+        return {"sessions": {
+            key: {"events": [(e.timestamp, list(e.data)) for e in s["events"]],
+                  "last_ts": s["last_ts"]}
+            for key, s in self.sessions.items()}}
+
+    def restore_state(self, state: dict) -> None:
+        self.sessions = {
+            key: {"events": [StreamEvent(t, d) for t, d in s["events"]],
+                  "last_ts": s["last_ts"]}
+            for key, s in state["sessions"].items()}
+        for s in self.sessions.values():
+            self.app_context.scheduler.notify_at(
+                s["last_ts"] + self.gap + self.allowed_latency, self._on_timer)
+
 
 # ---------------------------------------------------------------------------
 # sort / frequent / lossyFrequent
@@ -492,6 +566,12 @@ class SortWindow(WindowProcessor):
 
     def find_events(self) -> list[StreamEvent]:
         return list(self.buffer)
+
+    def snapshot_state(self) -> dict:
+        return {"buffer": [(e.timestamp, list(e.data)) for e in self.buffer]}
+
+    def restore_state(self, state: dict) -> None:
+        self.buffer = [StreamEvent(t, d) for t, d in state["buffer"]]
 
 
 class _Reversed:
@@ -546,6 +626,16 @@ class FrequentWindow(WindowProcessor):
     def find_events(self) -> list[StreamEvent]:
         return [v[1] for v in self.counts.values()]
 
+    def snapshot_state(self) -> dict:
+        return {"counts": [
+            (key, c, e.timestamp, list(e.data))
+            for key, (c, e) in self.counts.items()]}
+
+    def restore_state(self, state: dict) -> None:
+        self.counts = OrderedDict(
+            (tuple(key), [c, StreamEvent(t, d)])
+            for key, c, t, d in state["counts"])
+
 
 class LossyFrequentWindow(WindowProcessor):
     """Lossy-counting frequent-items window."""
@@ -590,6 +680,16 @@ class LossyFrequentWindow(WindowProcessor):
 
     def find_events(self) -> list[StreamEvent]:
         return [v[2] for v in self.counts.values()]
+
+    def snapshot_state(self) -> dict:
+        return {"total": self.total,
+                "counts": [(key, f, dlt, e.timestamp, list(e.data))
+                           for key, (f, dlt, e) in self.counts.items()]}
+
+    def restore_state(self, state: dict) -> None:
+        self.total = state["total"]
+        self.counts = {tuple(key): [f, dlt, StreamEvent(t, d)]
+                       for key, f, dlt, t, d in state["counts"]}
 
 
 # ---------------------------------------------------------------------------
@@ -645,6 +745,18 @@ class HoppingWindow(WindowProcessor):
 
     def find_events(self) -> list[StreamEvent]:
         return list(self.buffer)
+
+    def snapshot_state(self) -> dict:
+        return {"buffer": [(e.timestamp, list(e.data)) for e in self.buffer],
+                "last": [(e.timestamp, list(e.data)) for e in self.last_batch],
+                "boundary": self.boundary}
+
+    def restore_state(self, state: dict) -> None:
+        self.buffer = [StreamEvent(t, d) for t, d in state["buffer"]]
+        self.last_batch = [StreamEvent(t, d) for t, d in state["last"]]
+        self.boundary = state["boundary"]
+        if self.boundary is not None:
+            self.app_context.scheduler.notify_at(self.boundary, self._on_timer)
 
 
 # ---------------------------------------------------------------------------
@@ -742,3 +854,15 @@ class CronWindow(WindowProcessor):
 
     def find_events(self) -> list[StreamEvent]:
         return list(self.last_batch) + list(self.pending)
+
+    def snapshot_state(self) -> dict:
+        return {"pending": [(e.timestamp, list(e.data)) for e in self.pending],
+                "last": [(e.timestamp, list(e.data)) for e in self.last_batch],
+                "armed": self._armed}
+
+    def restore_state(self, state: dict) -> None:
+        self.pending = [StreamEvent(t, d) for t, d in state["pending"]]
+        self.last_batch = [StreamEvent(t, d) for t, d in state["last"]]
+        self._armed = False
+        if state.get("armed"):
+            self._arm(self.app_context.current_time())

@@ -1,0 +1,17 @@
+"""TPU compiled path: columnar ingress, vectorized query programs, NFA kernels.
+
+Everything here is jit-compiled XLA (plus Pallas kernels for the hottest ops);
+all mutable state lives in pytrees carried through the step functions, so
+checkpointing is ``device_get`` and multi-chip scaling is ``shard_map`` over a
+``jax.sharding.Mesh`` (see ``partition.py``).
+"""
+
+import jax
+
+# The engine carries aggregate state in float64/int64; enable x64 before use.
+jax.config.update("jax_enable_x64", True)
+
+from .batch import BatchBuilder, BatchSchema, StringDictionary, columns_from_rows
+from .expr_compile import ColumnResolver, DeviceCompileError, compile_expression
+from .query_compile import CompiledStreamQuery
+from .runtime import DeviceStreamRuntime

@@ -1,0 +1,144 @@
+"""Batching ingress: host rows → columnar (SoA) device micro-batches.
+
+The TPU-native replacement for the reference's per-event ``StreamEvent`` pooling
+(``event/stream/StreamEvent.java``) and the Disruptor ring ingress
+(``StreamJunction.java:279``): events pack into fixed-capacity dense columns
+(one array per attribute, dtype per ``DataType``), plus a timestamp column and a
+validity mask for padding. Strings dictionary-encode to int32 codes host-side.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from ..query_api.definition import DataType, StreamDefinition
+
+
+class StringDictionary:
+    """Host-side string→code dictionary (per attribute).
+
+    Code 0 is reserved for None/unknown so device comparisons against missing
+    values are always false for real codes.
+    """
+
+    def __init__(self):
+        self._codes: dict[str, int] = {}
+        self._values: list[Optional[str]] = [None]
+
+    def encode(self, s: Optional[str]) -> int:
+        if s is None:
+            return 0
+        c = self._codes.get(s)
+        if c is None:
+            c = len(self._values)
+            self._codes[s] = c
+            self._values.append(s)
+        return c
+
+    def decode(self, code: int) -> Optional[str]:
+        if 0 <= code < len(self._values):
+            return self._values[code]
+        return None
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+
+@dataclass
+class BatchSchema:
+    """Column layout for one stream."""
+
+    definition: StreamDefinition
+    dictionaries: dict[str, StringDictionary] = field(default_factory=dict)
+
+    def __post_init__(self):
+        # one shared dictionary: codes comparable across string columns
+        shared = None
+        for a in self.definition.attributes:
+            if a.type == DataType.STRING:
+                if shared is None:
+                    shared = self.dictionaries.get(a.name) or StringDictionary()
+                self.dictionaries.setdefault(a.name, shared)
+
+    @property
+    def names(self) -> list[str]:
+        return self.definition.attribute_names
+
+    def np_dtype(self, name: str) -> np.dtype:
+        t = self.definition.attribute_type(name)
+        if t == DataType.OBJECT:
+            raise TypeError(
+                f"attribute '{name}': OBJECT attributes are host-only and cannot "
+                "enter the device path")
+        return np.dtype(t.numpy_dtype)
+
+    def encode_value(self, name: str, v: Any):
+        t = self.definition.attribute_type(name)
+        if t == DataType.STRING:
+            return self.dictionaries[name].encode(v)
+        if v is None:
+            return 0
+        return v
+
+
+class BatchBuilder:
+    """Accumulates rows into numpy staging buffers; emits padded micro-batches.
+
+    The double-buffered host ring of the reference's async junction maps to: fill
+    one staging buffer while the device consumes the previous batch.
+    """
+
+    def __init__(self, schema: BatchSchema, capacity: int):
+        self.schema = schema
+        self.capacity = capacity
+        self._cols = {
+            n: np.zeros(capacity, dtype=schema.np_dtype(n)) for n in schema.names
+        }
+        self._ts = np.zeros(capacity, dtype=np.int64)
+        self._n = 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    @property
+    def full(self) -> bool:
+        return self._n >= self.capacity
+
+    def append(self, row: list, ts: int) -> None:
+        if self._n >= self.capacity:
+            raise OverflowError("micro-batch full; call emit() first")
+        i = self._n
+        for name, v in zip(self.schema.names, row):
+            self._cols[name][i] = self.schema.encode_value(name, v)
+        self._ts[i] = ts
+        self._n += 1
+
+    def append_rows(self, rows: list[list], ts_list) -> None:
+        for row, ts in zip(rows, ts_list):
+            self.append(row, ts)
+
+    def emit(self) -> dict:
+        """Returns {'cols': {name: np[capacity]}, 'ts', 'valid', 'count'} and
+        resets. Arrays are padded to capacity (static shapes for jit)."""
+        valid = np.zeros(self.capacity, dtype=bool)
+        valid[: self._n] = True
+        out = {
+            "cols": {n: self._cols[n].copy() for n in self.schema.names},
+            "ts": self._ts.copy(),
+            "valid": valid,
+            "count": self._n,
+        }
+        self._n = 0
+        return out
+
+
+def columns_from_rows(schema: BatchSchema, rows: list[list],
+                      ts_list: list[int], capacity: Optional[int] = None) -> dict:
+    """One-shot convenience: rows → padded column batch."""
+    cap = capacity or len(rows)
+    b = BatchBuilder(schema, cap)
+    b.append_rows(rows, ts_list)
+    return b.emit()

@@ -1,0 +1,210 @@
+"""Expression AST → vectorized jnp program.
+
+The TPU replacement for the reference's per-event executor-tree interpretation
+(``executor/ExpressionExecutor.execute`` per event, ~17 typed classes per compare
+operator): one build-time pass emits a closure over column arrays; XLA fuses the
+whole condition into a single elementwise kernel over the micro-batch.
+
+Programs take ``cols: dict[str, jnp.ndarray]`` (plus ``__ts__``) and return an
+array of shape [B]. String constants are dictionary-encoded at trace time, so
+string equality becomes int32 compare on codes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from ..query_api import (
+    And,
+    AttributeFunction,
+    Compare,
+    CompareOp,
+    Constant,
+    DataType,
+    Expression,
+    MathExpr,
+    MathOp,
+    Minus,
+    Not,
+    Or,
+    Variable,
+)
+from .batch import BatchSchema
+
+
+class DeviceCompileError(Exception):
+    """Raised when an expression cannot run on the device path (host fallback)."""
+
+
+_NUM_ORDER = [DataType.INT, DataType.LONG, DataType.FLOAT, DataType.DOUBLE]
+
+
+def promote(a: DataType, b: DataType) -> DataType:
+    if a in _NUM_ORDER and b in _NUM_ORDER:
+        return _NUM_ORDER[max(_NUM_ORDER.index(a), _NUM_ORDER.index(b))]
+    if a == b:
+        return a
+    raise DeviceCompileError(f"cannot promote {a} and {b} on device")
+
+
+class ColumnResolver:
+    """Maps a Variable to a column key + dtype. Single-stream queries use bare
+    attribute names; pattern/join compilers subclass with prefixed keys."""
+
+    def __init__(self, schema: BatchSchema):
+        self.schema = schema
+
+    def resolve(self, var: Variable) -> tuple[str, DataType]:
+        d = self.schema.definition
+        if var.attribute not in d.attribute_names:
+            raise DeviceCompileError(f"unknown attribute '{var.attribute}'")
+        return var.attribute, d.attribute_type(var.attribute)
+
+    def encode_string(self, attr_key: str, value: str) -> int:
+        dic = self.schema.dictionaries.get(attr_key)
+        if dic is None:
+            raise DeviceCompileError(f"no dictionary for '{attr_key}'")
+        return dic.encode(value)
+
+
+def compile_expression(expr: Expression, resolver: ColumnResolver
+                       ) -> tuple[Callable[[dict], jnp.ndarray], DataType]:
+    """Returns (fn(cols)->jnp array [B], result dtype)."""
+
+    if isinstance(expr, Constant):
+        if expr.type == DataType.STRING:
+            raise DeviceCompileError(
+                "bare string constant needs a comparison context for encoding")
+        v = expr.value
+        return (lambda cols, v=v: v), expr.type
+
+    if isinstance(expr, Variable):
+        key, t = resolver.resolve(expr)
+        return (lambda cols, key=key: cols[key]), t
+
+    if isinstance(expr, And):
+        lf, _ = compile_expression(expr.left, resolver)
+        rf, _ = compile_expression(expr.right, resolver)
+        return (lambda cols: jnp.logical_and(lf(cols), rf(cols))), DataType.BOOL
+
+    if isinstance(expr, Or):
+        lf, _ = compile_expression(expr.left, resolver)
+        rf, _ = compile_expression(expr.right, resolver)
+        return (lambda cols: jnp.logical_or(lf(cols), rf(cols))), DataType.BOOL
+
+    if isinstance(expr, Not):
+        f, _ = compile_expression(expr.expr, resolver)
+        return (lambda cols: jnp.logical_not(f(cols))), DataType.BOOL
+
+    if isinstance(expr, Compare):
+        return _compile_compare(expr, resolver)
+
+    if isinstance(expr, MathExpr):
+        lf, lt = compile_expression(expr.left, resolver)
+        rf, rt = compile_expression(expr.right, resolver)
+        rtype = promote(lt, rt)
+        op = expr.op
+        int_result = rtype in (DataType.INT, DataType.LONG)
+
+        def run(cols):
+            a, b = lf(cols), rf(cols)
+            if op == MathOp.ADD:
+                return a + b
+            if op == MathOp.SUB:
+                return a - b
+            if op == MathOp.MUL:
+                return a * b
+            if op == MathOp.DIV:
+                if int_result:
+                    # Java semantics: truncation toward zero
+                    q = jnp.abs(a) // jnp.abs(b)
+                    return jnp.where((a >= 0) == (b >= 0), q, -q)
+                return a / b
+            if int_result:
+                return a - b * jnp.trunc(a / b).astype(a.dtype) if a.dtype.kind == 'f' \
+                    else jnp.sign(a) * (jnp.abs(a) % jnp.abs(b))
+            return jnp.sign(a) * jnp.abs(jnp.fmod(a, b)) if False else jnp.fmod(a, b)
+
+        return run, rtype
+
+    if isinstance(expr, Minus):
+        f, t = compile_expression(expr.expr, resolver)
+        return (lambda cols: -f(cols)), t
+
+    if isinstance(expr, AttributeFunction):
+        return _compile_function(expr, resolver)
+
+    raise DeviceCompileError(f"expression {type(expr).__name__} not device-compilable")
+
+
+def _compile_compare(expr: Compare, resolver: ColumnResolver):
+    # string comparisons: only EQ/NEQ, via dictionary codes
+    def side(e: Expression, other: Expression):
+        if isinstance(e, Constant) and e.type == DataType.STRING:
+            if not isinstance(other, Variable):
+                raise DeviceCompileError("string constant must compare to a column")
+            key, t = resolver.resolve(other)
+            if t != DataType.STRING:
+                raise DeviceCompileError("string constant vs non-string column")
+            code = resolver.encode_string(key, e.value)
+            return (lambda cols, code=code: code), DataType.STRING
+        return compile_expression(e, resolver)
+
+    lf, lt = side(expr.left, expr.right)
+    rf, rt = side(expr.right, expr.left)
+    if (lt == DataType.STRING) != (rt == DataType.STRING):
+        raise DeviceCompileError("string vs non-string comparison")
+    if lt == DataType.STRING and expr.op not in (CompareOp.EQ, CompareOp.NEQ):
+        raise DeviceCompileError("string ordering not supported on device")
+    op = expr.op
+
+    def run(cols):
+        a, b = lf(cols), rf(cols)
+        if op == CompareOp.EQ:
+            return a == b
+        if op == CompareOp.NEQ:
+            return a != b
+        if op == CompareOp.LT:
+            return a < b
+        if op == CompareOp.LE:
+            return a <= b
+        if op == CompareOp.GT:
+            return a > b
+        return a >= b
+
+    return run, DataType.BOOL
+
+
+def _compile_function(expr: AttributeFunction, resolver: ColumnResolver):
+    name = expr.name if expr.namespace is None else f"{expr.namespace}:{expr.name}"
+    if name == "ifThenElse":
+        c, _ = compile_expression(expr.args[0], resolver)
+        a, ta = compile_expression(expr.args[1], resolver)
+        b, tb = compile_expression(expr.args[2], resolver)
+        return (lambda cols: jnp.where(c(cols), a(cols), b(cols))), promote(ta, tb)
+    if name in ("convert", "cast"):
+        src, _ = compile_expression(expr.args[0], resolver)
+        target = expr.args[1]
+        if not isinstance(target, Constant):
+            raise DeviceCompileError("convert target must be constant")
+        tmap = {"int": (jnp.int32, DataType.INT), "long": (jnp.int64, DataType.LONG),
+                "float": (jnp.float32, DataType.FLOAT),
+                "double": (jnp.float64, DataType.DOUBLE),
+                "bool": (jnp.bool_, DataType.BOOL)}
+        if str(target.value).lower() not in tmap:
+            raise DeviceCompileError(f"convert to {target.value!r} not on device")
+        jdt, dt = tmap[str(target.value).lower()]
+        return (lambda cols: src(cols).astype(jdt)), dt
+    if name == "eventTimestamp" and not expr.args:
+        return (lambda cols: cols["__ts__"]), DataType.LONG
+    if name == "maximum":
+        fns = [compile_expression(a, resolver) for a in expr.args]
+        t = fns[0][1]
+        return (lambda cols: jnp.stack([f(cols) for f, _ in fns]).max(0)), t
+    if name == "minimum":
+        fns = [compile_expression(a, resolver) for a in expr.args]
+        t = fns[0][1]
+        return (lambda cols: jnp.stack([f(cols) for f, _ in fns]).min(0)), t
+    raise DeviceCompileError(f"function '{name}' not device-compilable")

@@ -1,0 +1,706 @@
+"""Compiled NFA: vectorized pattern/sequence matching on device.
+
+The north-star kernel (SURVEY §7 phase 3). The reference's per-event,
+per-partial-match interpretation (``StreamPreStateProcessor.processAndReturn``,
+unbounded cloned ``StateEvent`` lists) becomes:
+
+- the state-element tree compiles (reusing the host ``PatternCompiler``) to a
+  *linear chain* of stream/count states with per-state predicate programs;
+- partial matches live in **fixed-capacity match tables** — one slot table per
+  state, holding the bound attribute values the downstream predicates/output
+  actually reference, plus first-bind timestamps and (for ``<m:n>``) counters;
+- one jitted ``lax.scan`` walks the micro-batch; each step updates every state's
+  table with vectorized slot math (predicates evaluate over all C slots at
+  once), states processed in reverse order so one event can't advance a partial
+  twice;
+- ``every`` is a carried seed counter (replenished when its scope completes),
+  ``within`` is a timestamp mask that also reclaims expired slots, slot
+  exhaustion is an explicit drop-newest policy with an overflow counter.
+
+Scope (host interpreter is the fallback for the rest): linear chains of
+stream/count states over one or more input streams, ``every`` scopes starting at
+state 0, stream-level ``within``, final state must be a stream state. Logical
+(and/or), absent, element-level within, and `e[k]` indexing beyond first/last
+stay on the host path this round.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.pattern import CompiledPattern, PatternCompiler
+from ..query_api import (
+    Query,
+    StateInputStream,
+    Variable,
+)
+from ..query_api.definition import DataType, StreamDefinition
+from .batch import StringDictionary
+from .expr_compile import DeviceCompileError, compile_expression
+
+_JNP = {
+    DataType.STRING: jnp.int32,
+    DataType.INT: jnp.int32,
+    DataType.LONG: jnp.int64,
+    DataType.FLOAT: jnp.float32,
+    DataType.DOUBLE: jnp.float64,
+    DataType.BOOL: jnp.bool_,
+}
+_NP = {
+    DataType.STRING: np.int32,
+    DataType.INT: np.int32,
+    DataType.LONG: np.int64,
+    DataType.FLOAT: np.float32,
+    DataType.DOUBLE: np.float64,
+    DataType.BOOL: np.bool_,
+}
+
+
+# ---------------------------------------------------------------------------
+# merged multi-stream batches
+# ---------------------------------------------------------------------------
+
+class MergedBatchSchema:
+    """Union columns over the pattern's streams + a stream tag per event."""
+
+    def __init__(self, stream_defs: dict[str, StreamDefinition], stream_ids: list[str]):
+        self.stream_ids = stream_ids
+        self.stream_index = {sid: i for i, sid in enumerate(stream_ids)}
+        self.columns: dict[str, DataType] = {}       # "s{i}_{attr}" -> dtype
+        # ONE dictionary shared by every string column: cross-column equality
+        # (`e2.sym == e1.sym` across streams) must compare comparable codes
+        shared = StringDictionary()
+        self.dictionaries: dict[str, StringDictionary] = {}
+        for i, sid in enumerate(stream_ids):
+            d = stream_defs[sid]
+            for a in d.attributes:
+                key = f"s{i}_{a.name}"
+                self.columns[key] = a.type
+                if a.type == DataType.STRING:
+                    self.dictionaries[key] = shared
+
+    def col_key(self, stream_id: str, attr: str) -> str:
+        return f"s{self.stream_index[stream_id]}_{attr}"
+
+
+class MergedBatchBuilder:
+    def __init__(self, schema: MergedBatchSchema, capacity: int,
+                 stream_defs: dict[str, StreamDefinition]):
+        self.schema = schema
+        self.capacity = capacity
+        self.stream_defs = stream_defs
+        self._cols = {
+            key: np.zeros(capacity, dtype=_NP[t])
+            for key, t in schema.columns.items()
+        }
+        self._tag = np.zeros(capacity, dtype=np.int32)
+        self._ts = np.zeros(capacity, dtype=np.int64)
+        self._n = 0
+
+    def __len__(self):
+        return self._n
+
+    @property
+    def full(self) -> bool:
+        return self._n >= self.capacity
+
+    def append(self, stream_id: str, row: list, ts: int) -> None:
+        i = self._n
+        si = self.schema.stream_index[stream_id]
+        d = self.stream_defs[stream_id]
+        for a, v in zip(d.attributes, row):
+            key = f"s{si}_{a.name}"
+            if a.type == DataType.STRING:
+                v = self.schema.dictionaries[key].encode(v)
+            self._cols[key][i] = 0 if v is None else v
+        self._tag[i] = si
+        self._ts[i] = ts
+        self._n += 1
+
+    def emit(self) -> dict:
+        valid = np.zeros(self.capacity, dtype=bool)
+        valid[: self._n] = True
+        out = {
+            "cols": {k: v.copy() for k, v in self._cols.items()},
+            "tag": self._tag.copy(),
+            "ts": self._ts.copy(),
+            "valid": valid,
+            "count": self._n,
+        }
+        self._n = 0
+        return out
+
+
+# ---------------------------------------------------------------------------
+# compilation
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _DevState:
+    index: int
+    kind: str                    # 'stream' | 'count'
+    stream_idx: int
+    alias: str
+    predicate: Optional[Callable]    # fn(env) -> bool/[C]
+    min_count: int = 1
+    max_count: int = 1
+    ends_every: bool = False     # reseed scope [0..index]
+
+
+class _NFAResolver:
+    """Resolves Variables inside predicates/output of the device NFA.
+
+    Namespace env keys:
+      ``ev_{attr-key}``    — candidate event scalar (merged column key)
+      ``b{q}_{attr}``      — bound value arrays of prior state q  [C]
+      ``b{q}_first_{attr}`` / ``b{q}_last_{attr}`` — count-state variants
+    """
+
+    def __init__(self, nfa: "DeviceNFACompiler", current_state: int):
+        self.nfa = nfa
+        self.current = current_state
+
+    def resolve(self, var: Variable) -> tuple[str, DataType]:
+        nfa = self.nfa
+        alias = var.stream_id
+        cur = nfa.states[self.current] if self.current is not None else None
+        if alias is None or (cur is not None and alias == cur.alias):
+            if cur is None:
+                raise DeviceCompileError("bare attribute outside a state context")
+            sid = nfa.compiled.alias_defs[cur.alias].id
+            key = nfa.merged.col_key(sid, var.attribute)
+            if var.attribute not in nfa.compiled.alias_defs[cur.alias].attribute_names:
+                raise DeviceCompileError(f"unknown attribute '{var.attribute}'")
+            return f"ev_{key}", nfa.merged.columns[key]
+        if alias not in nfa.alias_state:
+            raise DeviceCompileError(f"unknown alias '{alias}'")
+        q = nfa.alias_state[alias]
+        d = nfa.compiled.alias_defs[alias]
+        if var.attribute not in d.attribute_names:
+            raise DeviceCompileError(f"unknown attribute '{var.attribute}'")
+        t = d.attribute_type(var.attribute)
+        if nfa.states[q].kind == "count":
+            if var.stream_index == 0:
+                variant = f"b{q}_first_{var.attribute}"
+            else:          # last / None
+                variant = f"b{q}_last_{var.attribute}"
+        else:
+            if var.stream_index not in (None,):
+                from ..query_api.expression import LAST_INDEX
+                if var.stream_index not in (0, LAST_INDEX):
+                    raise DeviceCompileError("e[k] indexing needs host path")
+            variant = f"b{q}_{var.attribute}"
+        nfa.referenced.add((q, variant, t))
+        return variant, t
+
+    def encode_string(self, key: str, value: str) -> int:
+        # key may be ev_{merged} or b{q}_...: map back to the merged dictionary
+        if key.startswith("ev_"):
+            mk = key[3:]
+        else:
+            # bound col: find source merged key via alias
+            parts = key.split("_", 1)
+            q = int(parts[0].lstrip("b").split("_")[0]) if False else None
+            mk = self._bound_to_merged(key)
+        dic = self.nfa.merged.dictionaries.get(mk)
+        if dic is None:
+            raise DeviceCompileError(f"no dictionary for '{key}'")
+        return dic.encode(value)
+
+    def _bound_to_merged(self, key: str) -> str:
+        # b{q}[_first|_last]_{attr}
+        body = key[1:]
+        q_str, rest = body.split("_", 1)
+        q = int(q_str)
+        for pref in ("first_", "last_"):
+            if rest.startswith(pref):
+                rest = rest[len(pref):]
+        alias = self.nfa.states[q].alias
+        sid = self.nfa.compiled.alias_defs[alias].id
+        return self.nfa.merged.col_key(sid, rest)
+
+
+class DeviceNFACompiler:
+    def __init__(self, query: Query, stream_defs: dict[str, StreamDefinition],
+                 slot_capacity: int = 64, batch_capacity: int = 1024):
+        ist = query.input_stream
+        if not isinstance(ist, StateInputStream):
+            raise DeviceCompileError("not a pattern/sequence query")
+        self.query = query
+        self.C = slot_capacity
+        self.B = batch_capacity
+        self.compiled: CompiledPattern = PatternCompiler(ist, stream_defs).compile()
+        self.is_sequence = self.compiled.is_sequence
+        self.within = self.compiled.within_ms
+        self.merged = MergedBatchSchema(stream_defs, self.compiled.stream_ids)
+        self.stream_defs = stream_defs
+
+        # validate + lower nodes
+        self.states: list[_DevState] = []
+        self.alias_state: dict[str, int] = {}
+        self.referenced: set[tuple[int, str, DataType]] = set()
+        nodes = self.compiled.nodes
+        for node in nodes:
+            if node.kind not in ("stream", "count"):
+                raise DeviceCompileError(
+                    f"'{node.kind}' states need the host path")
+            if node.within_ms is not None:
+                raise DeviceCompileError("element-level within needs host path")
+            if node.reseed_to not in (None, 0):
+                raise DeviceCompileError("`every` scope must start the pattern")
+            b = node.branches[0]
+            sid_idx = self.merged.stream_index[b.stream_id]
+            st = _DevState(
+                index=node.index, kind=node.kind, stream_idx=sid_idx,
+                alias=b.alias, predicate=None,
+                min_count=node.min_count, max_count=node.max_count,
+                ends_every=node.reseed_to == 0,
+            )
+            self.states.append(st)
+            self.alias_state[b.alias] = node.index
+        if self.states[-1].kind != "stream":
+            raise DeviceCompileError("final count state needs the host path")
+
+        self.S = len(self.states)
+        self.always_seed = self.states[0].ends_every and self.S == 1 or \
+            (self.states[0].ends_every)
+        # group-every: scope end j > 0 → seeds replenished on state j advance
+        self.every_end = next(
+            (s.index for s in self.states if s.ends_every), None)
+
+        # compile predicates (after alias map ready) from the original ASTs
+        self._compile_predicates(ist)
+        # output programs
+        self._compile_output(query)
+        self._step = jax.jit(self._make_step(), donate_argnums=(0,))
+
+    def _compile_predicates(self, ist: StateInputStream) -> None:
+        # recover filter ASTs from the host compiler's branch filters is not
+        # possible (already closures), so re-walk the AST tree in node order
+        from ..query_api import (
+            CountStateElement,
+            EveryStateElement,
+            Filter,
+            NextStateElement,
+            StreamStateElement,
+        )
+        filters: list[Any] = []
+
+        def walk(el):
+            if isinstance(el, NextStateElement):
+                walk(el.first)
+                walk(el.next)
+            elif isinstance(el, EveryStateElement):
+                walk(el.inner)
+            elif isinstance(el, StreamStateElement):
+                filters.append(_filter_of(el.stream))
+            elif isinstance(el, CountStateElement):
+                filters.append(_filter_of(el.stream.stream))
+            else:
+                raise DeviceCompileError(
+                    f"{type(el).__name__} needs the host path")
+
+        def _filter_of(stream):
+            ast = None
+            from ..query_api import And
+            for h in stream.handlers:
+                if isinstance(h, Filter):
+                    ast = h.expr if ast is None else And(ast, h.expr)
+            return ast
+
+        walk(ist.state)
+        assert len(filters) == self.S
+        for s, ast in zip(self.states, filters):
+            if ast is None:
+                s.predicate = None
+            else:
+                resolver = _NFAResolver(self, s.index)
+                fn, _ = compile_expression(ast, resolver)
+                s.predicate = fn
+
+    def _compile_output(self, query: Query) -> None:
+        sel = query.selector
+        self.out_specs: list[tuple[str, Callable, DataType]] = []
+        attrs = sel.attributes
+        if sel.select_all or not attrs:
+            raise DeviceCompileError("pattern select * needs the host path")
+        final = self.S - 1
+        for oa in attrs:
+            resolver = _NFAResolver(self, final)
+            fn, t = compile_expression(oa.expr, resolver)
+            self.out_specs.append((oa.name, fn, t))
+
+    # ------------------------------------------------------------------ state
+    def init_state(self) -> dict:
+        C, S = self.C, self.S
+        pend = {}
+        for s in range(S):
+            fields: dict[str, Any] = {
+                "valid": jnp.zeros((C,), jnp.bool_),
+                "first_ts": jnp.zeros((C,), jnp.int64),
+            }
+            if self.states[s].kind == "count":
+                fields["count"] = jnp.zeros((C,), jnp.int32)
+                fields["closed"] = jnp.zeros((C,), jnp.bool_)
+            for (q, key, t) in self.referenced:
+                if q < s or (q == s and self.states[s].kind == "count"):
+                    fields[key] = jnp.zeros((C,), _JNP[t])
+            pend[f"p{s}"] = fields
+        return {
+            "pending": pend,
+            "seeds": jnp.array(1, jnp.int64),
+            "drops": jnp.array(0, jnp.int64),
+            "matches": jnp.array(0, jnp.int64),
+        }
+
+    # ------------------------------------------------------------------- step
+    def _make_step(self):
+        C, S = self.C, self.S
+        states = self.states
+        within = self.within
+        is_seq = self.is_sequence
+        always_seed = self.states[0].ends_every
+        every_end = self.every_end
+        out_specs = self.out_specs
+        referenced = sorted(self.referenced)
+        n_out = len(out_specs)
+
+        def bound_keys_for(level: int):
+            st = states[level]
+            return [key for (q, key, t) in referenced
+                    if q < level or (q == level and st.kind == "count")]
+
+        def insert(slots: dict, ins_mask, values: dict, ts_new, counts_new=None):
+            """Scatter candidates (ins_mask over [C]) into free slots. Returns
+            (new_slots, n_dropped)."""
+            free = ~slots["valid"]
+            free_rank = jnp.cumsum(free.astype(jnp.int32)) - 1     # rank among free
+            ins_rank = jnp.cumsum(ins_mask.astype(jnp.int32)) - 1  # rank among inserts
+            n_free = jnp.sum(free.astype(jnp.int32))
+            n_ins = jnp.sum(ins_mask.astype(jnp.int32))
+            # map free_rank -> slot index so insert j targets the j-th free slot
+            slot_of_rank = jnp.zeros((C,), jnp.int32).at[
+                jnp.where(free, free_rank, C - 1)].set(
+                jnp.where(free, jnp.arange(C, dtype=jnp.int32), 0), mode="drop")
+            ok = ins_mask & (ins_rank < n_free)
+            tgt = jnp.where(ok, slot_of_rank[jnp.clip(ins_rank, 0, C - 1)], C)
+            new = dict(slots)
+            new["valid"] = slots["valid"].at[tgt].set(
+                jnp.where(ok, True, False), mode="drop")
+            new["first_ts"] = slots["first_ts"].at[tgt].set(
+                jnp.where(ok, ts_new, 0), mode="drop")
+            if "count" in slots:
+                cnew = counts_new if counts_new is not None else jnp.ones((C,), jnp.int32)
+                new["count"] = slots["count"].at[tgt].set(
+                    jnp.where(ok, cnew, 0), mode="drop")
+                new["closed"] = slots["closed"].at[tgt].set(False, mode="drop")
+            for key, arr in values.items():
+                if key in slots:
+                    new[key] = slots[key].at[tgt].set(
+                        jnp.where(ok, arr, jnp.zeros((), arr.dtype)), mode="drop")
+            dropped = jnp.maximum(n_ins - n_free, 0)
+            inserted = jnp.zeros((C,), jnp.bool_).at[tgt].set(ok, mode="drop")
+            return new, dropped, inserted
+
+        def step_event(carry, ev):
+            pend = dict(carry["pending"])
+            seeds = carry["seeds"]
+            drops = carry["drops"]
+            n_match = carry["matches"]
+            ev_ts = ev["ts"]
+            ev_tag = ev["tag"]
+            ev_ok = ev["valid"]
+
+            # within-expiry reclaims slots
+            if within is not None:
+                for s in range(S):
+                    slots = dict(pend[f"p{s}"])
+                    has_first = slots["first_ts"] > 0
+                    alive = ~(has_first & (ev_ts - slots["first_ts"] > within))
+                    slots["valid"] = slots["valid"] & alive
+                    pend[f"p{s}"] = slots
+
+            out_mask = jnp.zeros((2, C), jnp.bool_)
+            out_cols = [jnp.zeros((2, C), _JNP[t]) for (_, _, t) in out_specs]
+            touched = {s: jnp.zeros((C,), jnp.bool_) for s in range(S)}
+
+            def env_for(level: int, ev):
+                env = {f"ev_{k}": ev["cols"][k] for k in ev["cols"]}
+                env.update({key: pend[f"p{level}"][key]
+                            for key in bound_keys_for(level)
+                            if key in pend[f"p{level}"]})
+                return env
+
+            seed_pred_cache = {}
+
+            for s in range(S - 1, -1, -1):
+                st = states[s]
+                gate = ev_ok & (ev_tag == st.stream_idx)
+                # ---- candidate source A: pending[s]
+                slots = pend[f"p{s}"]
+                env = env_for(s, ev)
+                pred = jnp.ones((C,), jnp.bool_) if st.predicate is None \
+                    else jnp.broadcast_to(st.predicate(env), (C,))
+                if st.kind == "count":
+                    ext = slots["valid"] & ~slots["closed"] & pred & gate
+                    new_slots = dict(slots)
+                    new_slots["count"] = slots["count"] + ext.astype(jnp.int32)
+                    # update last-bound values for extended slots
+                    for (q, key, t) in referenced:
+                        if q == s and key.startswith(f"b{s}_last_"):
+                            attr = key[len(f"b{s}_last_"):]
+                            mk = self.merged.col_key(
+                                self.compiled.alias_defs[st.alias].id, attr)
+                            new_slots[key] = jnp.where(
+                                ext, ev["cols"][mk].astype(slots[key].dtype),
+                                slots[key])
+                    if st.max_count != -1:
+                        new_slots["closed"] = new_slots["closed"] | (
+                            new_slots["count"] >= st.max_count)
+                    pend[f"p{s}"] = new_slots
+                    touched[s] = touched[s] | ext
+                else:
+                    # stream state: sources = pending[s] and (if prev is count)
+                    # its eligible slots
+                    sources = [(s, slots["valid"] & pred & gate)]
+                    if s > 0 and states[s - 1].kind == "count":
+                        prev = pend[f"p{s-1}"]
+                        env_p = env_for(s - 1, ev)
+                        pred_p = jnp.ones((C,), jnp.bool_) if st.predicate is None \
+                            else jnp.broadcast_to(st.predicate(env_p), (C,))
+                        elig = prev["valid"] & (
+                            prev["count"] >= states[s - 1].min_count)
+                        sources.append((s - 1, elig & pred_p & gate))
+
+                    for src_i, (lvl, matched) in enumerate(sources):
+                        src = pend[f"p{lvl}"]
+                        touched[lvl] = touched[lvl] | matched
+                        # gather advanced values: all bound cols + new binding
+                        values = {}
+                        for (q, key, t) in referenced:
+                            if key in src and (q < s):
+                                values[key] = src[key]
+                        sid = self.compiled.alias_defs[st.alias].id
+                        for (q, key, t) in referenced:
+                            if q == s:
+                                attr = key[len(f"b{s}_"):]
+                                mk = self.merged.col_key(sid, attr)
+                                values[key] = jnp.broadcast_to(
+                                    ev["cols"][mk].astype(_JNP[t]), (C,))
+                        first_ts_new = jnp.where(
+                            src["first_ts"] > 0, src["first_ts"], ev_ts)
+                        if s == S - 1:
+                            # emit matches
+                            out_mask = out_mask.at[src_i].set(matched)
+                            emit_env = {f"ev_{k}": ev["cols"][k]
+                                        for k in ev["cols"]}
+                            for (q, key, t) in referenced:
+                                if key in src:
+                                    emit_env[key] = src[key]
+                                elif q == s:
+                                    emit_env[key] = values[key]
+                            for oi, (_, fn, t) in enumerate(out_specs):
+                                val = jnp.broadcast_to(
+                                    fn(emit_env), (C,)).astype(out_cols[oi].dtype)
+                                out_cols[oi] = out_cols[oi].at[src_i].set(
+                                    jnp.where(matched, val, 0))
+                            n_match = n_match + jnp.sum(matched)
+                            n_adv = jnp.sum(matched.astype(jnp.int64))
+                        else:
+                            # a count target starts with 0 occurrences (its own
+                            # events arrive later via the extension path)
+                            new_tgt, dropped, inserted = insert(
+                                pend[f"p{s+1}"], matched, values, first_ts_new,
+                                jnp.zeros((C,), jnp.int32))
+                            pend[f"p{s+1}"] = new_tgt
+                            touched[s + 1] = touched[s + 1] | inserted
+                            drops = drops + dropped.astype(jnp.int64)
+                            n_adv = jnp.sum(matched.astype(jnp.int64))
+                        # kill advanced source slots
+                        src_new = dict(pend[f"p{lvl}"])
+                        src_new["valid"] = src_new["valid"] & ~matched
+                        pend[f"p{lvl}"] = src_new
+                        # every-scope completion replenishes seeds; the scope
+                        # ends either at this stream state (lvl == s) or at the
+                        # count state this advance consumed (lvl == s-1)
+                        if every_end == lvl:
+                            seeds = seeds + n_adv
+
+                # ---- seeding at state 0
+                if s == 0:
+                    env0 = {f"ev_{k}": ev["cols"][k] for k in ev["cols"]}
+                    pred0 = True if st.predicate is None else st.predicate(env0)
+                    can_seed = gate & jnp.asarray(pred0) & (
+                        jnp.array(True) if always_seed else seeds > 0)
+                    # seed advances directly into pending[1] (binding ev) or,
+                    # for count state 0, into pending[0] with count=1 — count
+                    # state 0 extension handled above won't double-fire because
+                    # it ran before this insert in the same event
+                    sid = self.compiled.alias_defs[st.alias].id
+                    seed_vals = {}
+                    for (q, key, t) in referenced:
+                        if q == 0:
+                            attr = key[len("b0_"):]
+                            for pref in ("first_", "last_"):
+                                if attr.startswith(pref):
+                                    attr = attr[len(pref):]
+                            mk = self.merged.col_key(sid, attr)
+                            seed_vals[key] = jnp.broadcast_to(
+                                ev["cols"][mk].astype(_JNP[t]), (C,))
+                    ins_mask = jnp.zeros((C,), jnp.bool_).at[0].set(can_seed)
+                    if st.kind == "count":
+                        new0, dropped, inserted = insert(
+                            pend["p0"], ins_mask, seed_vals,
+                            jnp.broadcast_to(ev_ts, (C,)),
+                            jnp.ones((C,), jnp.int32))
+                        pend["p0"] = new0
+                        touched[0] = touched[0] | inserted
+                        # count 1 may already satisfy min → eligibility handled
+                        # next events; if S == 1 impossible (final must be stream)
+                        drops = drops + dropped.astype(jnp.int64)
+                    else:
+                        if S == 1:
+                            # single-state pattern: immediate match
+                            out_mask = out_mask.at[0, 0].set(can_seed)
+                            emit_env = {f"ev_{k}": ev["cols"][k] for k in ev["cols"]}
+                            for (q, key, t) in referenced:
+                                if q == 0:
+                                    emit_env[key] = seed_vals[key]
+                            for oi, (_, fn, t) in enumerate(out_specs):
+                                val = jnp.broadcast_to(
+                                    fn(emit_env), (C,)).astype(out_cols[oi].dtype)
+                                out_cols[oi] = out_cols[oi].at[0].set(
+                                    jnp.where(ins_mask, val, 0))
+                            n_match = n_match + can_seed.astype(jnp.int64)
+                        else:
+                            new1, dropped, inserted = insert(
+                                pend["p1"], ins_mask, seed_vals,
+                                jnp.broadcast_to(ev_ts, (C,)))
+                            pend["p1"] = new1
+                            touched[1] = touched[1] | inserted
+                            drops = drops + dropped.astype(jnp.int64)
+                    if not always_seed:
+                        seeds = seeds - can_seed.astype(jnp.int64)
+
+            # sequence strictness: untouched partials die on any event
+            if is_seq:
+                for s in range(S):
+                    slots = dict(pend[f"p{s}"])
+                    slots["valid"] = slots["valid"] & jnp.where(
+                        ev_ok, touched[s], slots["valid"])
+                    pend[f"p{s}"] = slots
+
+            new_carry = {"pending": pend, "seeds": seeds, "drops": drops,
+                         "matches": n_match}
+            ys = {"mask": out_mask, "ts": ev_ts}
+            for oi, (name, _, _) in enumerate(out_specs):
+                ys[name] = out_cols[oi]
+            return new_carry, ys
+
+        def step(state, cols, tag, ts, valid):
+            def body(carry, xs):
+                ev = {"cols": {k: xs[f"c_{k}"] for k in cols},
+                      "tag": xs["tag"], "ts": xs["ts"], "valid": xs["valid"]}
+                return step_event(carry, ev)
+
+            xs = {f"c_{k}": v for k, v in cols.items()}
+            xs.update({"tag": tag, "ts": ts, "valid": valid})
+            state, ys = jax.lax.scan(body, state, xs)
+            return state, ys
+
+        return step
+
+    # -------------------------------------------------------------- execution
+    def step(self, state, batch: dict):
+        return self._step(state, batch["cols"], batch["tag"], batch["ts"],
+                          batch["valid"])
+
+    def decode_outputs(self, ys) -> list[list]:
+        mask = np.asarray(ys["mask"])              # [B, 2, C]
+        rows = []
+        cols = {name: np.asarray(ys[name]) for (name, _, t) in self.out_specs}
+        # decode dictionary-encoded outputs
+        dec = {}
+        for (name, fn, t) in self.out_specs:
+            dec[name] = t
+        idx = np.argwhere(mask)
+        for b, srci, c in idx:
+            row = []
+            for (name, _, t) in self.out_specs:
+                v = cols[name][b, srci, c]
+                row.append(_decode_scalar(self, name, v, t))
+            rows.append(row)
+        return rows
+
+
+def _decode_scalar(nfa: DeviceNFACompiler, name: str, v, t: DataType):
+    if t == DataType.STRING:
+        # find any dictionary able to decode; outputs referencing string
+        # columns share the merged dictionaries
+        for dic in nfa.merged.dictionaries.values():
+            s = dic.decode(int(v))
+            if s is not None:
+                return s
+        return int(v)
+    if isinstance(v, np.floating):
+        return float(v)
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.bool_):
+        return bool(v)
+    return v
+
+
+class DeviceNFARuntime:
+    """Micro-batching front end over a compiled NFA."""
+
+    def __init__(self, app_or_text, slot_capacity: int = 64,
+                 batch_capacity: int = 1024, query_index: int = 0):
+        from ..compiler import parse as _parse
+        app = _parse(app_or_text) if isinstance(app_or_text, str) else app_or_text
+        query = app.queries[query_index]
+        self.compiler = DeviceNFACompiler(
+            query, dict(app.stream_definitions), slot_capacity, batch_capacity)
+        self.builder = MergedBatchBuilder(
+            self.compiler.merged, batch_capacity, dict(app.stream_definitions))
+        self.state = self.compiler.init_state()
+        self.callback: Optional[Callable[[list[list]], None]] = None
+
+    def add_callback(self, fn) -> None:
+        self.callback = fn
+
+    def send(self, stream_id: str, row: list, timestamp: int) -> None:
+        self.builder.append(stream_id, row, timestamp)
+        if self.builder.full:
+            self.flush()
+
+    def flush(self, decode: bool = True):
+        if len(self.builder) == 0:
+            return None
+        batch = self.builder.emit()
+        self.state, ys = self.compiler.step(self.state, batch)
+        if decode:
+            rows = self.compiler.decode_outputs(ys)
+            if self.callback is not None and rows:
+                self.callback(rows)
+            return rows
+        return ys
+
+    @property
+    def match_count(self) -> int:
+        return int(jax.device_get(self.state["matches"]))
+
+    @property
+    def drop_count(self) -> int:
+        return int(jax.device_get(self.state["drops"]))
+
+    def snapshot_state(self):
+        return jax.device_get(self.state)
+
+    def restore_state(self, state) -> None:
+        self.state = jax.device_put(state)

@@ -1,0 +1,396 @@
+"""Compiled single-stream queries: filter → window → aggregate, fully vectorized.
+
+The TPU-native replacement for the hot path the reference interprets per event
+(``FilterProcessor.process`` → ``LengthWindowProcessor.process`` →
+``QuerySelector.process``; see SURVEY §3.2). Design:
+
+- All mutable runtime state is a pytree carried through the jitted step
+  (checkpoint = ``jax.device_get(state)``, restore = ``device_put``).
+- Sliding ``lengthWindow(N)`` with invertible aggregates (sum/count/avg) avoids
+  any per-event scan: keep the last-N accepted values as a carried *tail buffer*;
+  per-event window aggregates are ``cumsum(concat(tail, batch))`` differences —
+  one fused elementwise pipeline on the VPU.
+- ``lengthBatch(N)`` (tumbling) carries the open batch's events (aggregate args
+  *and* projected columns) as a remainder buffer; emission covers remainder +
+  current arrivals whenever batches complete.
+- Group-by running aggregates use a one-hot [B,K] cumulative contribution
+  (MXU-friendly) with a carried dense per-key state [K].
+- Masked events (filter rejections, padding) are *compacted* with a stable
+  scatter so window semantics see only accepted events.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..query_api import (
+    AttributeFunction,
+    Filter,
+    Query,
+    SingleInputStream,
+    Variable,
+    Window,
+)
+from ..query_api.definition import DataType, StreamDefinition
+from .batch import BatchSchema
+from .expr_compile import ColumnResolver, DeviceCompileError, compile_expression
+
+_INVERTIBLE_AGGS = {"sum", "count", "avg"}
+
+_JNP_DTYPES = {
+    DataType.STRING: jnp.int32,
+    DataType.INT: jnp.int32,
+    DataType.LONG: jnp.int64,
+    DataType.FLOAT: jnp.float32,
+    DataType.DOUBLE: jnp.float64,
+    DataType.BOOL: jnp.bool_,
+}
+
+
+@dataclass
+class _Spec:
+    name: str           # output name
+    kind: str           # 'value' | 'sum' | 'count' | 'avg'
+    fn: Optional[Callable] = None      # projection or aggregate-arg program
+    dtype: DataType = DataType.DOUBLE
+    source_attr: Optional[str] = None  # raw column name for string decode
+
+
+class CompiledStreamQuery:
+    """Compiles a supported Query AST to a jitted (state, batch) -> (state, out)
+    step. Raises DeviceCompileError for shapes the device path doesn't cover
+    (the host interpreter is the fallback, mirroring the reference's CPU
+    QueryRuntime role)."""
+
+    def __init__(self, query: Query, definition: StreamDefinition,
+                 batch_capacity: int = 4096, group_capacity: int = 1024):
+        ist = query.input_stream
+        if not isinstance(ist, SingleInputStream):
+            raise DeviceCompileError("device path covers single-stream queries")
+        self.query = query
+        self.definition = definition
+        self.B = batch_capacity
+        self.K = group_capacity
+        self.schema = BatchSchema(definition)
+        resolver = ColumnResolver(self.schema)
+        self.resolver = resolver
+
+        # handlers: filters + at most one window
+        self.filter_fns: list[Callable] = []
+        self.window_kind: Optional[str] = None
+        self.window_n = 0
+        for h in ist.handlers:
+            if isinstance(h, Filter):
+                fn, _ = compile_expression(h.expr, resolver)
+                self.filter_fns.append(fn)
+            elif isinstance(h, Window):
+                if self.window_kind is not None:
+                    raise DeviceCompileError("multiple windows not supported")
+                if h.name in ("length", "lengthBatch"):
+                    self.window_kind = h.name
+                else:
+                    raise DeviceCompileError(
+                        f"window '{h.name}' has no device kernel yet")
+                self.window_n = int(h.params[0].value)
+            else:
+                raise DeviceCompileError("stream functions not on device path")
+
+        # group-by: single key column (string codes or int)
+        self.group_key: Optional[str] = None
+        if query.selector.group_by:
+            if len(query.selector.group_by) != 1:
+                raise DeviceCompileError("device path supports one group-by key")
+            key, kt = resolver.resolve(query.selector.group_by[0])
+            if kt not in (DataType.STRING, DataType.INT, DataType.LONG):
+                raise DeviceCompileError("group key must be string/int")
+            self.group_key = key
+            if self.window_kind is not None:
+                raise DeviceCompileError(
+                    "group-by with windows not on device path yet")
+        if query.selector.having is not None:
+            raise DeviceCompileError("having not on device path yet")
+
+        # select list
+        self.specs: list[_Spec] = []
+        sel = query.selector
+        attrs = sel.attributes
+        if sel.select_all or not attrs:
+            from ..query_api import OutputAttribute
+            attrs = [OutputAttribute(None, Variable(attribute=n))
+                     for n in definition.attribute_names]
+        for oa in attrs:
+            e = oa.expr
+            if isinstance(e, AttributeFunction) and e.namespace is None \
+                    and e.name in ("sum", "count", "avg", "min", "max",
+                                   "distinctCount", "stdDev"):
+                if e.name not in _INVERTIBLE_AGGS:
+                    raise DeviceCompileError(
+                        f"aggregator '{e.name}' needs the host path")
+                arg_fn, at = (None, DataType.LONG)
+                if e.args:
+                    arg_fn, at = compile_expression(e.args[0], resolver)
+                if e.name == "count":
+                    dt = DataType.LONG
+                elif e.name == "avg":
+                    dt = DataType.DOUBLE
+                else:
+                    dt = DataType.LONG if at in (DataType.INT, DataType.LONG) \
+                        else DataType.DOUBLE
+                self.specs.append(_Spec(oa.name, e.name, arg_fn, dt))
+            else:
+                fn, t = compile_expression(e, resolver)
+                src = e.attribute if isinstance(e, Variable) and t == DataType.STRING \
+                    else None
+                self.specs.append(_Spec(oa.name, "value", fn, t, src))
+
+        self.value_idx = [i for i, s in enumerate(self.specs) if s.kind == "value"]
+        self.agg_idx = [i for i, s in enumerate(self.specs) if s.kind != "value"]
+        self._step = jax.jit(self._make_step(), donate_argnums=(0,))
+
+    # ------------------------------------------------------------------ state
+    def init_state(self) -> dict:
+        N = max(self.window_n, 1)
+        A = len(self.agg_idx)
+        state: dict[str, Any] = {}
+        if self.window_kind in ("length", "lengthBatch"):
+            state["tail_vals"] = jnp.zeros((A, N), dtype=jnp.float64)
+            state["tail_ones"] = jnp.zeros((N,), dtype=jnp.float64)
+        if self.window_kind == "lengthBatch":
+            state["rem_count"] = jnp.zeros((), dtype=jnp.int32)
+            state["rem_ts"] = jnp.zeros((N,), dtype=jnp.int64)
+            for i in self.value_idx:
+                state[f"rem_proj_{i}"] = jnp.zeros(
+                    (N,), dtype=_JNP_DTYPES[self.specs[i].dtype])
+        if self.group_key is not None:
+            state["key_sums"] = jnp.zeros((A, self.K), dtype=jnp.float64)
+            state["key_counts"] = jnp.zeros((self.K,), dtype=jnp.float64)
+        if self.window_kind is None and self.group_key is None:
+            state["run_sums"] = jnp.zeros((A,), dtype=jnp.float64)
+            state["run_count"] = jnp.zeros((), dtype=jnp.float64)
+        return state
+
+    # ------------------------------------------------------------------- step
+    def _make_step(self):
+        B = self.B
+        filter_fns = list(self.filter_fns)
+        specs = self.specs
+        value_idx, agg_idx = self.value_idx, self.agg_idx
+        window_kind, N = self.window_kind, max(self.window_n, 1)
+        group_key = self.group_key
+        K = self.K
+
+        def step(state, cols, ts, valid):
+            cols = dict(cols)
+            cols["__ts__"] = ts
+            mask = valid
+            for fn in filter_fns:
+                mask = jnp.logical_and(mask, fn(cols))
+            k = jnp.sum(mask.astype(jnp.int32))
+
+            # stable compaction: accepted event i → slot rank_i; rejected rows
+            # all target slot B-1 with value 0 — that slot only holds a real
+            # event when k == B, in which case nothing was rejected
+            rank = jnp.cumsum(mask.astype(jnp.int32)) - 1
+            pos = jnp.where(mask, rank, B - 1)
+
+            def compact(x):
+                out = jnp.zeros((B,), dtype=x.dtype)
+                return out.at[pos].set(jnp.where(mask, x, jnp.zeros((), x.dtype)),
+                                       mode="drop")
+
+            cts = compact(ts)
+            proj_c = {i: compact(specs[i].fn(cols)) for i in value_idx}
+            agg_c = []
+            for i in agg_idx:
+                s = specs[i]
+                v = jnp.ones((B,), jnp.float64) if s.fn is None \
+                    else s.fn(cols).astype(jnp.float64)
+                agg_c.append(compact(jnp.where(mask, v, 0.0)))
+            A = len(agg_c)
+            av = jnp.stack(agg_c) if A else jnp.zeros((0, B), jnp.float64)
+            ones_c = compact(jnp.where(mask, 1.0, 0.0))
+
+            if window_kind == "length":
+                state, sums, cnts = _length_window(state, av, ones_c, k, N, B)
+                out, out_valid = _materialize(
+                    specs, value_idx, agg_idx, proj_c, sums, cnts,
+                    jnp.arange(B) < k)
+                return state, {"out": out, "valid": out_valid, "ts": cts,
+                               "count": k}
+
+            if window_kind == "lengthBatch":
+                return _length_batch(state, specs, value_idx, agg_idx, proj_c,
+                                     av, ones_c, cts, k, N, B)
+
+            if group_key is not None:
+                keys = compact(cols[group_key].astype(jnp.int32)) % K
+                out_valid = jnp.arange(B) < k
+                onehot = jax.nn.one_hot(keys, K, dtype=jnp.float64) \
+                    * out_valid[:, None]                                   # [B,K]
+                if A:
+                    contrib = onehot[None] * av[:, :, None]                # [A,B,K]
+                    ccum = jnp.cumsum(contrib, axis=1)
+                    base = state["key_sums"][:, keys]                      # [A,B]
+                    sums = jnp.take_along_axis(
+                        ccum, keys[None, :, None], axis=2)[:, :, 0] + base
+                    new_key_sums = state["key_sums"] + contrib.sum(axis=1)
+                else:
+                    sums = jnp.zeros((0, B))
+                    new_key_sums = state["key_sums"]
+                ocum = jnp.cumsum(onehot, axis=0)
+                cnts = jnp.take_along_axis(ocum, keys[:, None], axis=1)[:, 0] \
+                    + state["key_counts"][keys]
+                state = {**state, "key_sums": new_key_sums,
+                         "key_counts": state["key_counts"] + onehot.sum(axis=0)}
+                out, out_valid = _materialize(
+                    specs, value_idx, agg_idx, proj_c, sums, cnts, out_valid)
+                return state, {"out": out, "valid": out_valid, "ts": cts,
+                               "count": k}
+
+            # running aggregates, no window/grouping
+            cs = jnp.cumsum(av, axis=1) if A else jnp.zeros((0, B))
+            cso = jnp.cumsum(ones_c)
+            sums = cs + state["run_sums"][:, None] if A else cs
+            cnts = cso + state["run_count"]
+            state = {**state,
+                     "run_sums": state["run_sums"] + (av.sum(axis=1) if A else 0.0),
+                     "run_count": state["run_count"] + ones_c.sum()}
+            out, out_valid = _materialize(
+                specs, value_idx, agg_idx, proj_c, sums, cnts, jnp.arange(B) < k)
+            return state, {"out": out, "valid": out_valid, "ts": cts, "count": k}
+
+        return step
+
+    # -------------------------------------------------------------- execution
+    def step(self, state, batch: dict):
+        """batch: output of BatchBuilder.emit() (numpy); returns (state, out)."""
+        return self._step(state, batch["cols"], batch["ts"], batch["valid"])
+
+    def decode_outputs(self, out) -> list[list]:
+        valid = np.asarray(out["valid"])
+        host_cols = {}
+        for s in self.specs:
+            col = np.asarray(out["out"][s.name])
+            if s.dtype == DataType.STRING and s.source_attr:
+                dic = self.schema.dictionaries[s.source_attr]
+                col = np.array([dic.decode(int(c)) for c in col], dtype=object)
+            host_cols[s.name] = col
+        rows = []
+        for i in np.nonzero(valid)[0]:
+            rows.append([_pyval(host_cols[s.name][i], s.dtype) for s in self.specs])
+        return rows
+
+
+# ---------------------------------------------------------------------------
+# window kernels
+# ---------------------------------------------------------------------------
+
+def _length_window(state, av, ones_c, k, N, B):
+    """Sliding window sums via tail-buffer + cumsum differences."""
+    A = av.shape[0]
+    z = jnp.concatenate([state["tail_vals"], av], axis=1)          # [A, N+B]
+    zo = jnp.concatenate([state["tail_ones"], ones_c])             # [N+B]
+    j = jnp.arange(B) + N
+    if A:
+        cs = jnp.cumsum(z, axis=1)
+        sums = cs[:, j] - cs[:, j - N]
+        new_tail_v = jax.vmap(
+            lambda row: jax.lax.dynamic_slice(row, (k,), (N,)))(z)
+    else:
+        sums = jnp.zeros((0, B))
+        new_tail_v = state["tail_vals"]
+    cso = jnp.cumsum(zo)
+    cnts = cso[j] - cso[j - N]
+    new_tail_o = jax.lax.dynamic_slice(zo, (k,), (N,))
+    return ({**state, "tail_vals": new_tail_v, "tail_ones": new_tail_o},
+            sums, cnts)
+
+
+def _length_batch(state, specs, value_idx, agg_idx, proj_c, av, ones_c, cts,
+                  k, N, B):
+    """Tumbling window: carried remainder (projections + agg args), outputs over
+    [N+B] slots covering remainder + current arrivals."""
+    A = av.shape[0]
+    r = state["rem_count"]
+    M = N + B
+    total = r + k
+    # contiguous accepted sequence: remainder (first r of N) then batch (first k)
+    zm = jnp.concatenate([jnp.arange(N) < r, jnp.arange(B) < k])
+    zrank = jnp.cumsum(zm.astype(jnp.int32)) - 1
+    zpos = jnp.where(zm, zrank, M - 1)
+
+    def zc(x_rem, x_batch):
+        x = jnp.concatenate([x_rem, x_batch])
+        out = jnp.zeros((M,), dtype=x.dtype)
+        return out.at[zpos].set(jnp.where(zm, x, jnp.zeros((), x.dtype)),
+                                mode="drop")
+
+    z = jax.vmap(lambda rr, bb: zc(rr, bb))(state["tail_vals"], av) if A \
+        else jnp.zeros((0, M))
+    zts = zc(state["rem_ts"], cts)
+    zproj = {i: zc(state[f"rem_proj_{i}"], proj_c[i]) for i in value_idx}
+
+    j2 = jnp.arange(M)
+    batch_start = (j2 // N) * N
+    if A:
+        cs = jnp.cumsum(z, axis=1)
+        start_cs = jnp.where(batch_start > 0, cs[:, jnp.maximum(batch_start - 1, 0)], 0.0)
+        sums = cs[:, j2] - start_cs
+    else:
+        sums = jnp.zeros((0, M))
+    cnts = (j2 % N + 1).astype(jnp.float64)
+
+    full_batches = total // N
+    out_valid = (j2 < full_batches * N) & (j2 < total)
+
+    rem_n = total - full_batches * N
+    def rem_slice(row):
+        return jax.lax.dynamic_slice(row, (full_batches * N,), (N,))
+    keep = jnp.arange(N) < rem_n
+    new_state = {**state, "rem_count": rem_n.astype(jnp.int32)}
+    new_state["tail_vals"] = jnp.where(
+        keep[None, :], jax.vmap(rem_slice)(z), 0.0) if A else state["tail_vals"]
+    new_state["tail_ones"] = jnp.where(keep, rem_slice(
+        jnp.concatenate([jnp.where(jnp.arange(N) < r, state["tail_ones"], 0.0),
+                         ones_c])), 0.0)
+    new_state["rem_ts"] = jnp.where(keep, rem_slice(zts), 0)
+    for i in value_idx:
+        z_i = zproj[i]
+        new_state[f"rem_proj_{i}"] = jnp.where(
+            keep, rem_slice(z_i), jnp.zeros((), z_i.dtype))
+
+    out, out_valid = _materialize(specs, value_idx, agg_idx, zproj, sums, cnts,
+                                  out_valid)
+    return new_state, {"out": out, "valid": out_valid, "ts": zts,
+                       "count": full_batches * N}
+
+
+def _materialize(specs, value_idx, agg_idx, proj, sums, cnts, out_valid):
+    outputs = {}
+    for vi, i in enumerate(value_idx):
+        outputs[specs[i].name] = proj[i]
+    for ai, i in enumerate(agg_idx):
+        s = specs[i]
+        if s.kind == "sum":
+            v = sums[ai]
+            outputs[s.name] = v.astype(jnp.int64) if s.dtype == DataType.LONG else v
+        elif s.kind == "count":
+            outputs[s.name] = cnts.astype(jnp.int64)
+        else:  # avg
+            outputs[s.name] = sums[ai] / jnp.maximum(cnts, 1.0)
+    return outputs, out_valid
+
+
+def _pyval(v, dtype: DataType):
+    if isinstance(v, np.floating):
+        return float(v)
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.bool_):
+        return bool(v)
+    return v

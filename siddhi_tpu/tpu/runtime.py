@@ -1,0 +1,70 @@
+"""Device stream runtime: micro-batching front end over a compiled query.
+
+Plays the role of the reference's ``StreamJunction`` + ``QueryRuntime`` pair for
+the device path: host rows accumulate in a staging buffer; when a micro-batch
+fills (or ``flush()`` is called) one jitted step runs on device and decoded rows
+go to the callback.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from ..compiler import parse as _parse
+from ..query_api import Query, SiddhiApp
+from .batch import BatchBuilder
+from .query_compile import CompiledStreamQuery
+
+
+class DeviceStreamRuntime:
+    def __init__(self, app_or_text, batch_capacity: int = 4096,
+                 group_capacity: int = 1024, query_index: int = 0):
+        app = _parse(app_or_text) if isinstance(app_or_text, str) else app_or_text
+        queries = app.queries
+        if not queries:
+            raise ValueError("no queries in app")
+        query = queries[query_index]
+        sid = query.input_stream.stream_id
+        if sid not in app.stream_definitions:
+            raise KeyError(f"stream '{sid}' not defined")
+        self.definition = app.stream_definitions[sid]
+        self.compiled = CompiledStreamQuery(
+            query, self.definition, batch_capacity, group_capacity)
+        self.builder = BatchBuilder(self.compiled.schema, batch_capacity)
+        self.state = self.compiled.init_state()
+        self.callback: Optional[Callable[[list[list]], None]] = None
+        self._pending_out = []
+
+    def add_callback(self, fn: Callable[[list[list]], None]) -> None:
+        self.callback = fn
+
+    def send(self, row: list, timestamp: int = 0) -> None:
+        self.builder.append(row, timestamp)
+        if self.builder.full:
+            self.flush()
+
+    def flush(self, decode: bool = True) -> None:
+        if len(self.builder) == 0:
+            return
+        batch = self.builder.emit()
+        self.state, out = self.compiled.step(self.state, batch)
+        if decode:
+            rows = self.compiled.decode_outputs(out)
+            if self.callback is not None and rows:
+                self.callback(rows)
+        else:
+            self._pending_out.append(out)
+
+    def block_until_ready(self) -> None:
+        jax.tree_util.tree_map(
+            lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x,
+            self.state)
+
+    # -- checkpointing: state is a pytree --------------------------------------
+    def snapshot_state(self) -> dict:
+        return jax.device_get(self.state)
+
+    def restore_state(self, state) -> None:
+        self.state = jax.device_put(state)

@@ -8,7 +8,7 @@ Must be set before jax initializes its backends.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,3 +16,17 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon (TPU tunnel) PJRT plugin registers itself via sitecustomize, sets
+# jax.config.jax_platforms="axon,cpu" programmatically (overriding the env
+# var), and blocks on the tunnel at backend init. Tests are CPU-only: drop the
+# factory and force the config back to cpu before any backend initializes.
+try:
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

@@ -323,3 +323,78 @@ def test_stream_function_extension(manager):
     """)
     rt.input_handler("S").send([7], timestamp=1)
     assert [e.data for e in got] == [[7, 0], [7, 1]]
+
+
+def test_time_batch_restore_rearms_timer(manager):
+    """Review regression: restored timeBatch must flush on time in the new
+    runtime (timer re-armed from restored boundary)."""
+    app = """
+        define stream S (v long);
+        from S#window.timeBatch(100) select sum(v) as total insert into O;
+    """
+    rt, got = setup(manager, app)
+    ih = rt.input_handler("S")
+    ih.send([1], timestamp=1000)
+    ih.send([2], timestamp=1050)
+    blob = rt.snapshot()
+
+    rt2 = manager.create_siddhi_app_runtime(app, playback=True, start_time=1050)
+    got2 = []
+    rt2.add_callback("O", StreamCallback(lambda evs: got2.extend(evs)))
+    rt2.start()
+    rt2.restore(blob)
+    rt2.advance_time(1200)          # boundary at 1100 must fire via timer alone
+    assert [e.data[0] for e in got2] == [1, 3]
+
+
+def test_session_window_restore(manager):
+    app = """
+        define stream S (k string, v long);
+        from S#window.session(100, k) select k, sum(v) as total insert into O;
+    """
+    rt, got = setup(manager, app)
+    rt.input_handler("S").send(["a", 1], timestamp=1000)
+    blob = rt.snapshot()
+
+    rt2 = manager.create_siddhi_app_runtime(app, playback=True, start_time=1000)
+    got2 = []
+    rt2.add_callback("O", StreamCallback(lambda evs: got2.extend(evs)))
+    rt2.start()
+    rt2.restore(blob)
+    rt2.input_handler("S").send(["a", 2], timestamp=1050)
+    # restored session state: sum includes pre-snapshot event
+    assert [e.data for e in got2] == [["a", 3]]
+
+
+def test_absent_pattern_restore_rearms_timer(manager):
+    app = """
+        define stream A (v int); define stream B (v int);
+        from e1=A -> not B for 100 select e1.v as a insert into O;
+    """
+    rt, got = setup(manager, app)
+    rt.input_handler("A").send([1], timestamp=1000)
+    blob = rt.snapshot()
+
+    rt2 = manager.create_siddhi_app_runtime(app, playback=True, start_time=1000)
+    got2 = []
+    rt2.add_callback("O", StreamCallback(lambda evs: got2.extend(evs)))
+    rt2.start()
+    rt2.restore(blob)
+    rt2.advance_time(1200)          # non-occurrence deadline passed → match
+    assert [e.data for e in got2] == [[1]]
+
+
+def test_log_error_action_continues(manager):
+    """Default @OnError LOG action: event dropped, app keeps running, other
+    subscribers still receive the event."""
+    rt = manager.create_siddhi_app_runtime("""
+        define stream S (v int);
+        define function boom[python] return int { return data[0] / 0 };
+        @info(name='bad') from S select boom(v) as d insert into O1;
+        @info(name='good') from S select v insert into O2;
+    """, playback=True)
+    good = []
+    rt.add_callback("O2", StreamCallback(lambda evs: good.extend(evs)))
+    rt.start()
+    rt.input_handler("S").send([7], timestamp=1)   # must not raise
+    assert [e.data for e in good] == [[7]]

@@ -1,0 +1,141 @@
+"""Device-path parity tests: compiled XLA programs vs the host interpreter
+oracle on identical event sequences (the role of the reference's numeric
+kernel-vs-CPU tests; SURVEY §4 'new numeric-parity tests')."""
+
+import random
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.tpu import DeviceCompileError, DeviceStreamRuntime
+
+
+def interpreter_run(app, rows, stream="S", out="O"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, playback=True)
+    got = []
+    rt.add_callback(out, StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    ih = rt.input_handler(stream)
+    for i, r in enumerate(rows):
+        ih.send(r, timestamp=1000 + i)
+    m.shutdown()
+    return [e.data for e in got]
+
+
+def device_run(app, rows, batch_capacity=64):
+    rt = DeviceStreamRuntime(app, batch_capacity=batch_capacity)
+    got = []
+    rt.add_callback(got.extend)
+    for i, r in enumerate(rows):
+        rt.send(r, timestamp=1000 + i)
+    rt.flush()
+    return got
+
+
+def assert_parity(app, rows, batch_capacity=64):
+    expected = interpreter_run(app, rows)
+    actual = device_run(app, rows, batch_capacity)
+    assert len(expected) == len(actual), (len(expected), len(actual))
+    for e, a in zip(expected, actual):
+        assert len(e) == len(a)
+        for x, y in zip(e, a):
+            if isinstance(x, float) or isinstance(y, float):
+                assert y == pytest.approx(x, rel=1e-9), (e, a)
+            else:
+                assert x == y, (e, a)
+
+
+APP_FILTER_WINDOW = """
+define stream S (sym string, price double, vol long);
+from S[price > 50.0 and vol < 900]#window.length(10)
+select sym, sum(vol) as total, count() as c, avg(price) as ap
+insert into O;
+"""
+
+
+def random_rows(n, seed):
+    rng = random.Random(seed)
+    return [
+        [rng.choice("abcdef"), round(rng.uniform(0, 100), 2), rng.randrange(1000)]
+        for _ in range(n)
+    ]
+
+
+def test_parity_filter_length_window():
+    assert_parity(APP_FILTER_WINDOW, random_rows(500, 1), batch_capacity=64)
+
+
+def test_parity_small_batches():
+    # batch boundary stress: capacity smaller than window length
+    assert_parity(APP_FILTER_WINDOW, random_rows(200, 2), batch_capacity=7)
+
+
+def test_parity_length_batch():
+    app = """
+    define stream S (sym string, v long);
+    from S[v > 100]#window.lengthBatch(5)
+    select sym, sum(v) as s, count() as c insert into O;
+    """
+    rng = random.Random(3)
+    rows = [[rng.choice("xyz"), rng.randrange(1000)] for _ in range(300)]
+    assert_parity(app, rows, batch_capacity=11)
+
+
+def test_parity_group_by_running():
+    app = """
+    define stream S (k string, v long);
+    from S select k, sum(v) as total, count() as c, avg(v) as a
+    group by k insert into O;
+    """
+    rng = random.Random(4)
+    rows = [[rng.choice("pqrstu"), rng.randrange(100)] for _ in range(400)]
+    assert_parity(app, rows, batch_capacity=32)
+
+
+def test_parity_projection_math():
+    app = """
+    define stream S (a long, b long);
+    from S[a != b] select a + b as s, a * b as p, ifThenElse(a > b, a, b) as mx
+    insert into O;
+    """
+    rng = random.Random(5)
+    rows = [[rng.randrange(50), rng.randrange(50)] for _ in range(200)]
+    assert_parity(app, rows, batch_capacity=17)
+
+
+def test_device_state_snapshot_roundtrip():
+    app = """
+    define stream S (v long);
+    from S#window.length(4) select sum(v) as s insert into O;
+    """
+    rt = DeviceStreamRuntime(app, batch_capacity=4)
+    got = []
+    rt.add_callback(got.extend)
+    for i, v in enumerate([1, 2, 3, 4]):
+        rt.send([v], timestamp=i)
+    rt.flush()
+    snap = rt.snapshot_state()
+
+    rt2 = DeviceStreamRuntime(app, batch_capacity=4)
+    got2 = []
+    rt2.add_callback(got2.extend)
+    rt2.restore_state(snap)
+    for i, v in enumerate([5, 6]):
+        rt2.send([v], timestamp=10 + i)
+    rt2.flush()
+    # window [1,2,3,4] → +5 (evict 1) = 14 → +6 (evict 2) = 18
+    assert [r[0] for r in got2] == [14, 18]
+
+
+def test_unsupported_falls_back_cleanly():
+    with pytest.raises(DeviceCompileError):
+        DeviceStreamRuntime("""
+        define stream S (v long);
+        from S#window.time(1 sec) select sum(v) as s insert into O;
+        """)
+    with pytest.raises(DeviceCompileError):
+        DeviceStreamRuntime("""
+        define stream S (v double);
+        from S select stdDev(v) as sd insert into O;
+        """)
