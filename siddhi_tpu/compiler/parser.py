@@ -498,7 +498,11 @@ class Parser:
         if self.accept_kw("on"):
             on = self.parse_expression()
         if self.accept_kw("within"):
-            within = self._parse_within_value()
+            first = self.parse_expression()
+            if self.accept_op(","):
+                within = (first, self.parse_expression())
+            else:
+                within = first
         if self.accept_kw("per"):
             per = self.parse_expression()
         return JoinInputStream(left, jt, right, on, trigger, within, per)

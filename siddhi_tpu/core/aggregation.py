@@ -130,6 +130,31 @@ class AggregationRuntime:
     def output_names(self) -> list[str]:
         return ["AGG_TIMESTAMP"] + [s[0] for s in self.attr_specs]
 
+    @property
+    def output_definition(self):
+        from ..query_api.definition import DataType, StreamDefinition
+        d = StreamDefinition(self.definition.id)
+        d.attribute("AGG_TIMESTAMP", DataType.LONG)
+        for name, kind, fn, agg_name, rt, arg_t in self.attr_specs:
+            d.attribute(name, rt if rt is not None else DataType.OBJECT)
+        return d
+
+    def duration_for(self, per_value: str):
+        per = str(per_value).lower().rstrip("s")
+        dur_map = {
+            "second": TimePeriodDuration.SECONDS, "sec": TimePeriodDuration.SECONDS,
+            "minute": TimePeriodDuration.MINUTES, "min": TimePeriodDuration.MINUTES,
+            "hour": TimePeriodDuration.HOURS, "day": TimePeriodDuration.DAYS,
+            "month": TimePeriodDuration.MONTHS, "year": TimePeriodDuration.YEARS,
+        }
+        if per not in dur_map:
+            raise KeyError(f"unknown aggregation granularity '{per_value}'")
+        d = dur_map[per]
+        if d not in self.stores:
+            raise KeyError(
+                f"aggregation '{self.definition.id}' lacks duration {d.value}")
+        return d
+
     def rows_for(self, duration: TimePeriodDuration,
                  start: Optional[int] = None, end: Optional[int] = None) -> list[list]:
         buckets = self.stores.get(duration)
@@ -156,14 +181,7 @@ class AggregationRuntime:
         # `within t1 [, t2] per 'duration'`
         duration = self.definition.durations[0]
         if odq.per is not None:
-            per = str(odq.per.value).rstrip("s")
-            dur_map = {
-                "second": TimePeriodDuration.SECONDS, "sec": TimePeriodDuration.SECONDS,
-                "minute": TimePeriodDuration.MINUTES, "min": TimePeriodDuration.MINUTES,
-                "hour": TimePeriodDuration.HOURS, "day": TimePeriodDuration.DAYS,
-                "month": TimePeriodDuration.MONTHS, "year": TimePeriodDuration.YEARS,
-            }
-            duration = dur_map.get(per, duration)
+            duration = self.duration_for(odq.per.value)
         start = end = None
         if odq.within:
             vals = [v.value for v in odq.within]

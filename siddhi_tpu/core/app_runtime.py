@@ -66,6 +66,7 @@ class SiddhiAppRuntime:
         self.trigger_runtimes: list[TriggerRuntime] = []
         self.sources: list = []
         self.sinks: list = []
+        self.device_bridges: list = []
         self._started = False
         self._ondemand_cache: dict[str, OnDemandQueryRuntime] = {}
 
@@ -131,6 +132,27 @@ class SiddhiAppRuntime:
             if isinstance(element, Query):
                 q_count += 1
                 name = element.name() or f"query-{q_count}"
+                # @device queries offload to the compiled TPU path when they
+                # fit its kernel coverage; otherwise the host path builds below
+                from .device_bridge import try_build_device_query
+                bridge = try_build_device_query(
+                    element, ctx, self._stream_defs(), self._get_junction, name)
+                if bridge is not None:
+                    self.device_bridges.append(bridge)
+                    for sid in bridge.stream_ids:
+                        self._get_junction(sid).subscribe(
+                            bridge.receiver_for(sid))
+                    from ..query_api import InsertIntoStream
+                    os_ = element.output_stream
+                    if isinstance(os_, InsertIntoStream):
+                        j = self.ctx.stream_junctions.get(os_.target_id)
+                        if j is not None and not j.definition.attributes:
+                            names, types = bridge.output_schema
+                            d = StreamDefinition(os_.target_id)
+                            for n, t in zip(names, types):
+                                d.attribute(n, t)
+                            j.definition = d
+                    continue
                 rt = build_query_runtime(
                     element, ctx, self._stream_defs(), self._get_junction, name)
                 self.query_runtimes[name] = rt
@@ -215,10 +237,41 @@ class SiddhiAppRuntime:
                 if mapper_cls is None:
                     raise SiddhiAppCreationError(
                         f"unknown sink mapper type '{s['map']}'")
-                mapper = mapper_cls()
-                mapper.init(sd, s["options"])
-                sink = cls()
-                sink.init(sd, s["options"], mapper)
+                dist = s.get("distribution")
+                if dist and dist["destinations"]:
+                    from .io import (
+                        BroadcastStrategy,
+                        DistributedSink,
+                        PartitionedStrategy,
+                        RoundRobinStrategy,
+                    )
+                    subs = []
+                    for dest_opts in dist["destinations"]:
+                        mapper = mapper_cls()
+                        mapper.init(sd, s["options"])
+                        sub = cls()
+                        merged = {**s["options"], **dest_opts}
+                        sub.init(sd, merged, mapper)
+                        subs.append(sub)
+                    n = len(subs)
+                    strat_name = (dist["strategy"] or "roundRobin").lower()
+                    if strat_name == "partitioned":
+                        key = dist.get("partitionKey")
+                        if key is None:
+                            raise SiddhiAppCreationError(
+                                "partitioned @distribution needs partitionKey")
+                        strat = PartitionedStrategy(
+                            n, sd.attribute_position(key))
+                    elif strat_name == "broadcast":
+                        strat = BroadcastStrategy(n)
+                    else:
+                        strat = RoundRobinStrategy(n)
+                    sink = DistributedSink(subs, strat)
+                else:
+                    mapper = mapper_cls()
+                    mapper.init(sd, s["options"])
+                    sink = cls()
+                    sink.init(sd, s["options"], mapper)
                 self.sinks.append(sink)
                 cb = StreamCallback(lambda events, sk=sink: [
                     sk.on_event(e) for e in events])
@@ -255,6 +308,10 @@ class SiddhiAppRuntime:
         if rt is not None:
             rt.add_callback(callback)
             return
+        for bridge in self.device_bridges:
+            if bridge.query_name == query_name:
+                bridge.query_callbacks.append(callback)
+                return
         for prt in self.partition_runtimes:
             for q in prt.partition_ast.queries:
                 if q.name() == query_name:
@@ -277,6 +334,7 @@ class SiddhiAppRuntime:
             self.ctx.ticker.start()
 
     def shutdown(self) -> None:
+        self.flush_device()          # drain partially-filled device batches
         for src in self.sources:
             src.disconnect()
         for sink in self.sinks:
@@ -288,7 +346,13 @@ class SiddhiAppRuntime:
     # -- time (playback) ------------------------------------------------------
     def advance_time(self, ts: int) -> None:
         """Advance the playback clock (fires due timers) without an event."""
+        self.flush_device()
         self.ctx.advance_time(ts)
+
+    def flush_device(self) -> None:
+        """Drain pending micro-batches of @device-offloaded queries."""
+        for b in self.device_bridges:
+            b.flush()
 
     # -- snapshots ------------------------------------------------------------
     def snapshot(self) -> bytes:
@@ -319,6 +383,16 @@ class SiddhiAppRuntime:
                 self._ondemand_cache.clear()
             self._ondemand_cache[text] = rt
         return rt.execute()
+
+    # -- debugger -------------------------------------------------------------
+    def debug(self):
+        """Start debugging: returns the SiddhiDebugger (reference
+        ``SiddhiAppRuntime.debug():666``)."""
+        from .debugger import SiddhiDebugger
+        if getattr(self.ctx, "debugger", None) is None:
+            self.ctx.debugger = SiddhiDebugger(self.ctx)
+        self.start()
+        return self.ctx.debugger
 
     # -- stats / errors -------------------------------------------------------
     def set_statistics_level(self, level: Level) -> None:

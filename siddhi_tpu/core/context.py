@@ -48,6 +48,7 @@ class SiddhiAppContext:
         self._element_counter = 0
 
         self.exception_listener: Optional[Callable[[Exception], None]] = None
+        self.debugger = None
         self.runtime = None                         # back-ref set by SiddhiAppRuntime
         self.statistics_manager = None
 

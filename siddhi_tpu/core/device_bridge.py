@@ -1,0 +1,186 @@
+"""Device execution backend integration: per-query offload with host fallback.
+
+The north star (BASELINE.json): the compiled TPU path plugs in as an execution
+backend for individual queries — the role the reference reserves for its
+``@Extension``/StreamProcessor plugin boundary — while the host interpreter
+remains the fallback (the reference's CPU ``QueryRuntime``).
+
+Usage: annotate a query with ``@device`` (optionally ``@device(batch='4096')``).
+The app builder tries the device compiler; on ``DeviceCompileError`` the query
+silently builds on the host path instead (``@device(strict='true')`` raises).
+Events route into a micro-batching bridge; device outputs flow back into the
+target junction as CURRENT events. Batching semantics: outputs surface when a
+micro-batch fills or on ``SiddhiAppRuntime.flush_device()`` (also invoked by
+playback watermark advancement).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..query_api import InsertIntoStream, Query, SingleInputStream, StateInputStream
+from ..query_api.annotation import find_annotation
+from .event import EventType, StreamEvent
+
+log = logging.getLogger("siddhi_tpu.device")
+
+
+class DeviceQueryBridge:
+    """Junction subscriber feeding a compiled device query; outputs re-enter the
+    engine through the query's output junction."""
+
+    def __init__(self, kind: str, runtime, app_context, stream_ids: list[str],
+                 output_junction, query_name: str):
+        self.kind = kind                  # 'stream' | 'nfa'
+        self.runtime = runtime            # DeviceStreamRuntime | DeviceNFARuntime
+        self.app_context = app_context
+        self.stream_ids = stream_ids
+        self.output_junction = output_junction
+        self.query_name = query_name
+        self.query_callbacks: list = []
+        runtime.add_callback(self._on_rows)
+        self._out_ts = 0
+
+    # -- junction receiver(s) -------------------------------------------------
+    def receiver_for(self, stream_id: str):
+        bridge = self
+
+        class _R:
+            def receive(self, event: StreamEvent) -> None:
+                bridge.on_event(stream_id, event)
+
+        return _R()
+
+    def on_event(self, stream_id: str, event: StreamEvent) -> None:
+        if event.type != EventType.CURRENT:
+            return
+        self._out_ts = event.timestamp
+        if self.kind == "stream":
+            self.runtime.send(event.data, timestamp=event.timestamp)
+        else:
+            self.runtime.send(stream_id, event.data, event.timestamp)
+
+    def flush(self) -> None:
+        self.runtime.flush()
+
+    def _on_rows(self, rows: list[list]) -> None:
+        if self.query_callbacks:
+            from .event import Event
+            evs = [Event(self._out_ts, row) for row in rows]
+            for cb in self.query_callbacks:
+                cb.receive(self._out_ts, evs, None)
+        if self.output_junction is None:
+            return
+        for row in rows:
+            self.output_junction.send_event(
+                StreamEvent(self._out_ts, row, EventType.CURRENT))
+
+
+def try_build_device_query(query: Query, app_context, stream_defs: dict,
+                           get_junction, name: str) -> Optional[DeviceQueryBridge]:
+    """Returns a bridge when the query opts in via @device AND compiles on the
+    device path; None → caller builds the host runtime."""
+    ann = find_annotation(query.annotations, "device")
+    if ann is None:
+        return None
+    strict = (ann.get("strict") or "false").lower() == "true"
+    batch = int(ann.get("batch") or 1024)
+    slots = int(ann.get("slots") or 64)
+
+    from ..tpu.expr_compile import DeviceCompileError
+
+    target = None
+    if isinstance(query.output_stream, InsertIntoStream):
+        target = get_junction(query.output_stream.target_id,
+                              query.output_stream.is_inner_stream)
+    try:
+        ist = query.input_stream
+        if isinstance(ist, SingleInputStream):
+            from ..tpu.batch import BatchBuilder
+            from ..tpu.query_compile import CompiledStreamQuery
+
+            d = stream_defs.get(ist.stream_id)
+            if d is None:
+                raise DeviceCompileError(f"undefined stream '{ist.stream_id}'")
+            compiled = CompiledStreamQuery(query, d, batch_capacity=batch)
+
+            class _StreamRT:
+                def __init__(self):
+                    self.compiled = compiled
+                    self.builder = BatchBuilder(compiled.schema, batch)
+                    self.state = compiled.init_state()
+                    self.callback = None
+
+                def add_callback(self, fn):
+                    self.callback = fn
+
+                def send(self, row, timestamp=0):
+                    self.builder.append(row, timestamp)
+                    if self.builder.full:
+                        self.flush()
+
+                def flush(self):
+                    if len(self.builder) == 0:
+                        return
+                    b = self.builder.emit()
+                    self.state, out = self.compiled.step(self.state, b)
+                    rows = self.compiled.decode_outputs(out)
+                    if self.callback and rows:
+                        self.callback(rows)
+
+                def snapshot_state(self):
+                    import jax
+                    return jax.device_get(self.state)
+
+                def restore_state(self, st):
+                    import jax
+                    self.state = jax.device_put(st)
+
+            rt = _StreamRT()
+            bridge = DeviceQueryBridge("stream", rt, app_context,
+                                       [ist.stream_id], target, name)
+            bridge.output_schema = ([s.name for s in compiled.specs],
+                                    [s.dtype for s in compiled.specs])
+        elif isinstance(ist, StateInputStream):
+            from ..tpu.nfa import DeviceNFACompiler, DeviceNFARuntime, MergedBatchBuilder
+
+            compiler = DeviceNFACompiler(query, stream_defs, slots, batch)
+
+            class _NFART(DeviceNFARuntime):
+                def __init__(self):
+                    self.compiler = compiler
+                    self.builder = MergedBatchBuilder(
+                        compiler.merged, batch, stream_defs)
+                    self.state = compiler.init_state()
+                    self.callback = None
+
+            rt = _NFART()
+            bridge = DeviceQueryBridge("nfa", rt, app_context,
+                                       compiler.compiled.stream_ids, target, name)
+            bridge.output_schema = ([n for n, _, _ in compiler.out_specs],
+                                    [t for _, _, t in compiler.out_specs])
+        else:
+            raise DeviceCompileError("joins not on device path yet")
+    except DeviceCompileError as e:
+        if strict:
+            raise
+        log.info("query '%s' falls back to host path: %s", name, e)
+        return None
+
+    app_context.register_state(f"device-{name}", _BridgeState(bridge))
+    return bridge
+
+
+class _BridgeState:
+    """Snapshot adapter: device state pytree is host-fetchable."""
+
+    def __init__(self, bridge: DeviceQueryBridge):
+        self.bridge = bridge
+
+    def snapshot_state(self):
+        self.bridge.flush()
+        return self.bridge.runtime.snapshot_state()
+
+    def restore_state(self, state):
+        self.bridge.runtime.restore_state(state)

@@ -1,0 +1,193 @@
+"""Expression windows: retain events while a condition over the buffer holds.
+
+Reference: ``ExpressionWindowProcessor`` / ``ExpressionBatchWindowProcessor`` —
+``#window.expression('count() <= 20')``, ``#window.expressionBatch('last.ts -
+first.ts < 5000')``. The expression sees:
+
+- bare attributes → the newest (just-arrived) event
+- ``first.attr`` / ``last.attr`` → oldest / newest buffered event
+- ``count()``, ``sum(x)``, ``avg(x)``, ``min(x)``, ``max(x)`` → over the buffer
+- ``eventTimestamp(first)`` / ``eventTimestamp(last)`` → buffer boundary times
+
+Sliding form: on arrival, evict oldest events until the expression holds.
+Batch form: when the expression turns false, flush the buffered batch (expiring
+the previous batch) and start fresh with the new event.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..query_api import AttributeFunction, DataType, Variable
+from ..query_api.definition import StreamDefinition
+from .event import EventType, StreamEvent
+from .executor import ExecutorBuilder, VariableResolver
+from .windows import WindowProcessor
+
+
+class _BufferFrame:
+    __slots__ = ("buffer", "newest")
+
+    def __init__(self, buffer: list[StreamEvent], newest: StreamEvent):
+        self.buffer = buffer
+        self.newest = newest
+
+    def timestamp(self) -> int:
+        return self.newest.timestamp
+
+
+class _BufferResolver(VariableResolver):
+    def __init__(self, definition: StreamDefinition):
+        self.definition = definition
+
+    def resolve(self, var: Variable):
+        d = self.definition
+        if var.stream_id in ("first", "last"):
+            pos = d.attribute_position(var.attribute)
+            if var.stream_id == "first":
+                return (lambda f: f.buffer[0].data[pos] if f.buffer else None), \
+                    d.attributes[pos].type
+            return (lambda f: f.buffer[-1].data[pos] if f.buffer else None), \
+                d.attributes[pos].type
+        pos = d.attribute_position(var.attribute)
+        return (lambda f: f.newest.data[pos]), d.attributes[pos].type
+
+
+def _build_buffer_fn(expr, definition: StreamDefinition, app_context) -> Callable:
+    """Compile the window expression with buffer-aggregate function support."""
+    resolver = _BufferResolver(definition)
+
+    def agg_builder(kind):
+        def build(fns, types):
+            def run(f: _BufferFrame):
+                if kind == "count":
+                    return len(f.buffer)
+                vals = [fns[0](_BufferFrame(f.buffer, e)) for e in f.buffer]
+                vals = [v for v in vals if v is not None]
+                if not vals:
+                    return None
+                if kind == "sum":
+                    return sum(vals)
+                if kind == "avg":
+                    return sum(vals) / len(vals)
+                if kind == "min":
+                    return min(vals)
+                return max(vals)
+            return run, DataType.DOUBLE if kind in ("avg",) else (
+                types[0] if types else DataType.LONG)
+        return build
+
+    extra = {
+        "count": agg_builder("count"),
+        "sum": agg_builder("sum"),
+        "avg": agg_builder("avg"),
+        "min": agg_builder("min"),
+        "max": agg_builder("max"),
+    }
+
+    # rewrite eventTimestamp(first|last) before building
+    def rewrite(e):
+        if isinstance(e, AttributeFunction) and e.name == "eventTimestamp" \
+                and e.args and isinstance(e.args[0], Variable) \
+                and e.args[0].attribute in ("first", "last"):
+            which = e.args[0].attribute
+            return _TimestampOf(which)
+        for attr in ("left", "right", "expr"):
+            sub = getattr(e, attr, None)
+            if sub is not None and hasattr(sub, "__class__") and not isinstance(sub, (int, float, str)):
+                new = rewrite(sub)
+                if new is not sub:
+                    setattr(e, attr, new)
+        if isinstance(e, AttributeFunction):
+            e.args = [rewrite(a) for a in e.args]
+        return e
+
+    expr = rewrite(expr)
+
+    class _Builder(ExecutorBuilder):
+        def build(self, e):
+            if isinstance(e, _TimestampOf):
+                if e.which == "first":
+                    return (lambda f: f.buffer[0].timestamp if f.buffer else 0), \
+                        DataType.LONG
+                return (lambda f: f.buffer[-1].timestamp if f.buffer else 0), \
+                    DataType.LONG
+            return super().build(e)
+
+    builder = _Builder(resolver, app_context, extra_functions=extra)
+    fn, _ = builder.build(expr)
+    return fn
+
+
+class _TimestampOf:
+    def __init__(self, which: str):
+        self.which = which
+
+
+class DynamicExpressionWindow(WindowProcessor):
+    """Sliding: evict oldest until the expression holds."""
+
+    def __init__(self, expr, definition: StreamDefinition, app_context):
+        super().__init__()
+        self.fn = _build_buffer_fn(expr, definition, app_context)
+        self.buffer: list[StreamEvent] = []
+
+    def process(self, events: list[StreamEvent]) -> None:
+        out: list[StreamEvent] = []
+        for ev in events:
+            if ev.type != EventType.CURRENT:
+                continue
+            self.buffer.append(ev)
+            while self.buffer and not bool(
+                    self.fn(_BufferFrame(self.buffer, ev))):
+                out.append(self._expired(self.buffer.pop(0), ev.timestamp))
+            out.append(ev)
+        self.forward(out)
+
+    def find_events(self) -> list[StreamEvent]:
+        return list(self.buffer)
+
+    def snapshot_state(self) -> dict:
+        return {"buffer": [(e.timestamp, list(e.data)) for e in self.buffer]}
+
+    def restore_state(self, state: dict) -> None:
+        self.buffer = [StreamEvent(t, d) for t, d in state["buffer"]]
+
+
+class DynamicExpressionBatchWindow(WindowProcessor):
+    """Batch: flush the collected batch when the expression turns false."""
+
+    def __init__(self, expr, definition: StreamDefinition, app_context):
+        super().__init__()
+        self.fn = _build_buffer_fn(expr, definition, app_context)
+        self.pending: list[StreamEvent] = []
+        self.last_batch: list[StreamEvent] = []
+
+    def process(self, events: list[StreamEvent]) -> None:
+        out: list[StreamEvent] = []
+        for ev in events:
+            if ev.type != EventType.CURRENT:
+                continue
+            trial = self.pending + [ev]
+            if self.pending and not bool(self.fn(_BufferFrame(trial, ev))):
+                # flush current batch, start a new one with this event
+                for old in self.last_batch:
+                    out.append(self._expired(old, ev.timestamp))
+                out.append(StreamEvent(ev.timestamp, [], EventType.RESET))
+                out.extend(self.pending)
+                self.last_batch = self.pending
+                self.pending = [ev]
+            else:
+                self.pending.append(ev)
+        self.forward(out)
+
+    def find_events(self) -> list[StreamEvent]:
+        return list(self.last_batch) + list(self.pending)
+
+    def snapshot_state(self) -> dict:
+        return {"pending": [(e.timestamp, list(e.data)) for e in self.pending],
+                "last": [(e.timestamp, list(e.data)) for e in self.last_batch]}
+
+    def restore_state(self, state: dict) -> None:
+        self.pending = [StreamEvent(t, d) for t, d in state["pending"]]
+        self.last_batch = [StreamEvent(t, d) for t, d in state["last"]]

@@ -233,6 +233,67 @@ SOURCES = {"inMemory": InMemorySource}
 SINKS = {"inMemory": InMemorySink, "log": LogSink}
 
 
+class DistributionStrategy:
+    """Reference: ``stream/output/sink/distributed/DistributionStrategy.java`` —
+    picks destination index(es) per event."""
+
+    def __init__(self, destinations: int):
+        self.n = destinations
+
+    def destinations_for(self, event: Event) -> list[int]:
+        raise NotImplementedError
+
+
+class RoundRobinStrategy(DistributionStrategy):
+    def __init__(self, destinations: int):
+        super().__init__(destinations)
+        self._i = 0
+
+    def destinations_for(self, event: Event) -> list[int]:
+        i = self._i
+        self._i = (self._i + 1) % self.n
+        return [i]
+
+
+class PartitionedStrategy(DistributionStrategy):
+    def __init__(self, destinations: int, key_pos: int):
+        super().__init__(destinations)
+        self.key_pos = key_pos
+
+    def destinations_for(self, event: Event) -> list[int]:
+        import zlib
+        # stable across processes (Python's hash() is randomized) so a key
+        # always lands on the same endpoint after restarts
+        key = str(event.data[self.key_pos]).encode()
+        return [zlib.crc32(key) % self.n]
+
+
+class BroadcastStrategy(DistributionStrategy):
+    def destinations_for(self, event: Event) -> list[int]:
+        return list(range(self.n))
+
+
+class DistributedSink:
+    """Multi-endpoint egress (reference ``MultiClientDistributedSink.java``):
+    one underlying sink per @destination, events routed per strategy."""
+
+    def __init__(self, sinks: list[Sink], strategy: DistributionStrategy):
+        self.sinks = sinks
+        self.strategy = strategy
+
+    def on_event(self, event: Event) -> None:
+        for i in self.strategy.destinations_for(event):
+            self.sinks[i].on_event(event)
+
+    def connect(self) -> None:
+        for s in self.sinks:
+            s.connect()
+
+    def disconnect(self) -> None:
+        for s in self.sinks:
+            s.disconnect()
+
+
 def parse_io_annotations(definition: StreamDefinition):
     """Extract (@source, @sink) configs from a stream definition's annotations."""
     sources, sinks = [], []
@@ -243,5 +304,15 @@ def parse_io_annotations(definition: StreamDefinition):
             map_ann = ann.nested("map")
             map_type = map_ann.get("type") if map_ann else "passThrough"
             entry = {"type": opts.get("type"), "options": opts, "map": map_type}
+            dist = ann.nested("distribution")
+            if dist is not None and low == "sink":
+                entry["distribution"] = {
+                    "strategy": dist.get("strategy", "roundRobin"),
+                    "partitionKey": dist.get("partitionKey"),
+                    "destinations": [
+                        {e.key: e.value for e in d.elements if e.key}
+                        for d in dist.annotations if d.name.lower() == "destination"
+                    ],
+                }
             (sources if low == "source" else sinks).append(entry)
     return sources, sinks

@@ -61,6 +61,27 @@ class QueryBuildError(Exception):
     pass
 
 
+def _within_bound(expr) -> int:
+    """Aggregation-join within bound: epoch-ms int or a date string
+    'YYYY-MM-DD HH:MM:SS[ +HH:MM]' (reference SiddhiQL accepts both)."""
+    v = getattr(expr, "value", None)
+    if isinstance(v, (int, float)):
+        return int(v)
+    if isinstance(v, str):
+        import datetime as _dt
+        text = v.strip().replace("**", "01")
+        for fmt in ("%Y-%m-%d %H:%M:%S %z", "%Y-%m-%d %H:%M:%S"):
+            try:
+                dt = _dt.datetime.strptime(text, fmt)
+                if dt.tzinfo is None:
+                    dt = dt.replace(tzinfo=_dt.timezone.utc)
+                return int(dt.timestamp() * 1000)
+            except ValueError:
+                continue
+        raise QueryBuildError(f"cannot parse within bound {v!r}")
+    raise QueryBuildError("within bound must be a constant timestamp or date string")
+
+
 # ---------------------------------------------------------------------------
 # Window factory
 # ---------------------------------------------------------------------------
@@ -136,6 +157,17 @@ def make_window_processor(win: Window, definition: StreamDefinition,
         key_start = 2 if error is not None else 1
         key_fns = [builder.build(p)[0] for p in params[key_start:]] or None
         proc = W.LossyFrequentWindow(support, error, key_fns)
+    elif name in ("expression", "expressionBatch"):
+        from ..compiler.parser import Parser
+        from .expression_window import (
+            DynamicExpressionBatchWindow,
+            DynamicExpressionWindow,
+        )
+        expr_text = str(_const(params[0], name))
+        expr_ast = Parser(expr_text).parse_expression()
+        cls = DynamicExpressionWindow if name == "expression" \
+            else DynamicExpressionBatchWindow
+        proc = cls(expr_ast, definition, app_context)
     elif name == "cron":
         proc = W.CronWindow(str(_const(params[0], "cron")))
     elif name == "hopping":
@@ -270,7 +302,8 @@ def build_query_runtime(query: Query, app_context, stream_defs: dict,
                                   app_context.element_id(f"{qid}-selector"))
         app_context.register_state(selector.element_id, selector)
         tail.set_next(_SelectorBridge(selector))
-        receiver = StreamReceiver(head)
+        from .debugger import DebuggedReceiver
+        receiver = DebuggedReceiver(StreamReceiver(head), name, app_context)
         rt.subscriptions.append((sid_eff, receiver))
 
     elif isinstance(ist, StateInputStream):
@@ -308,6 +341,7 @@ def build_query_runtime(query: Query, app_context, stream_defs: dict,
     selector.next = limiter
 
     targets: list = [rt.callback_adapter]
+    from .debugger import DebuggedOutput
     os = query.output_stream
     if isinstance(os, InsertIntoStream):
         if os.target_id in app_context.tables:
@@ -335,7 +369,7 @@ def build_query_runtime(query: Query, app_context, stream_defs: dict,
         targets.append(cls(table, cond, setters))
     elif isinstance(os, ReturnStream) or os is None:
         pass
-    limiter.next = FanoutProcessor(targets)
+    limiter.next = DebuggedOutput(FanoutProcessor(targets), name, app_context)
     return rt
 
 
@@ -382,7 +416,26 @@ def _build_join(ist: JoinInputStream, rt: QueryRuntime, app_context,
     sides = {}
     for label, s in (("left", ist.left), ("right", ist.right)):
         sid = s.stream_id
-        if sid in app_context.tables:
+        if sid in app_context.aggregations:
+            agg = app_context.aggregations[sid]
+            if ist.per is None:
+                raise QueryBuildError(
+                    "aggregation join needs `per '<granularity>'`")
+            duration = agg.duration_for(ist.per.value)
+            w = ist.within
+            start = end = None
+            if isinstance(w, tuple):
+                start, end = _within_bound(w[0]), _within_bound(w[1])
+            elif w is not None:
+                start = _within_bound(w)
+            def agg_find(agg=agg, duration=duration, start=start, end=end):
+                from .event import StreamEvent as _SE
+                return [_SE(r[0], r) for r in agg.rows_for(duration, start, end)]
+            sides[label] = {
+                "kind": "aggregation", "def": agg.output_definition,
+                "ref": s.ref(), "find": agg_find, "stream": s,
+            }
+        elif sid in app_context.tables:
             table = app_context.tables[sid]
             sides[label] = {
                 "kind": "table", "def": table.definition, "ref": s.ref(),
@@ -413,7 +466,14 @@ def _build_join(ist: JoinInputStream, rt: QueryRuntime, app_context,
     if ist.on_condition is not None:
         cond_fn, _ = builder.build(ist.on_condition)
 
-    within_ms = ist.within.value if ist.within is not None else None
+    within_ms = None
+    if ist.per is None and ist.within is not None:
+        from ..query_api import Constant as _Const
+        if isinstance(ist.within, tuple) or not isinstance(ist.within, _Const):
+            raise QueryBuildError(
+                "stream join `within` takes a single time constant "
+                "(range/expression forms apply to aggregation joins with `per`)")
+        within_ms = ist.within.value
     jr = JoinRuntime(ist.join_type, ist.trigger, cond_fn,
                      sides["left"]["find"], sides["right"]["find"], within_ms)
 

@@ -26,7 +26,10 @@ from .nfa import DeviceNFACompiler, MergedBatchBuilder
 
 
 def _hash_key(v) -> int:
-    return hash(v) & 0x7FFFFFFF
+    import zlib
+    # stable across processes (hash() randomization would break resumed
+    # checkpoints whose lane assignment must match)
+    return zlib.crc32(str(v).encode()) & 0x7FFFFFFF
 
 
 class PartitionedNFARuntime:
