@@ -32,6 +32,73 @@ _MS = {
 }
 
 
+def parse_within_value(v) -> int:
+    """One bound of a two-arg ``within start, end``: epoch-ms int or a fully
+    specified date string 'YYYY-MM-DD HH:MM:SS[ +HH:MM]' (no wildcards)."""
+    if isinstance(v, (int, float)):
+        return int(v)
+    if isinstance(v, str):
+        if "*" in v:
+            raise ValueError(
+                f"wildcards are only valid in single-value within: {v!r}")
+        return _date_ms(v)
+    raise ValueError("within bound must be a constant timestamp or date string")
+
+
+def parse_within_single(v) -> tuple[Optional[int], Optional[int]]:
+    """Single-arg ``within``: a wildcard pattern covers its whole period
+    (reference: ``aggregation/AggregationRuntime.java`` within handling —
+    '2017-06-** **:**:**' means all of June 2017). Returns [start, end)."""
+    if isinstance(v, (int, float)):
+        return int(v), None
+    if not isinstance(v, str):
+        raise ValueError("within bound must be a constant timestamp or date string")
+    text, tz = _split_tz(v.strip())
+    try:
+        date_part, time_part = text.split()
+        y_s, mo_s, d_s = date_part.split("-")
+        h_s, mi_s, s_s = time_part.split(":")
+    except ValueError:
+        raise ValueError(f"cannot parse within bound {v!r}") from None
+    if "*" in y_s:
+        return None, None  # every year: unbounded
+    fields = [mo_s, d_s, h_s, mi_s, s_s]
+    mins = [1, 1, 0, 0, 0]
+    wild = ["*" in f for f in fields]
+    first = wild.index(True) if any(wild) else 5
+    if not all(wild[first:]):
+        raise ValueError(
+            f"within wildcards must be a contiguous suffix: {v!r}")
+    vals = [int(f) if not w else m for f, w, m in zip(fields, wild, mins)]
+    y = int(y_s)
+    start_dt = _dt.datetime(y, vals[0], vals[1], vals[2], vals[3], vals[4], tzinfo=tz)
+    if first == 0:
+        end_dt = _dt.datetime(y + 1, 1, 1, tzinfo=tz)
+    elif first == 1:
+        end_dt = (_dt.datetime(y + 1, 1, 1, tzinfo=tz) if vals[0] == 12
+                  else _dt.datetime(y, vals[0] + 1, 1, tzinfo=tz))
+    else:
+        unit = {2: _dt.timedelta(days=1), 3: _dt.timedelta(hours=1),
+                4: _dt.timedelta(minutes=1), 5: _dt.timedelta(seconds=1)}[first]
+        end_dt = start_dt + unit
+    return int(start_dt.timestamp() * 1000), int(end_dt.timestamp() * 1000)
+
+
+def _split_tz(text: str):
+    # trailing ' +HH:MM' / ' -HH:MM' timezone offset; default UTC
+    if len(text) > 6 and text[-6] in "+-" and text[-3] == ":" and text[-7] == " ":
+        sign = -1 if text[-6] == "-" else 1
+        h, m = int(text[-5:-3]), int(text[-2:])
+        return text[:-7], _dt.timezone(sign * _dt.timedelta(hours=h, minutes=m))
+    return text, _dt.timezone.utc
+
+
+def _date_ms(text: str) -> int:
+    text, tz = _split_tz(text.strip())
+    dt = _dt.datetime.strptime(text, "%Y-%m-%d %H:%M:%S").replace(tzinfo=tz)
+    return int(dt.timestamp() * 1000)
+
+
 def bucket_start(ts: int, duration: TimePeriodDuration) -> int:
     if duration in _MS:
         return ts - ts % _MS[duration]
@@ -147,19 +214,23 @@ class AggregationRuntime:
             "hour": TimePeriodDuration.HOURS, "day": TimePeriodDuration.DAYS,
             "month": TimePeriodDuration.MONTHS, "year": TimePeriodDuration.YEARS,
         }
+        from .errors import SiddhiAppRuntimeError
         if per not in dur_map:
-            raise KeyError(f"unknown aggregation granularity '{per_value}'")
+            raise SiddhiAppRuntimeError(
+                f"unknown aggregation granularity '{per_value}'")
         d = dur_map[per]
         if d not in self.stores:
-            raise KeyError(
-                f"aggregation '{self.definition.id}' lacks duration {d.value}")
+            raise SiddhiAppRuntimeError(
+                f"aggregation '{self.definition.id}' lacks duration '{d.value}' "
+                f"(defined: {[x.value for x in self.stores]})")
         return d
 
     def rows_for(self, duration: TimePeriodDuration,
                  start: Optional[int] = None, end: Optional[int] = None) -> list[list]:
         buckets = self.stores.get(duration)
         if buckets is None:
-            raise KeyError(
+            from .errors import SiddhiAppRuntimeError
+            raise SiddhiAppRuntimeError(
                 f"aggregation '{self.definition.id}' has no duration {duration}")
         rows = []
         for bs in sorted(buckets):
@@ -185,8 +256,10 @@ class AggregationRuntime:
         start = end = None
         if odq.within:
             vals = [v.value for v in odq.within]
-            start = vals[0]
-            end = vals[1] if len(vals) > 1 else None
+            if len(vals) > 1:
+                start, end = parse_within_value(vals[0]), parse_within_value(vals[1])
+            else:
+                start, end = parse_within_single(vals[0])
         rows = self.rows_for(duration, start, end)
 
         names = self.output_names

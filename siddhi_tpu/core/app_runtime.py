@@ -142,16 +142,7 @@ class SiddhiAppRuntime:
                     for sid in bridge.stream_ids:
                         self._get_junction(sid).subscribe(
                             bridge.receiver_for(sid))
-                    from ..query_api import InsertIntoStream
-                    os_ = element.output_stream
-                    if isinstance(os_, InsertIntoStream):
-                        j = self.ctx.stream_junctions.get(os_.target_id)
-                        if j is not None and not j.definition.attributes:
-                            names, types = bridge.output_schema
-                            d = StreamDefinition(os_.target_id)
-                            for n, t in zip(names, types):
-                                d.attribute(n, t)
-                            j.definition = d
+                    self._fill_implicit(element, bridge)
                     continue
                 rt = build_query_runtime(
                     element, ctx, self._stream_defs(), self._get_junction, name)
@@ -195,7 +186,9 @@ class SiddhiAppRuntime:
             j.definition = define
         return j
 
-    def _fill_implicit(self, query: Query, rt: QueryRuntime) -> None:
+    def _fill_implicit(self, query: Query, rt) -> None:
+        """``rt`` is any runtime exposing ``output_schema`` (host query runtime
+        or device bridge)."""
         from ..query_api import InsertIntoStream
         os = query.output_stream
         if isinstance(os, InsertIntoStream):

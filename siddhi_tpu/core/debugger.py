@@ -73,7 +73,11 @@ class SiddhiDebugger:
         selectors, pattern tables) by element-id prefix."""
         out = {}
         for element_id, holder in self.app_context.state_registry.items():
-            if query_name in element_id:
+            # element ids are '{query}-{kind}[-{seq}]' — prefix match, so
+            # 'q1' doesn't also pick up 'q10-...'
+            if element_id == query_name or \
+                    element_id.startswith(query_name + "-") or \
+                    element_id.startswith("device-" + query_name):
                 try:
                     out[element_id] = holder.snapshot_state()
                 except Exception:  # noqa: BLE001 — best-effort inspection
@@ -120,5 +124,9 @@ class DebuggedOutput:
         dbg = getattr(self.app_context, "debugger", None)
         if dbg is not None:
             for ev in events:
-                dbg.check_break_point(self.query_name, QueryTerminal.OUT, ev)
+                # RESET markers (and window-internal TIMER rows) are engine
+                # protocol, not output events — a stepping user sees only
+                # CURRENT/EXPIRED, like the reference OUT terminal
+                if ev.type in (EventType.CURRENT, EventType.EXPIRED):
+                    dbg.check_break_point(self.query_name, QueryTerminal.OUT, ev)
         self.inner.process(events)

@@ -91,10 +91,15 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
     from ..tpu.expr_compile import DeviceCompileError
 
     target = None
-    if isinstance(query.output_stream, InsertIntoStream):
-        target = get_junction(query.output_stream.target_id,
-                              query.output_stream.is_inner_stream)
     try:
+        if not isinstance(query.output_stream, InsertIntoStream):
+            raise DeviceCompileError(
+                "device path handles insert-into-stream outputs only")
+        tid = query.output_stream.target_id
+        if tid in app_context.tables or tid in app_context.named_windows:
+            raise DeviceCompileError(
+                f"device path cannot target table/window '{tid}'")
+        target = get_junction(tid, query.output_stream.is_inner_stream)
         ist = query.input_stream
         if isinstance(ist, SingleInputStream):
             from ..tpu.batch import BatchBuilder

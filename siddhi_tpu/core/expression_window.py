@@ -36,6 +36,45 @@ class _BufferFrame:
         return self.newest.timestamp
 
 
+class _AggCache:
+    """Running aggregates over a FIFO buffer, resynced by identity against the
+    buffer's endpoints: evictions pop from the front, appends extend the back,
+    so each event's value is computed exactly once."""
+
+    def __init__(self):
+        from collections import deque
+        self.entries = deque()          # (event, value) aligned with buffer
+        self.sum = 0                    # int stays int; += float promotes
+        self.nn = 0                     # non-null count
+        self.minq = deque()             # monotonic (value, event)
+        self.maxq = deque()
+
+    def sync(self, buffer: list, valfn) -> None:
+        ents = self.entries
+        while ents and (not buffer or ents[0][0] is not buffer[0]):
+            ev, v = ents.popleft()
+            if v is not None:
+                self.sum -= v
+                self.nn -= 1
+                if self.minq and self.minq[0][1] is ev:
+                    self.minq.popleft()
+                if self.maxq and self.maxq[0][1] is ev:
+                    self.maxq.popleft()
+        for i in range(len(ents), len(buffer)):
+            ev = buffer[i]
+            v = valfn(ev)
+            ents.append((ev, v))
+            if v is not None:
+                self.sum += v
+                self.nn += 1
+                while self.minq and self.minq[-1][0] >= v:
+                    self.minq.pop()
+                self.minq.append((v, ev))
+                while self.maxq and self.maxq[-1][0] <= v:
+                    self.maxq.pop()
+                self.maxq.append((v, ev))
+
+
 class _BufferResolver(VariableResolver):
     def __init__(self, definition: StreamDefinition):
         self.definition = definition
@@ -59,20 +98,28 @@ def _build_buffer_fn(expr, definition: StreamDefinition, app_context) -> Callabl
 
     def agg_builder(kind):
         def build(fns, types):
+            # incremental per-window cache: the buffer is FIFO (append at the
+            # back, evict from the front), so running sum/count plus monotonic
+            # deques give O(1) amortized evaluation instead of re-walking the
+            # whole buffer on every check (the reference keeps equivalent
+            # incremental state in ExpressionWindowProcessor's per-attribute
+            # executors)
+            cache = _AggCache()
+
             def run(f: _BufferFrame):
                 if kind == "count":
                     return len(f.buffer)
-                vals = [fns[0](_BufferFrame(f.buffer, e)) for e in f.buffer]
-                vals = [v for v in vals if v is not None]
-                if not vals:
+                cache.sync(f.buffer,
+                           lambda e: fns[0](_BufferFrame(f.buffer, e)))
+                if cache.nn == 0:
                     return None
                 if kind == "sum":
-                    return sum(vals)
+                    return cache.sum
                 if kind == "avg":
-                    return sum(vals) / len(vals)
+                    return cache.sum / cache.nn
                 if kind == "min":
-                    return min(vals)
-                return max(vals)
+                    return cache.minq[0][0]
+                return cache.maxq[0][0]
             return run, DataType.DOUBLE if kind in ("avg",) else (
                 types[0] if types else DataType.LONG)
         return build

@@ -62,24 +62,12 @@ class QueryBuildError(Exception):
 
 
 def _within_bound(expr) -> int:
-    """Aggregation-join within bound: epoch-ms int or a date string
-    'YYYY-MM-DD HH:MM:SS[ +HH:MM]' (reference SiddhiQL accepts both)."""
-    v = getattr(expr, "value", None)
-    if isinstance(v, (int, float)):
-        return int(v)
-    if isinstance(v, str):
-        import datetime as _dt
-        text = v.strip().replace("**", "01")
-        for fmt in ("%Y-%m-%d %H:%M:%S %z", "%Y-%m-%d %H:%M:%S"):
-            try:
-                dt = _dt.datetime.strptime(text, fmt)
-                if dt.tzinfo is None:
-                    dt = dt.replace(tzinfo=_dt.timezone.utc)
-                return int(dt.timestamp() * 1000)
-            except ValueError:
-                continue
-        raise QueryBuildError(f"cannot parse within bound {v!r}")
-    raise QueryBuildError("within bound must be a constant timestamp or date string")
+    """One bound of a two-arg aggregation-join ``within start, end``."""
+    from .aggregation import parse_within_value
+    try:
+        return parse_within_value(getattr(expr, "value", None))
+    except ValueError as e:
+        raise QueryBuildError(str(e)) from None
 
 
 # ---------------------------------------------------------------------------
@@ -321,9 +309,11 @@ def build_query_runtime(query: Query, app_context, stream_defs: dict,
                                   app_context.element_id(f"{qid}-selector"))
         app_context.register_state(selector.element_id, selector)
         pattern_rt.next = selector
+        from .debugger import DebuggedReceiver
         from .pattern import PatternStreamReceiver
         for sid in compiled.stream_ids:
-            rt.subscriptions.append((sid, PatternStreamReceiver(pattern_rt, sid)))
+            rt.subscriptions.append((sid, DebuggedReceiver(
+                PatternStreamReceiver(pattern_rt, sid), name, app_context)))
 
     elif isinstance(ist, JoinInputStream):
         selector = _build_join(ist, rt, app_context, stream_defs, stream_def,
@@ -421,13 +411,21 @@ def _build_join(ist: JoinInputStream, rt: QueryRuntime, app_context,
             if ist.per is None:
                 raise QueryBuildError(
                     "aggregation join needs `per '<granularity>'`")
-            duration = agg.duration_for(ist.per.value)
+            from .errors import SiddhiAppRuntimeError
+            try:
+                duration = agg.duration_for(ist.per.value)
+            except SiddhiAppRuntimeError as e:
+                raise QueryBuildError(str(e)) from None
             w = ist.within
             start = end = None
             if isinstance(w, tuple):
                 start, end = _within_bound(w[0]), _within_bound(w[1])
             elif w is not None:
-                start = _within_bound(w)
+                from .aggregation import parse_within_single
+                try:
+                    start, end = parse_within_single(getattr(w, "value", None))
+                except ValueError as e:
+                    raise QueryBuildError(str(e)) from None
             def agg_find(agg=agg, duration=duration, start=start, end=end):
                 from .event import StreamEvent as _SE
                 return [_SE(r[0], r) for r in agg.rows_for(duration, start, end)]
@@ -494,9 +492,10 @@ def _build_join(ist: JoinInputStream, rt: QueryRuntime, app_context,
     for label, is_left in (("left", True), ("right", False)):
         side = sides[label]
         if side["kind"] == "stream":
+            from .debugger import DebuggedReceiver
             side["tail"].set_next(JoinSide(jr, is_left))
-            rt.subscriptions.append((side["stream"].stream_id,
-                                    StreamReceiver(side["head"])))
+            rt.subscriptions.append((side["stream"].stream_id, DebuggedReceiver(
+                StreamReceiver(side["head"]), rt.name, app_context)))
         elif side["kind"] == "window":
             nw = app_context.named_windows[side["stream"].stream_id]
             bridge = _ChainHead()

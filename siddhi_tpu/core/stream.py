@@ -123,6 +123,13 @@ class InputHandler:
                 self._send_one(ts, list(data))
 
     def _send_one(self, ts: int, data: list) -> None:
+        defn = self.junction.definition
+        if len(data) != len(defn.attributes):
+            from .errors import SiddhiAppRuntimeError
+            sig = ", ".join(f"{a.name} {a.type.value}" for a in defn.attributes)
+            raise SiddhiAppRuntimeError(
+                f"stream '{self.stream_id}' expects {len(defn.attributes)} "
+                f"attributes ({sig}) but got {len(data)}: {data!r}")
         # watermark: advance clock & fire due timers before the event itself
         self.app_context.advance_time(ts)
         self.junction.send_event(StreamEvent(ts, data, EventType.CURRENT))

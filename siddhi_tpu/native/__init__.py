@@ -1,0 +1,191 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference runs its ingress hot path on the JVM (Disruptor ring +
+per-event ``StreamEvent`` allocation, ``stream/StreamJunction.java:254-316``).
+Here the equivalent is ``ingress.cpp``: a C++ data-loader that parses raw
+transport bytes (CSV lines), dictionary-encodes strings, routes rows to
+partition lanes (crc32 — bit-identical to ``tpu/partition.py::_hash_key``)
+and packs fixed-capacity SoA column buffers that ``emit_lane`` copies into
+numpy arrays ready for ``jax.device_put``.
+
+Built on first import with ``g++ -O3`` into ``_build/``; if no toolchain is
+available ``NATIVE_AVAILABLE`` is False and callers fall back to the pure
+Python packers (``tpu/batch.py``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "ingress.cpp")
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_SO = os.path.join(_BUILD_DIR, "libsiddhi_ingress.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+NATIVE_AVAILABLE = False
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return True
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _SO, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib, NATIVE_AVAILABLE
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not _build():
+            return None
+        lib = ctypes.CDLL(_SO)
+        lib.sp_create.restype = ctypes.c_void_p
+        lib.sp_create.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_int, ctypes.c_int64]
+        lib.sp_destroy.argtypes = [ctypes.c_void_p]
+        lib.sp_encode.restype = ctypes.c_int32
+        lib.sp_encode.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+        lib.sp_dict_size.restype = ctypes.c_int64
+        lib.sp_dict_size.argtypes = [ctypes.c_void_p]
+        lib.sp_dict_get.restype = ctypes.c_int64
+        lib.sp_dict_get.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                    ctypes.c_char_p, ctypes.c_int64]
+        lib.sp_lane_of.restype = ctypes.c_int32
+        lib.sp_lane_of.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+        lib.sp_lane_len.restype = ctypes.c_int64
+        lib.sp_lane_len.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.sp_parse_errors.restype = ctypes.c_int64
+        lib.sp_parse_errors.argtypes = [ctypes.c_void_p]
+        lib.sp_ingest_csv.restype = ctypes.c_int64
+        lib.sp_ingest_csv.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int32, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.sp_emit_lane.restype = ctypes.c_int64
+        lib.sp_emit_lane.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p]
+        _lib = lib
+        NATIVE_AVAILABLE = True
+        return lib
+
+
+_TYPE_NP = {
+    "f": np.float32, "d": np.float64, "i": np.int32, "l": np.int64,
+    "b": np.uint8, "s": np.int32,
+}
+
+
+class NativeIngress:
+    """Lane-routed CSV ingress backed by the C++ library.
+
+    ``types`` is one char per payload column ('f','d','i','l','b','s');
+    ``key_col`` is the payload column index used for crc32 lane routing
+    (-1 routes everything to lane 0).
+    """
+
+    def __init__(self, types: str, key_col: int = -1, n_lanes: int = 1,
+                 capacity: int = 1024):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native ingress unavailable (no g++?)")
+        self._lib = lib
+        self.types = types
+        self.n_lanes = n_lanes
+        self.capacity = capacity
+        self._h = lib.sp_create(types.encode(), len(types), key_col, n_lanes,
+                                capacity)
+        if not self._h:
+            raise ValueError("sp_create failed (bad schema)")
+        self._row_seq = ctypes.c_int64(0)
+        self._decode_cache: list = [None]
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.sp_destroy(h)
+            self._h = None
+
+    # -- ingest ------------------------------------------------------------
+    def ingest_csv(self, data: bytes, base_ts: int = 0, ts_last: bool = False,
+                   tag: int = 0, final: bool = True) -> int:
+        """Feeds raw CSV bytes; returns bytes consumed (< len(data) when a
+        lane filled up — drain with emit_lane and call again with the rest)."""
+        return self._lib.sp_ingest_csv(
+            self._h, data, len(data), base_ts, 1 if ts_last else 0, tag,
+            1 if final else 0, ctypes.byref(self._row_seq))
+
+    # -- dictionary --------------------------------------------------------
+    def encode(self, s: str) -> int:
+        b = s.encode()
+        return self._lib.sp_encode(self._h, b, len(b))
+
+    def decode(self, code: int):
+        if code == 0:
+            return None
+        cache = self._decode_cache
+        if code < len(cache) and cache[code] is not None:
+            return cache[code]
+        buf = ctypes.create_string_buffer(4096)
+        n = self._lib.sp_dict_get(self._h, code, buf, 4096)
+        if n < 0:
+            return None
+        s = buf.raw[:n].decode()
+        while len(cache) <= code:
+            cache.append(None)
+        cache[code] = s
+        return s
+
+    def dict_size(self) -> int:
+        return self._lib.sp_dict_size(self._h)
+
+    def lane_of(self, key: str) -> int:
+        b = key.encode()
+        return self._lib.sp_lane_of(self._h, b, len(b))
+
+    def lane_len(self, lane: int) -> int:
+        return self._lib.sp_lane_len(self._h, lane)
+
+    @property
+    def parse_errors(self) -> int:
+        return self._lib.sp_parse_errors(self._h)
+
+    # -- emit --------------------------------------------------------------
+    def emit_lane(self, lane: int) -> dict:
+        """Drains one lane into fresh numpy arrays padded to capacity.
+
+        Returns {'cols': [np array per payload column], 'ts', 'tag', 'valid',
+        'count'} — same contract as tpu/batch.py builders."""
+        cap = self.capacity
+        cols = [np.zeros(cap, dtype=_TYPE_NP[t]) for t in self.types]
+        ts = np.zeros(cap, dtype=np.int64)
+        tag = np.zeros(cap, dtype=np.int32)
+        valid = np.zeros(cap, dtype=np.uint8)
+        ptrs = (ctypes.c_void_p * len(cols))(
+            *[c.ctypes.data_as(ctypes.c_void_p).value for c in cols])
+        n = self._lib.sp_emit_lane(
+            self._h, lane, ptrs,
+            ts.ctypes.data_as(ctypes.c_void_p),
+            tag.ctypes.data_as(ctypes.c_void_p),
+            valid.ctypes.data_as(ctypes.c_void_p))
+        return {"cols": cols, "ts": ts, "tag": tag,
+                "valid": valid.astype(bool), "count": int(n)}
+
+
+def native_available() -> bool:
+    return _load() is not None

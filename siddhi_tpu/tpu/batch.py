@@ -43,6 +43,16 @@ class StringDictionary:
             return self._values[code]
         return None
 
+    def add(self, code: int, value: str) -> None:
+        """Registers an externally minted (code, value) pair — used to sync
+        entries assigned by the native ingress dictionary. Codes must arrive
+        in sequence."""
+        if code != len(self._values):
+            raise ValueError(
+                f"out-of-sequence dictionary code {code} (next is {len(self._values)})")
+        self._codes[value] = code
+        self._values.append(value)
+
     def __len__(self) -> int:
         return len(self._values)
 

@@ -54,6 +54,7 @@ class PartitionedNFARuntime:
             query = app.partitions[0].queries[query_index]
         self.P = num_partitions
         self.key_attr = key_attr
+        self.lane_batch = lane_batch
         self.mesh = mesh
         self.axis = axis
         self.compiler = DeviceNFACompiler(
@@ -95,7 +96,94 @@ class PartitionedNFARuntime:
     def lane_of(self, key) -> int:
         return _hash_key(key) % self.P
 
+    # -- native (C++) CSV ingress ------------------------------------------
+    def enable_native_ingress(self) -> None:
+        """Routes raw CSV bytes through the C++ data-loader (no Python in the
+        per-event loop): parse → dict-encode → crc32 lane routing → SoA pack.
+        Single-input-stream patterns only (the bench/north-star shape)."""
+        from ..query_api.definition import DataType
+        from ..native import NativeIngress, native_available
+
+        if not native_available():
+            raise RuntimeError("native ingress unavailable (no g++)")
+        if len(self.compiler.merged.stream_ids) != 1:
+            raise ValueError("native CSV ingress supports single-stream patterns")
+        sid = self.compiler.merged.stream_ids[0]
+        d = self.stream_defs[sid]
+        chars = {DataType.STRING: "s", DataType.INT: "i", DataType.LONG: "l",
+                 DataType.FLOAT: "f", DataType.DOUBLE: "d", DataType.BOOL: "b"}
+        types = "".join(chars[a.type] for a in d.attributes)
+        self._ning = NativeIngress(
+            types, key_col=d.attribute_position(self.key_attr),
+            n_lanes=self.P, capacity=self.lane_batch)
+        # replay already-assigned codes (compile-time string constants) so the
+        # native dictionary assigns identical codes from here on
+        self._shared_dict = next(
+            iter(self.compiler.merged.dictionaries.values()), None)
+        if self._shared_dict is not None:
+            for code in range(1, len(self._shared_dict)):
+                self._ning.encode(self._shared_dict.decode(code))
+        self._col_keys = [f"s0_{a.name}" for a in d.attributes]
+        self._bool_cols = [a.type == DataType.BOOL for a in d.attributes]
+
+    def ingest_csv(self, data: bytes, base_ts: int = 0, ts_last: bool = False,
+                   decode: bool = False) -> list:
+        """Feeds raw CSV bytes end-to-end; flushes full lanes as it goes."""
+        decode = decode or self.callback is not None
+        rows: list = []
+        pos = 0
+        n = len(data)
+        while pos < n:
+            consumed = self._ning.ingest_csv(
+                data[pos:], base_ts=base_ts, ts_last=ts_last)
+            pos += consumed
+            if pos < n:  # a lane filled: drain to device and resume
+                out = self.flush_native(decode=decode)
+                if decode and out:
+                    rows.extend(out)
+        return rows
+
+    def flush_native(self, decode: bool = False):
+        decode = decode or self.callback is not None
+        if all(self._ning.lane_len(ln) == 0 for ln in range(self.P)):
+            return [] if decode else None
+        batches = [self._ning.emit_lane(ln) for ln in range(self.P)]
+        cols = {}
+        for ci, key in enumerate(self._col_keys):
+            stacked = np.stack([bt["cols"][ci] for bt in batches])
+            if self._bool_cols[ci]:
+                stacked = stacked.astype(bool)
+            cols[key] = stacked
+        tag = np.stack([bt["tag"] for bt in batches])
+        ts = np.stack([bt["ts"] for bt in batches])
+        valid = np.stack([bt["valid"] for bt in batches])
+        self.state, ys = self._vstep(self.state, cols, tag, ts, valid)
+        if not decode:
+            return ys
+        self._sync_dict_from_native()
+        rows = []
+        for lane in range(self.P):
+            lane_ys = jax.tree_util.tree_map(lambda x: x[lane], ys)
+            rows.extend(self.compiler.decode_outputs(lane_ys))
+        if self.callback is not None and rows:
+            self.callback(rows)
+        return rows
+
+    def _sync_dict_from_native(self) -> None:
+        # pull strings the C++ dict minted during ingest into the Python
+        # shared dictionary so decode_outputs can render them
+        d = self._shared_dict
+        if d is None:
+            return
+        for code in range(len(d), self._ning.dict_size()):
+            d.add(code, self._ning.decode(code))
+
     def send(self, stream_id: str, row: list, timestamp: int) -> None:
+        if getattr(self, "_ning", None) is not None:
+            # host append would mint dictionary codes the C++ dict doesn't
+            # know about, silently corrupting decode — one ingress owns codes
+            raise RuntimeError(
+                "native ingress enabled: use ingest_csv(), not send()")
         d = self.stream_defs[stream_id]
         key = row[d.attribute_position(self.key_attr)]
         lane = self.lane_of(key)

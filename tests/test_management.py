@@ -398,3 +398,79 @@ def test_log_error_action_continues(manager):
     rt.start()
     rt.input_handler("S").send([7], timestamp=1)   # must not raise
     assert [e.data for e in good] == [[7]]
+
+
+def test_debugger_in_breakpoint_on_pattern_and_join():
+    """IN breakpoints fire for pattern and join queries (not just single-stream)."""
+    from siddhi_tpu.core.debugger import QueryTerminal
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+define stream A (v int);
+define stream B (v int);
+@info(name='pq')
+from e1=A[v > 0] -> e2=B[v > e1.v] select e1.v as a, e2.v as b insert into P;
+@info(name='jq')
+from A join B on A.v == B.v select A.v insert into J;
+""", playback=True)
+    dbg = rt.debug()
+    hits = []
+    dbg.set_debugger_callback(
+        lambda ev, q, term, d: hits.append((q, term.value)) or "play")
+    dbg.acquire_break_point("pq", QueryTerminal.IN)
+    dbg.acquire_break_point("jq", QueryTerminal.IN)
+    rt.input_handler("A").send([5], timestamp=1000)
+    rt.input_handler("B").send([9], timestamp=1001)
+    assert ("pq", "in") in hits
+    assert ("jq", "in") in hits
+
+
+def test_debugger_out_skips_reset_markers():
+    """OUT terminal surfaces only CURRENT/EXPIRED events, never RESET."""
+    from siddhi_tpu.core.debugger import QueryTerminal
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+define stream S (v int);
+@info(name='q')
+from S#window.lengthBatch(2) select v insert into O;
+""", playback=True)
+    dbg = rt.debug()
+    seen = []
+    dbg.set_debugger_callback(lambda ev, q, term, d: seen.append(ev) or "play")
+    dbg.acquire_break_point("q", QueryTerminal.OUT)
+    h = rt.input_handler("S")
+    h.send([1], timestamp=1000)
+    h.send([2], timestamp=1001)   # batch flush: CURRENTs (+ RESET internally)
+    assert len(seen) >= 2
+    assert all(ev.data for ev in seen)   # no empty RESET payloads
+
+
+def test_aggregation_wildcard_within():
+    """`within '2017-06-** **:**:**'` covers exactly June 2017."""
+    import datetime as dt
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+define stream Trades (sym string, px double);
+define aggregation TA from Trades select sym, sum(px) as total
+group by sym aggregate every days;
+""", playback=True)
+    rt.start()
+    h = rt.input_handler("Trades")
+
+    def ms(y, mo, d):
+        return int(dt.datetime(y, mo, d, 12, 0, 0,
+                               tzinfo=dt.timezone.utc).timestamp() * 1000)
+
+    h.send(["a", 10.0], timestamp=ms(2017, 5, 31))
+    h.send(["a", 20.0], timestamp=ms(2017, 6, 1))
+    h.send(["a", 30.0], timestamp=ms(2017, 6, 30))
+    h.send(["a", 40.0], timestamp=ms(2017, 7, 1))
+    rows = rt.query(
+        "from TA within '2017-06-** **:**:**' per 'days' select sym, total")
+    assert sum(r.data[1] for r in rows) == 50.0
+    # full-year wildcard covers everything in 2017
+    rows = rt.query(
+        "from TA within '2017-**-** **:**:**' per 'days' select sym, total")
+    assert sum(r.data[1] for r in rows) == 100.0

@@ -139,3 +139,23 @@ def test_unsupported_falls_back_cleanly():
         define stream S (v double);
         from S select stdDev(v) as sd insert into O;
         """)
+
+
+def test_device_query_table_target_falls_back_to_host():
+    """@device targeting a table can't run on the device path — it must fall
+    back to the host runtime so the table actually fills."""
+    from siddhi_tpu import SiddhiManager
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+define stream S (v long);
+define table T (v long, w long);
+@device(batch='2')
+from S select v, v + 1 as w insert into T;
+""", playback=True)
+    rt.start()
+    h = rt.input_handler("S")
+    for i in range(4):
+        h.send([i], timestamp=1000 + i)
+    rows = sorted(e.data for e in rt.query("from T select v, w"))
+    assert rows == [[0, 1], [1, 2], [2, 3], [3, 4]]

@@ -196,3 +196,20 @@ def test_cron_window(manager):
     ih.send([2], timestamp=500)
     rt.advance_time(2500)    # cron fires at 2000
     assert [e.data[0] for e in got] == [1, 3]
+
+
+def test_expression_window_incremental_aggregates_scale():
+    """sum() over the buffer is O(1) amortized per event, not O(n)."""
+    import time
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+define stream S (v long);
+from S#window.expression('sum(v) <= 100000000') select v insert into O;
+""", playback=True)
+    rt.start()
+    h = rt.input_handler("S")
+    t0 = time.perf_counter()
+    for i in range(20_000):
+        h.send([1], timestamp=1000 + i)
+    assert time.perf_counter() - t0 < 5.0   # O(n^2) would take minutes
