@@ -21,6 +21,7 @@ from ..query_api import (
 )
 from ..query_api.definition import AggregationDefinition, TimePeriodDuration
 from .aggregators import AGGREGATOR_NAMES, aggregator_return_type, make_aggregator
+from .errors import SiddhiAppRuntimeError
 from .event import Event, EventType, StreamEvent
 from .executor import ExecutorBuilder, StreamFrame, StreamResolver
 
@@ -39,10 +40,10 @@ def parse_within_value(v) -> int:
         return int(v)
     if isinstance(v, str):
         if "*" in v:
-            raise ValueError(
+            raise SiddhiAppRuntimeError(
                 f"wildcards are only valid in single-value within: {v!r}")
         return _date_ms(v)
-    raise ValueError("within bound must be a constant timestamp or date string")
+    raise SiddhiAppRuntimeError("within bound must be a constant timestamp or date string")
 
 
 def parse_within_single(v) -> tuple[Optional[int], Optional[int]]:
@@ -52,14 +53,14 @@ def parse_within_single(v) -> tuple[Optional[int], Optional[int]]:
     if isinstance(v, (int, float)):
         return int(v), None
     if not isinstance(v, str):
-        raise ValueError("within bound must be a constant timestamp or date string")
+        raise SiddhiAppRuntimeError("within bound must be a constant timestamp or date string")
     text, tz = _split_tz(v.strip())
     try:
         date_part, time_part = text.split()
         y_s, mo_s, d_s = date_part.split("-")
         h_s, mi_s, s_s = time_part.split(":")
     except ValueError:
-        raise ValueError(f"cannot parse within bound {v!r}") from None
+        raise SiddhiAppRuntimeError(f"cannot parse within bound {v!r}") from None
     if "*" in y_s:
         return None, None  # every year: unbounded
     fields = [mo_s, d_s, h_s, mi_s, s_s]
@@ -67,7 +68,7 @@ def parse_within_single(v) -> tuple[Optional[int], Optional[int]]:
     wild = ["*" in f for f in fields]
     first = wild.index(True) if any(wild) else 5
     if not all(wild[first:]):
-        raise ValueError(
+        raise SiddhiAppRuntimeError(
             f"within wildcards must be a contiguous suffix: {v!r}")
     vals = [int(f) if not w else m for f, w, m in zip(fields, wild, mins)]
     y = int(y_s)

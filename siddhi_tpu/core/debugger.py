@@ -77,7 +77,7 @@ class SiddhiDebugger:
             # 'q1' doesn't also pick up 'q10-...'
             if element_id == query_name or \
                     element_id.startswith(query_name + "-") or \
-                    element_id.startswith("device-" + query_name):
+                    element_id == "device-" + query_name:
                 try:
                     out[element_id] = holder.snapshot_state()
                 except Exception:  # noqa: BLE001 — best-effort inspection

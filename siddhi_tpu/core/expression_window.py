@@ -95,6 +95,10 @@ class _BufferResolver(VariableResolver):
 def _build_buffer_fn(expr, definition: StreamDefinition, app_context) -> Callable:
     """Compile the window expression with buffer-aggregate function support."""
     resolver = _BufferResolver(definition)
+    # set after the rewrite pass below; True when some aggregate's argument
+    # references first./last./eventTimestamp — those change as the buffer
+    # moves, so per-event values can't be cached at append time
+    _agg_arg_buffer_dep = [False]
 
     def agg_builder(kind):
         def build(fns, types):
@@ -109,6 +113,16 @@ def _build_buffer_fn(expr, definition: StreamDefinition, app_context) -> Callabl
             def run(f: _BufferFrame):
                 if kind == "count":
                     return len(f.buffer)
+                if _agg_arg_buffer_dep[0]:
+                    vals = [v for v in (fns[0](_BufferFrame(f.buffer, e))
+                                        for e in f.buffer) if v is not None]
+                    if not vals:
+                        return None
+                    if kind == "sum":
+                        return sum(vals)
+                    if kind == "avg":
+                        return sum(vals) / len(vals)
+                    return min(vals) if kind == "min" else max(vals)
                 cache.sync(f.buffer,
                            lambda e: fns[0](_BufferFrame(f.buffer, e)))
                 if cache.nn == 0:
@@ -150,6 +164,38 @@ def _build_buffer_fn(expr, definition: StreamDefinition, app_context) -> Callabl
         return e
 
     expr = rewrite(expr)
+
+    def _buffer_dep(e) -> bool:
+        if isinstance(e, _TimestampOf):
+            return True
+        if isinstance(e, Variable) and e.stream_id in ("first", "last"):
+            return True
+        if isinstance(e, AttributeFunction) and e.namespace is None \
+                and e.name in ("sum", "avg", "min", "max", "count"):
+            return True  # nested aggregate: value moves with the buffer
+        for attr in ("left", "right", "expr"):
+            sub = getattr(e, attr, None)
+            if sub is not None and not isinstance(sub, (int, float, str, bool)) \
+                    and _buffer_dep(sub):
+                return True
+        if isinstance(e, AttributeFunction):
+            return any(_buffer_dep(a) for a in e.args)
+        return False
+
+    def _scan_agg_args(e) -> None:
+        if isinstance(e, AttributeFunction) and e.namespace is None \
+                and e.name in ("sum", "avg", "min", "max"):
+            if any(_buffer_dep(a) for a in e.args):
+                _agg_arg_buffer_dep[0] = True
+        for attr in ("left", "right", "expr"):
+            sub = getattr(e, attr, None)
+            if sub is not None and not isinstance(sub, (int, float, str, bool)):
+                _scan_agg_args(sub)
+        if isinstance(e, AttributeFunction):
+            for a in e.args:
+                _scan_agg_args(a)
+
+    _scan_agg_args(expr)
 
     class _Builder(ExecutorBuilder):
         def build(self, e):

@@ -64,9 +64,10 @@ class QueryBuildError(Exception):
 def _within_bound(expr) -> int:
     """One bound of a two-arg aggregation-join ``within start, end``."""
     from .aggregation import parse_within_value
+    from .errors import SiddhiAppRuntimeError
     try:
         return parse_within_value(getattr(expr, "value", None))
-    except ValueError as e:
+    except (ValueError, SiddhiAppRuntimeError) as e:
         raise QueryBuildError(str(e)) from None
 
 
@@ -424,7 +425,7 @@ def _build_join(ist: JoinInputStream, rt: QueryRuntime, app_context,
                 from .aggregation import parse_within_single
                 try:
                     start, end = parse_within_single(getattr(w, "value", None))
-                except ValueError as e:
+                except (ValueError, SiddhiAppRuntimeError) as e:
                     raise QueryBuildError(str(e)) from None
             def agg_find(agg=agg, duration=duration, start=start, end=end):
                 from .event import StreamEvent as _SE

@@ -113,6 +113,8 @@ class InputHandler:
                 # delivery — firing later timers first would reorder events
                 # around window boundaries; the rest advances after the chunk
                 self.app_context.advance_time(min(ev.timestamp for ev in data))
+                for ev in data:
+                    self._check_arity(ev.data)
                 self.junction.send_events([
                     StreamEvent(ev.timestamp, list(ev.data), EventType.CURRENT)
                     for ev in data
@@ -122,7 +124,7 @@ class InputHandler:
                 ts = timestamp if timestamp is not None else self.app_context.current_time()
                 self._send_one(ts, list(data))
 
-    def _send_one(self, ts: int, data: list) -> None:
+    def _check_arity(self, data) -> None:
         defn = self.junction.definition
         if len(data) != len(defn.attributes):
             from .errors import SiddhiAppRuntimeError
@@ -130,6 +132,9 @@ class InputHandler:
             raise SiddhiAppRuntimeError(
                 f"stream '{self.stream_id}' expects {len(defn.attributes)} "
                 f"attributes ({sig}) but got {len(data)}: {data!r}")
+
+    def _send_one(self, ts: int, data: list) -> None:
+        self._check_arity(data)
         # watermark: advance clock & fire due timers before the event itself
         self.app_context.advance_time(ts)
         self.junction.send_event(StreamEvent(ts, data, EventType.CURRENT))

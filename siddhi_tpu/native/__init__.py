@@ -52,7 +52,21 @@ def _load():
             return _lib
         if not _build():
             return None
-        lib = ctypes.CDLL(_SO)
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            # stale/wrong-arch .so (e.g. leftover from another platform):
+            # force a rebuild from source and retry once
+            try:
+                os.remove(_SO)
+            except OSError:
+                return None
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(_SO)
+            except OSError:
+                return None
         lib.sp_create.restype = ctypes.c_void_p
         lib.sp_create.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
                                   ctypes.c_int, ctypes.c_int64]
@@ -72,7 +86,7 @@ def _load():
         lib.sp_parse_errors.argtypes = [ctypes.c_void_p]
         lib.sp_ingest_csv.restype = ctypes.c_int64
         lib.sp_ingest_csv.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int, ctypes.c_int32, ctypes.c_int,
             ctypes.POINTER(ctypes.c_int64)]
         lib.sp_emit_lane.restype = ctypes.c_int64
@@ -123,12 +137,15 @@ class NativeIngress:
 
     # -- ingest ------------------------------------------------------------
     def ingest_csv(self, data: bytes, base_ts: int = 0, ts_last: bool = False,
-                   tag: int = 0, final: bool = True) -> int:
-        """Feeds raw CSV bytes; returns bytes consumed (< len(data) when a
-        lane filled up — drain with emit_lane and call again with the rest)."""
+                   tag: int = 0, final: bool = True, offset: int = 0) -> int:
+        """Feeds raw CSV bytes starting at ``offset`` (no copy); returns bytes
+        consumed (stops short when a lane filled up — drain with emit_lane and
+        call again with offset advanced past the consumed prefix)."""
+        addr = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p).value
         return self._lib.sp_ingest_csv(
-            self._h, data, len(data), base_ts, 1 if ts_last else 0, tag,
-            1 if final else 0, ctypes.byref(self._row_seq))
+            self._h, addr + offset, len(data) - offset, base_ts,
+            1 if ts_last else 0, tag, 1 if final else 0,
+            ctypes.byref(self._row_seq))
 
     # -- dictionary --------------------------------------------------------
     def encode(self, s: str) -> int:
@@ -139,12 +156,17 @@ class NativeIngress:
         if code == 0:
             return None
         cache = self._decode_cache
-        if code < len(cache) and cache[code] is not None:
+        if 0 < code < len(cache) and cache[code] is not None:
             return cache[code]
-        buf = ctypes.create_string_buffer(4096)
-        n = self._lib.sp_dict_get(self._h, code, buf, 4096)
-        if n < 0:
+        if code < 0 or code >= self._lib.sp_dict_size(self._h):
             return None
+        cap = 4096
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.sp_dict_get(self._h, code, buf, cap)
+            if n >= 0:
+                break
+            cap *= 2  # valid code, so -1 means the buffer was too small
         s = buf.raw[:n].decode()
         while len(cache) <= code:
             cache.append(None)

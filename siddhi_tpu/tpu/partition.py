@@ -135,7 +135,7 @@ class PartitionedNFARuntime:
         n = len(data)
         while pos < n:
             consumed = self._ning.ingest_csv(
-                data[pos:], base_ts=base_ts, ts_last=ts_last)
+                data, base_ts=base_ts, ts_last=ts_last, offset=pos)
             pos += consumed
             if pos < n:  # a lane filled: drain to device and resume
                 out = self.flush_native(decode=decode)
@@ -157,17 +157,9 @@ class PartitionedNFARuntime:
         tag = np.stack([bt["tag"] for bt in batches])
         ts = np.stack([bt["ts"] for bt in batches])
         valid = np.stack([bt["valid"] for bt in batches])
-        self.state, ys = self._vstep(self.state, cols, tag, ts, valid)
-        if not decode:
-            return ys
-        self._sync_dict_from_native()
-        rows = []
-        for lane in range(self.P):
-            lane_ys = jax.tree_util.tree_map(lambda x: x[lane], ys)
-            rows.extend(self.compiler.decode_outputs(lane_ys))
-        if self.callback is not None and rows:
-            self.callback(rows)
-        return rows
+        if decode:
+            self._sync_dict_from_native()
+        return self._step_and_decode(cols, tag, ts, valid, decode)
 
     def _sync_dict_from_native(self) -> None:
         # pull strings the C++ dict minted during ingest into the Python
@@ -203,16 +195,19 @@ class PartitionedNFARuntime:
         tag = np.stack([bt["tag"] for bt in batches])
         ts = np.stack([bt["ts"] for bt in batches])
         valid = np.stack([bt["valid"] for bt in batches])
+        return self._step_and_decode(cols, tag, ts, valid, decode)
+
+    def _step_and_decode(self, cols, tag, ts, valid, decode: bool):
         self.state, ys = self._vstep(self.state, cols, tag, ts, valid)
-        if decode:
-            rows = []
-            for lane in range(self.P):
-                lane_ys = jax.tree_util.tree_map(lambda x: x[lane], ys)
-                rows.extend(self.compiler.decode_outputs(lane_ys))
-            if self.callback is not None and rows:
-                self.callback(rows)
-            return rows
-        return ys
+        if not decode:
+            return ys
+        rows = []
+        for lane in range(self.P):
+            lane_ys = jax.tree_util.tree_map(lambda x: x[lane], ys)
+            rows.extend(self.compiler.decode_outputs(lane_ys))
+        if self.callback is not None and rows:
+            self.callback(rows)
+        return rows
 
     @property
     def match_count(self) -> int:
