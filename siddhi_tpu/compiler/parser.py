@@ -215,12 +215,20 @@ class Parser:
                     ann.annotations.append(self.parse_annotation())
                 else:
                     t = self.peek()
+                    # keys may be dotted identifiers: buffer.size, cache.policy
+                    klen = 0
+                    if t.type == TokenType.IDENT:
+                        klen = 1
+                        while (self.peek(klen).type == TokenType.OP
+                               and self.peek(klen).value == "."
+                               and self.peek(klen + 1).type == TokenType.IDENT):
+                            klen += 2
                     if (
-                        t.type == TokenType.IDENT
-                        and self.peek(1).type == TokenType.OP
-                        and self.peek(1).value == "="
+                        klen
+                        and self.peek(klen).type == TokenType.OP
+                        and self.peek(klen).value == "="
                     ):
-                        key = self.next().value
+                        key = "".join(self.next().value for _ in range(klen))
                         self.next()  # '='
                         ann.element(key, self.parse_annotation_value())
                     else:

@@ -94,6 +94,16 @@ class SiddhiAppRuntime:
                         f"no store extension '{store_type}' for table '{td.id}'")
                 table = cls(td, ctx)
                 table.init(td, {e.key: e.value for e in store_ann.elements if e.key})
+                cache_ann = store_ann.nested("cache")
+                if cache_ann is not None:
+                    from .table import CacheTable
+                    table = CacheTable(
+                        td, ctx, backing=table,
+                        max_size=int(cache_ann.get("size")
+                                     or cache_ann.get("cache.size") or "128"),
+                        policy=(cache_ann.get("cache.policy")
+                                or cache_ann.get("policy") or "FIFO"))
+                    table.preload()
             else:
                 table = InMemoryTable(td, ctx)
             ctx.tables[td.id] = table

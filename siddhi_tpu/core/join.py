@@ -31,8 +31,8 @@ class JoinSide(Processor):
 class JoinRuntime:
     def __init__(self, join_type: JoinType, trigger: EventTrigger,
                  condition_fn: Optional[Callable],
-                 left_find: Callable[[], list[StreamEvent]],
-                 right_find: Callable[[], list[StreamEvent]],
+                 left_find: Callable[..., list[StreamEvent]],
+                 right_find: Callable[..., list[StreamEvent]],
                  within_ms: Optional[int] = None):
         self.join_type = join_type
         self.trigger = trigger
@@ -51,7 +51,10 @@ class JoinRuntime:
                 continue
             if (not is_left) and self.trigger == EventTrigger.LEFT:
                 continue
-            opposite = self.right_find() if is_left else self.left_find()
+            # the probe event is handed to the opposite side so table sides
+            # can push an indexed lookup down instead of scanning
+            # (reference: JoinProcessor + OperatorParser's IndexOperator)
+            opposite = self.right_find(ev) if is_left else self.left_find(ev)
             matched = False
             for other in opposite:
                 left_ev = ev if is_left else other

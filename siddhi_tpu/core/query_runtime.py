@@ -402,6 +402,65 @@ def _build_setters(set_attributes, table, out_names, out_types, app_context):
     return setters
 
 
+def _table_pushdown_find(table, table_ref, table_is_left, on_condition, builder):
+    """Compile ``T.pk == <probe expr>`` into a point lookup fn(probe_ev),
+    or None if the condition has no such conjunct (falls back to scan)."""
+    if on_condition is None or not hasattr(table, "pk_lookup"):
+        return None
+    pk_positions = getattr(table, "pk_positions", [])
+    if len(pk_positions) != 1:
+        return None
+    pk_name = table.definition.attributes[pk_positions[0]].name
+    table_ids = {table_ref, table.definition.id}
+    rhs = _find_join_pk_rhs(on_condition, table_ids, pk_name,
+                            table.definition.attribute_names)
+    if rhs is None:
+        return None
+    try:
+        val_fn, _ = builder.build(rhs)
+    except Exception:
+        return None
+    from .event import StreamEvent as _SE
+    from .executor import JoinFrame as _JF
+
+    def find(probe_ev, t=table, left=table_is_left):
+        frame = _JF(None, probe_ev, probe_ev.timestamp) if left \
+            else _JF(probe_ev, None, probe_ev.timestamp)
+        return [_SE(probe_ev.timestamp, r) for r in t.pk_lookup(val_fn(frame))]
+
+    return find
+
+
+def _find_join_pk_rhs(expr, table_ids, pk_name, table_attr_names):
+    from ..query_api import And, Compare, CompareOp, Variable
+    if isinstance(expr, And):
+        return _find_join_pk_rhs(expr.left, table_ids, pk_name, table_attr_names) \
+            or _find_join_pk_rhs(expr.right, table_ids, pk_name, table_attr_names)
+    if isinstance(expr, Compare) and expr.op == CompareOp.EQ:
+        for a, b in ((expr.left, expr.right), (expr.right, expr.left)):
+            if isinstance(a, Variable) and a.attribute == pk_name \
+                    and a.stream_id in table_ids \
+                    and not _expr_touches_table(b, table_ids, table_attr_names):
+                return b
+    return None
+
+
+def _expr_touches_table(expr, table_ids, table_attr_names):
+    from ..query_api import AttributeFunction, Expression, Variable
+    if isinstance(expr, Variable):
+        return expr.stream_id in table_ids or \
+            (expr.stream_id is None and expr.attribute in table_attr_names)
+    for attr in ("left", "right", "expr"):
+        sub = getattr(expr, attr, None)
+        if isinstance(sub, Expression) and \
+                _expr_touches_table(sub, table_ids, table_attr_names):
+            return True
+    if isinstance(expr, AttributeFunction):
+        return any(_expr_touches_table(a, table_ids, table_attr_names)
+                   for a in expr.args)
+    return False
+
+
 def _build_join(ist: JoinInputStream, rt: QueryRuntime, app_context,
                 stream_defs: dict, stream_def_fn, query: Query, qid: str):
     sides = {}
@@ -473,8 +532,27 @@ def _build_join(ist: JoinInputStream, rt: QueryRuntime, app_context,
                 "stream join `within` takes a single time constant "
                 "(range/expression forms apply to aggregation joins with `per`)")
         within_ms = ist.within.value
+    # finds take the probing event; table sides push a PK point-lookup down
+    # (reference: OperatorParser.java:64 compiles `T.pk == probe.expr` into an
+    # IndexOperator instead of an exhaustive scan)
+    finds = {}
+    for label, is_left in (("left", True), ("right", False)):
+        side = sides[label]
+        fn = None
+        if side["kind"] == "table":
+            table = app_context.tables[side["stream"].stream_id]
+            fn = _table_pushdown_find(table, side["ref"], is_left,
+                                      ist.on_condition, builder)
+            if fn is None:
+                # scan fallback stamps rows with the probe's timestamp, same
+                # as the pushdown path, so `within` sees consistent times
+                fn = lambda probe_ev=None, t=table: t.all_events(  # noqa: E731
+                    probe_ev.timestamp if probe_ev is not None else 0)
+        if fn is None:
+            fn = lambda probe_ev=None, f=side["find"]: f()  # noqa: E731
+        finds[label] = fn
     jr = JoinRuntime(ist.join_type, ist.trigger, cond_fn,
-                     sides["left"]["find"], sides["right"]["find"], within_ms)
+                     finds["left"], finds["right"], within_ms)
 
     # selector over the combined schema
     names = (sides["left"]["def"].attribute_names

@@ -24,6 +24,13 @@ from .event import Event, StreamEvent
 from .executor import ExecutorBuilder, VariableResolver
 
 
+def _pk_key(row: list, pk_positions: list[int]) -> Any:
+    """Single-PK → scalar key, composite → tuple (shared by table + cache)."""
+    if len(pk_positions) == 1:
+        return row[pk_positions[0]]
+    return tuple(row[p] for p in pk_positions)
+
+
 class TableMatchFrame:
     """Frame pairing a table row with the matching (output) event."""
 
@@ -128,9 +135,7 @@ class InMemoryTable(Table):
 
     # -- helpers --------------------------------------------------------------
     def _pk_of_row(self, row: list) -> Any:
-        if len(self.pk_positions) == 1:
-            return row[self.pk_positions[0]]
-        return tuple(row[p] for p in self.pk_positions)
+        return _pk_key(row, self.pk_positions)
 
     def _index_add(self, row: list) -> None:
         for p in self.index_positions:
@@ -217,6 +222,11 @@ class InMemoryTable(Table):
             return value in self.pk_map
         return any(value in r for r in self.rows)
 
+    def pk_lookup(self, key: Any) -> list[list]:
+        """Single-PK point lookup (reference ``IndexOperator`` fast path)."""
+        row = self.pk_map.get(key)
+        return [list(row)] if row is not None else []
+
     def all_events(self, ts: int = 0) -> list[StreamEvent]:
         return [StreamEvent(ts, list(r)) for r in self.rows]
 
@@ -265,6 +275,194 @@ class AbstractRecordTable(Table):
         return [r for r in rows if cond.fn(TableMatchFrame(r, out_data, ts))]
 
 
+class CacheTable(Table):
+    """Bounded cache in front of a record store.
+
+    Reference: ``table/CacheTable.java`` + policy subclasses
+    ``CacheTable{FIFO,LRU,LFU}.java`` — configured via
+    ``@store(..., @cache(size='100', cache.policy='LRU'))``. Write-through on
+    mutations; primary-key ``find``s are served from the cache on hit; scan
+    results are back-filled into the cache. When the whole store fits in the
+    cache (``_complete``), scans are served from the cache too.
+    """
+
+    POLICIES = ("FIFO", "LRU", "LFU")
+
+    def __init__(self, definition: TableDefinition, app_context, backing: Table,
+                 max_size: int, policy: str = "FIFO"):
+        super().__init__(definition, app_context)
+        policy = policy.upper()
+        if policy not in self.POLICIES:
+            raise ValueError(f"unknown cache policy '{policy}' "
+                             f"(expected one of {self.POLICIES})")
+        self.backing = backing
+        self.max_size = max(1, int(max_size))
+        self.policy = policy
+        self.pk_positions: list[int] = []
+        pk = find_annotation(definition.annotations, "PrimaryKey")
+        if pk:
+            self.pk_positions = [
+                definition.attribute_position(v) for v in pk.indexed_values()
+            ]
+        from collections import OrderedDict
+        self._cache: "OrderedDict[Any, list]" = OrderedDict()
+        self._freq: dict[Any, int] = {}
+        self._complete = False      # cache mirrors the entire store
+        self.cache_hits = 0
+        app_context.register_state(f"table-cache-{self.id}", self)
+
+    # -- policy bookkeeping ----------------------------------------------------
+    def _key_of(self, row: list) -> Any:
+        if self.pk_positions:
+            return _pk_key(row, self.pk_positions)
+        return tuple(row)
+
+    def _touch(self, key: Any) -> None:
+        if self.policy == "LRU":
+            self._cache.move_to_end(key)
+        elif self.policy == "LFU":
+            self._freq[key] = self._freq.get(key, 0) + 1
+
+    def _evict_one(self) -> None:
+        if self.policy == "LFU":
+            victim = min(self._cache, key=lambda k: self._freq.get(k, 0))
+            self._cache.pop(victim)
+            self._freq.pop(victim, None)
+        else:   # FIFO and LRU both evict the head (LRU head = least recent)
+            key, _ = self._cache.popitem(last=False)
+            self._freq.pop(key, None)
+        self._complete = False
+
+    def _put(self, row: list) -> None:
+        key = self._key_of(row)
+        if key in self._cache:
+            self._cache[key] = list(row)
+            self._touch(key)
+            return
+        while len(self._cache) >= self.max_size:
+            self._evict_one()
+        self._cache[key] = list(row)
+        if self.policy == "LFU":
+            self._freq[key] = 1
+
+    def _invalidate(self, row: list) -> None:
+        key = self._key_of(row)
+        self._cache.pop(key, None)
+        self._freq.pop(key, None)
+        # the row may still exist in the store with new values — the cache no
+        # longer mirrors the store until the entry is re-fetched
+        self._complete = False
+
+    # -- table API (write-through) --------------------------------------------
+    def add(self, rows: list[list], ts: int = 0) -> None:
+        self.backing.add(rows, ts)
+        fits = self._complete and \
+            len(self._cache) + len(rows) <= self.max_size
+        for r in rows:
+            self._put(list(r))
+        self._complete = fits
+
+    def preload(self) -> None:
+        """Load the store into the cache (reference preloads on connect)."""
+        rows = self.backing.find(None, None)
+        if len(rows) <= self.max_size:
+            for r in rows:
+                self._put(list(r))
+            self._complete = True
+
+    def find(self, cond: Optional[CompiledTableCondition],
+             out_data: Optional[list], ts: int = 0) -> list[list]:
+        if cond is None:
+            if self._complete:
+                return [list(r) for r in self._cache.values()]
+            return self.backing.find(None, out_data, ts)
+        if cond.pk_extractor is not None and len(self.pk_positions) >= 1:
+            key = cond.pk_extractor(out_data)
+            row = self._cache.get(key)
+            if row is not None:
+                self._touch(key)
+                self.cache_hits += 1
+                return [list(row)] if cond.fn(
+                    TableMatchFrame(row, out_data, ts)) else []
+        if self._complete:
+            hits = [list(r) for r in self._cache.values()
+                    if cond.fn(TableMatchFrame(r, out_data, ts))]
+            for r in hits:
+                self._touch(self._key_of(r))
+            return hits
+        rows = self.backing.find(cond, out_data, ts)
+        for r in rows:
+            self._put(list(r))
+        return rows
+
+    def delete(self, cond, out_data, ts: int = 0) -> int:
+        victims = [r for r in self.backing.find(cond, out_data, ts)]
+        n = self.backing.delete(cond, out_data, ts)
+        for r in victims:
+            self._invalidate(r)
+        return n
+
+    def update(self, cond, out_data, setters, ts: int = 0) -> int:
+        before = self.backing.find(cond, out_data, ts)
+        n = self.backing.update(cond, out_data, setters, ts)
+        for r in before:
+            self._invalidate(r)   # re-cached on next lookup with fresh values
+        return n
+
+    def update_or_add(self, cond, out_data, setters, ts: int = 0) -> None:
+        if self.update(cond, out_data, setters, ts) == 0:
+            self.add([list(out_data)], ts)
+
+    def pk_lookup(self, key: Any) -> list[list]:
+        row = self._cache.get(key)
+        if row is not None:
+            self._touch(key)
+            self.cache_hits += 1
+            return [list(row)]
+        if self._complete:
+            return []
+        if hasattr(self.backing, "pk_lookup"):
+            rows = self.backing.pk_lookup(key)
+        else:
+            pos = self.pk_positions[0] if len(self.pk_positions) == 1 else None
+            rows = [r for r in self.backing.find(None, None)
+                    if (r[pos] if pos is not None else None) == key] \
+                if pos is not None else []
+        for r in rows:
+            self._put(list(r))
+        return rows
+
+    def contains_value(self, value: Any) -> bool:
+        # single PK: membership = PK membership (InMemoryTable semantics)
+        if len(self.pk_positions) == 1:
+            if value in self._cache:
+                self._touch(value)
+                return True
+            if self._complete:
+                return False
+            return bool(self.pk_lookup(value))
+        # composite/no PK: any-column membership over the full row set
+        rows = self._cache.values() if self._complete \
+            else self.backing.find(None, None)
+        return any(value in r for r in rows)
+
+    def all_events(self, ts: int = 0) -> list[StreamEvent]:
+        if self._complete:
+            return [StreamEvent(ts, list(r)) for r in self._cache.values()]
+        return [StreamEvent(ts, list(r)) for r in self.backing.find(None, None, ts)]
+
+    # -- state ----------------------------------------------------------------
+    # The cache is derived state: a restore invalidates it so lookups refetch
+    # from the (authoritative) store.
+    def snapshot_state(self) -> dict:
+        return {}
+
+    def restore_state(self, state: dict) -> None:
+        self._cache.clear()
+        self._freq.clear()
+        self._complete = False
+
+
 def compile_table_condition(table: Table, on_condition: Optional[Expression],
                             out_names: list[str], out_types: list[DataType],
                             app_context) -> Optional[CompiledTableCondition]:
@@ -278,7 +476,7 @@ def compile_table_condition(table: Table, on_condition: Optional[Expression],
     # A bare variable named like the PK only counts as the table side when the
     # resolver would NOT bind it to the matching event (out side wins there).
     pk_extractor = None
-    if isinstance(table, InMemoryTable) and len(table.pk_positions) == 1:
+    if isinstance(table, (InMemoryTable, CacheTable)) and len(table.pk_positions) == 1:
         pk_pos = table.pk_positions[0]
         pk_name = table.definition.attributes[pk_pos].name
         allow_bare = pk_name not in out_names

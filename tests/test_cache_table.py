@@ -1,0 +1,170 @@
+"""Cache table behavioral tests (reference: ``table/CacheTable{,FIFO,LRU,LFU}.java``,
+``core/table/`` cache suites). A counting record-store extension verifies which
+lookups are served from cache vs pushed down to the store.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.table import AbstractRecordTable, CacheTable
+
+
+class CountingStore(AbstractRecordTable):
+    """In-process record store that counts find calls."""
+
+    instances = []
+
+    def __init__(self, definition, app_context):
+        super().__init__(definition, app_context)
+        self.rows = []
+        self.find_calls = 0
+        CountingStore.instances.append(self)
+
+    def init(self, definition, options):
+        self.options = options
+
+    def record_add(self, rows):
+        self.rows.extend(list(r) for r in rows)
+
+    def record_find(self, condition_params):
+        self.find_calls += 1
+        return [list(r) for r in self.rows]
+
+    def record_delete(self, condition_params):
+        return 0
+
+    def delete(self, cond, out_data, ts=0):
+        victims = [r for r in self.rows
+                   if cond is None or cond.fn(self._frame(r, out_data, ts))]
+        for r in victims:
+            self.rows.remove(r)
+        return len(victims)
+
+    def update(self, cond, out_data, setters, ts=0):
+        n = 0
+        for r in self.rows:
+            if cond is None or cond.fn(self._frame(r, out_data, ts)):
+                for pos, fn in setters:
+                    r[pos] = fn(self._frame(r, out_data, ts))
+                n += 1
+        return n
+
+    def update_or_add(self, cond, out_data, setters, ts=0):
+        if self.update(cond, out_data, setters, ts) == 0:
+            self.record_add([list(out_data)])
+
+    @staticmethod
+    def _frame(row, out, ts):
+        from siddhi_tpu.core.table import TableMatchFrame
+        return TableMatchFrame(row, out, ts)
+
+
+@pytest.fixture
+def manager():
+    CountingStore.instances.clear()
+    m = SiddhiManager()
+    m.set_extension("store:counting", CountingStore)
+    yield m
+    m.shutdown()
+
+
+APP = """
+define stream S (sym string, p float);
+define stream L (sym string);
+@store(type='counting', @cache(size='2', cache.policy='{policy}'))
+@PrimaryKey('sym')
+define table T (sym string, p float);
+from S insert into T;
+from L join T on T.sym == L.sym select T.sym as sym, T.p as p insert into Out;
+"""
+
+
+def _run(manager, policy, lookups):
+    out = []
+    rt = manager.create_siddhi_app_runtime(
+        APP.format(policy=policy), playback=True)
+    rt.add_callback("Out", StreamCallback(lambda events: out.extend(e.data for e in events)))
+    rt.start()
+    ih = rt.input_handler("S")
+    for i, (sym, p) in enumerate([("a", 1.0), ("b", 2.0), ("c", 3.0)]):
+        ih.send([sym, p], timestamp=i + 1)
+    lh = rt.input_handler("L")
+    for i, sym in enumerate(lookups):
+        lh.send([sym], timestamp=100 + i)
+    return out, rt
+
+
+def test_cache_table_pk_hits_skip_store(manager):
+    out, rt = _run(manager, "FIFO", ["b", "c", "c", "c"])
+    assert out == [["b", 2.0], ["c", 3.0], ["c", 3.0], ["c", 3.0]]
+    tbl = rt.ctx.tables["T"]
+    assert isinstance(tbl, CacheTable)
+    # size=2, FIFO: inserts a,b,c -> cache {b,c}; every lookup is a PK hit
+    store = CountingStore.instances[0]
+    assert store.find_calls == 1    # the preload scan at build time only
+    assert tbl.cache_hits == 4
+
+
+def test_cache_table_miss_falls_through_and_backfills(manager):
+    out, rt = _run(manager, "FIFO", ["a", "a"])
+    # 'a' was FIFO-evicted: first lookup hits the store, second is cached
+    assert out == [["a", 1.0], ["a", 1.0]]
+    store = CountingStore.instances[0]
+    assert store.find_calls == 2    # preload + the one miss
+
+
+def test_cache_table_lru_keeps_recent(manager):
+    out, rt = _run(manager, "LRU", ["b"])      # touch b -> b most recent
+    tbl = rt.ctx.tables["T"]
+    tbl.find(None, None)                       # no-cond scan goes to store
+    rt.input_handler("S").send(["d", 4.0], timestamp=50)   # evicts c, not b
+    assert "b" in tbl._cache and "d" in tbl._cache
+
+
+def test_cache_table_lfu_evicts_least_used(manager):
+    out, rt = _run(manager, "LFU", ["b", "b", "c"])  # freq: b=3, c=2
+    rt.input_handler("S").send(["d", 4.0], timestamp=50)     # evicts c (lower freq)
+    tbl = rt.ctx.tables["T"]
+    assert "b" in tbl._cache and "d" in tbl._cache and "c" not in tbl._cache
+
+
+def test_cache_table_update_invalidates(manager):
+    rt = manager.create_siddhi_app_runtime("""
+        define stream S (sym string, p float);
+        define stream U (sym string, p float);
+        define stream L (sym string);
+        @store(type='counting', @cache(size='8'))
+        @PrimaryKey('sym')
+        define table T (sym string, p float);
+        from S insert into T;
+        from U update T set T.p = p on T.sym == sym;
+        from L join T on T.sym == L.sym select T.p as p insert into Out;
+    """, playback=True)
+    out = []
+    rt.add_callback("Out", StreamCallback(lambda events: out.extend(e.data for e in events)))
+    rt.start()
+    rt.input_handler("S").send(["a", 1.0], timestamp=1)
+    rt.input_handler("L").send(["a"], timestamp=2)
+    rt.input_handler("U").send(["a", 9.0], timestamp=3)
+    rt.input_handler("L").send(["a"], timestamp=4)
+    assert out == [[1.0], [9.0]]
+
+
+def test_cache_table_delete_invalidates(manager):
+    rt = manager.create_siddhi_app_runtime("""
+        define stream S (sym string, p float);
+        define stream D (sym string);
+        @store(type='counting', @cache(size='8'))
+        @PrimaryKey('sym')
+        define table T (sym string, p float);
+        from S insert into T;
+        from D delete T on T.sym == sym;
+    """, playback=True)
+    rt.start()
+    rt.input_handler("S").send(["a", 1.0], timestamp=1)
+    rt.input_handler("S").send(["b", 2.0], timestamp=2)
+    rt.input_handler("D").send(["a"], timestamp=3)
+    tbl = rt.ctx.tables["T"]
+    assert "a" not in tbl._cache
+    rows = rt.query("from T select sym")
+    assert [e.data for e in rows] == [["b"]]
