@@ -13,12 +13,16 @@ from . import query_api
 from .compiler import SiddhiCompiler, parse, parse_on_demand_query, parse_query
 from .core import (
     Event,
+    IncrementalFileSystemPersistenceStore,
+    IncrementalPersistenceStore,
     InMemoryBroker,
+    InMemoryConfigManager,
     InMemoryPersistenceStore,
     InputHandler,
     QueryCallback,
     SiddhiAppRuntime,
     SiddhiManager,
     StreamCallback,
+    YAMLConfigManager,
     extension,
 )

@@ -27,15 +27,21 @@ __all__ = [
 _VAR_RE = re.compile(r"\$\{(\w+)\}")
 
 
-def update_variables(app_text: str, env: dict | None = None) -> str:
-    """Substitute ``${var}`` from env/system properties (SiddhiCompiler.updateVariables)."""
+def update_variables(app_text: str, env: dict | None = None,
+                     config_manager=None) -> str:
+    """Substitute ``${var}`` from env/system properties (SiddhiCompiler.updateVariables),
+    falling back to the ConfigManager's properties."""
     source = env if env is not None else os.environ
 
     def sub(m: re.Match) -> str:
         name = m.group(1)
-        if name not in source:
-            raise SiddhiParserError(f"no system/environment variable found for ${{{name}}}")
-        return str(source[name])
+        if name in source:
+            return str(source[name])
+        if config_manager is not None:
+            v = config_manager.extract_property(name)
+            if v is not None:
+                return v
+        raise SiddhiParserError(f"no system/environment variable found for ${{{name}}}")
 
     return _VAR_RE.sub(sub, app_text)
 

@@ -11,8 +11,11 @@ from .app_runtime import SiddhiAppRuntime
 from .stream import InputHandler, QueryCallback, StreamCallback
 from .snapshot import (
     FileSystemPersistenceStore,
+    IncrementalFileSystemPersistenceStore,
+    IncrementalPersistenceStore,
     InMemoryPersistenceStore,
     PersistenceStore,
+    SnapshotableEventBuffer,
 )
 from .extension import (
     ScalarFunctionExtension,
@@ -21,3 +24,9 @@ from .extension import (
 )
 from .io import InMemoryBroker
 from .metrics import Level
+from .config import (
+    ConfigManager,
+    ConfigReader,
+    InMemoryConfigManager,
+    YAMLConfigManager,
+)

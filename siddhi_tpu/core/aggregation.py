@@ -100,6 +100,43 @@ def _date_ms(text: str) -> int:
     return int(dt.timestamp() * 1000)
 
 
+_PURGE_DEFAULT_RETENTION: dict[TimePeriodDuration, Optional[int]] = {
+    # reference IncrementalDataPurger defaults: sec 120s, min 24h, hours 30d,
+    # days 1 year, months/years never purged
+    TimePeriodDuration.SECONDS: 120_000,
+    TimePeriodDuration.MINUTES: 86_400_000,
+    TimePeriodDuration.HOURS: 30 * 86_400_000,
+    TimePeriodDuration.DAYS: 365 * 86_400_000,
+    TimePeriodDuration.MONTHS: None,
+    TimePeriodDuration.YEARS: None,
+}
+
+_TIME_UNIT_MS = {
+    "ms": 1, "millisecond": 1, "milliseconds": 1,
+    "sec": 1000, "second": 1000, "seconds": 1000,
+    "min": 60_000, "minute": 60_000, "minutes": 60_000,
+    "hour": 3_600_000, "hours": 3_600_000, "h": 3_600_000,
+    "day": 86_400_000, "days": 86_400_000,
+    "month": 30 * 86_400_000, "months": 30 * 86_400_000,
+    "year": 365 * 86_400_000, "years": 365 * 86_400_000,
+}
+
+
+def parse_retention(text: str) -> Optional[int]:
+    """'120 sec' / '24 hours' / '1 year' → ms; 'all' → None (keep forever)."""
+    text = text.strip().lower()
+    if text == "all":
+        return None
+    parts = text.split()
+    try:
+        if len(parts) == 2:
+            return int(float(parts[0]) * _TIME_UNIT_MS[parts[1]])
+        return int(text)   # bare ms
+    except (ValueError, KeyError):
+        raise SiddhiAppRuntimeError(
+            f"cannot parse retention/interval {text!r}") from None
+
+
 def bucket_start(ts: int, duration: TimePeriodDuration) -> int:
     if duration in _MS:
         return ts - ts % _MS[duration]
@@ -164,6 +201,38 @@ class AggregationRuntime:
             if isinstance(h, _F):
                 self.filter_fn, _ = builder.build(h.expr)
 
+        # @purge(enable='true', interval='15 min',
+        #        @retentionPeriod(sec='120 sec', min='24 hours', ...))
+        # (reference: aggregation/IncrementalDataPurger.java)
+        from ..query_api.annotation import find_annotation
+        purge_ann = find_annotation(definition.annotations, "purge")
+        self.purge_enabled = purge_ann is not None and \
+            (purge_ann.get("enable") or "true").lower() == "true"
+        self.purge_interval = parse_retention(
+            (purge_ann.get("interval") if purge_ann else None) or "15 min")
+        self.retention: dict[TimePeriodDuration, Optional[int]] = \
+            dict(_PURGE_DEFAULT_RETENTION)
+        rp = purge_ann.nested("retentionPeriod") if purge_ann else None
+        if rp is not None:
+            keymap = {
+                "sec": TimePeriodDuration.SECONDS,
+                "min": TimePeriodDuration.MINUTES,
+                "hours": TimePeriodDuration.HOURS,
+                "days": TimePeriodDuration.DAYS,
+                "months": TimePeriodDuration.MONTHS,
+                "years": TimePeriodDuration.YEARS,
+            }
+            for e in rp.elements:
+                if e.key is None:
+                    continue
+                d = keymap.get(e.key.lower())
+                if d is None:
+                    raise SiddhiAppRuntimeError(
+                        f"unknown retentionPeriod key '{e.key}'")
+                self.retention[d] = parse_retention(e.value)
+        if self.purge_enabled:
+            self._arm_purge()
+
     # -- junction receiver ----------------------------------------------------
     def receive(self, event: StreamEvent) -> None:
         if event.type != EventType.CURRENT:
@@ -192,6 +261,34 @@ class AggregationRuntime:
                     state["aggs"][name].add(fn(frame))
                 else:
                     state["values"][name] = fn(frame)
+
+    # -- purging --------------------------------------------------------------
+    def _arm_purge(self) -> None:
+        self.app_context.scheduler.notify_at(
+            self.app_context.current_time() + self.purge_interval,
+            self._on_purge)
+
+    def _on_purge(self, fire_ts: int) -> None:
+        self.purge(fire_ts)
+        self.app_context.scheduler.notify_at(
+            fire_ts + self.purge_interval, self._on_purge)
+
+    def purge(self, now: Optional[int] = None) -> int:
+        """Drop buckets older than the per-duration retention; returns the
+        number of buckets removed. The bucket covering `now` is never purged."""
+        if now is None:
+            now = self.app_context.current_time()
+        removed = 0
+        for duration, buckets in self.stores.items():
+            ret = self.retention.get(duration)
+            if ret is None:
+                continue
+            cutoff = now - ret
+            keep = bucket_start(now, duration)
+            for bs in [b for b in buckets if b < cutoff and b != keep]:
+                del buckets[bs]
+                removed += 1
+        return removed
 
     # -- retrieval ------------------------------------------------------------
     @property

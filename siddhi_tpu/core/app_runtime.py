@@ -93,6 +93,7 @@ class SiddhiAppRuntime:
                     raise SiddhiAppCreationError(
                         f"no store extension '{store_type}' for table '{td.id}'")
                 table = cls(td, ctx)
+                table.config_reader = ctx.config_reader("store", store_type)
                 table.init(td, {e.key: e.value for e in store_ann.elements if e.key})
                 cache_ann = store_ann.nested("cache")
                 if cache_ann is not None:
@@ -210,6 +211,11 @@ class SiddhiAppRuntime:
                     d.attribute(n, t)
                 j.definition = d
 
+    def _with_config(self, obj, namespace: str, name: str):
+        # reference hands a ConfigReader into every extension init
+        obj.config_reader = self.ctx.config_reader(namespace, name)
+        return obj
+
     def _wire_io(self) -> None:
         ctx = self.ctx
         for sd in self.app.stream_definitions.values():
@@ -224,9 +230,9 @@ class SiddhiAppRuntime:
                 if mapper_cls is None:
                     raise SiddhiAppCreationError(
                         f"unknown source mapper type '{s['map']}'")
-                mapper = mapper_cls()
+                mapper = self._with_config(mapper_cls(), "sourceMapper", s["map"])
                 mapper.init(sd, s["options"])
-                src = cls()
+                src = self._with_config(cls(), "source", s["type"])
                 handler = self._make_source_handler(sd.id, mapper)
                 src.init(sd, s["options"], mapper, handler)
                 self.sources.append(src)
@@ -250,9 +256,10 @@ class SiddhiAppRuntime:
                     )
                     subs = []
                     for dest_opts in dist["destinations"]:
-                        mapper = mapper_cls()
+                        mapper = self._with_config(
+                            mapper_cls(), "sinkMapper", s["map"])
                         mapper.init(sd, s["options"])
-                        sub = cls()
+                        sub = self._with_config(cls(), "sink", s["type"])
                         merged = {**s["options"], **dest_opts}
                         sub.init(sd, merged, mapper)
                         subs.append(sub)
@@ -271,9 +278,10 @@ class SiddhiAppRuntime:
                         strat = RoundRobinStrategy(n)
                     sink = DistributedSink(subs, strat)
                 else:
-                    mapper = mapper_cls()
+                    mapper = self._with_config(
+                        mapper_cls(), "sinkMapper", s["map"])
                     mapper.init(sd, s["options"])
-                    sink = cls()
+                    sink = self._with_config(cls(), "sink", s["type"])
                     sink.init(sd, s["options"], mapper)
                 self.sinks.append(sink)
                 cb = StreamCallback(lambda events, sk=sink: [
@@ -363,6 +371,7 @@ class SiddhiAppRuntime:
 
     def restore(self, blob: bytes) -> None:
         self.snapshot_service.restore(blob)
+        self.persistence.invalidate_chain()
 
     def persist(self) -> str:
         return self.persistence.persist()

@@ -71,6 +71,15 @@ class SiddhiAppContext:
             self.timestamp_generator.advance(ts)
         self.scheduler.fire_until(self.timestamp_generator.current_time())
 
+    # -- config --------------------------------------------------------------
+    def config_reader(self, namespace: str, name: str):
+        """Per-extension ConfigReader (reference injects one into every init)."""
+        from .config import ConfigReader
+        cm = self.siddhi_context.config_manager
+        if cm is None:
+            return ConfigReader({})
+        return cm.generate_config_reader(namespace, name)
+
     # -- lookups -------------------------------------------------------------
     def get_table(self, table_id: str):
         t = self.tables.get(table_id)

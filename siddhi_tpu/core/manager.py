@@ -30,7 +30,8 @@ class SiddhiManager:
             start_time: int = 0,
             env: Optional[dict] = None) -> SiddhiAppRuntime:
         if isinstance(app, str):
-            app = _parse(update_variables(app, env) if "${" in app else app)
+            app = _parse(update_variables(
+                app, env, self.context.config_manager) if "${" in app else app)
         runtime = SiddhiAppRuntime(app, self.context, playback, start_time)
         self.runtimes[runtime.name] = runtime
         return runtime
@@ -40,6 +41,10 @@ class SiddhiManager:
 
     def set_extension(self, name: str, cls: type) -> None:
         self.context.extensions[name] = cls
+
+    def set_config_manager(self, config_manager) -> None:
+        """Reference ``SiddhiManager.setConfigManager`` (ConfigManager SPI)."""
+        self.context.config_manager = config_manager
 
     def set_persistence_store(self, store: PersistenceStore) -> None:
         self.context.persistence_store = store

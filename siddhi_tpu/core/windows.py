@@ -58,12 +58,14 @@ class WindowProcessor(Processor):
 # ---------------------------------------------------------------------------
 
 class LengthWindow(WindowProcessor):
-    """Sliding count window (reference ``LengthWindowProcessor.java:81``)."""
+    """Sliding count window (reference ``LengthWindowProcessor.java:81``).
+    Buffer is op-log snapshotable (``SnapshotableStreamEventQueue`` analog)."""
 
     def __init__(self, length: int):
         super().__init__()
+        from .snapshot import SnapshotableEventBuffer
         self.length = length
-        self.buffer: list[StreamEvent] = []
+        self.buffer = SnapshotableEventBuffer()
 
     def process(self, events: list[StreamEvent]) -> None:
         out: list[StreamEvent] = []
@@ -71,7 +73,7 @@ class LengthWindow(WindowProcessor):
             if ev.type != EventType.CURRENT:
                 continue
             if len(self.buffer) >= self.length:
-                oldest = self.buffer.pop(0)
+                oldest = self.buffer.popleft()
                 out.append(self._expired(oldest, ev.timestamp))
             self.buffer.append(ev)
             out.append(ev)
@@ -81,10 +83,20 @@ class LengthWindow(WindowProcessor):
         return list(self.buffer)
 
     def snapshot_state(self) -> dict:
-        return {"buffer": [(e.timestamp, list(e.data)) for e in self.buffer]}
+        return {"buffer": self.buffer.capture()}
 
     def restore_state(self, state: dict) -> None:
-        self.buffer = [StreamEvent(ts, d) for ts, d in state["buffer"]]
+        self.buffer.restore(state["buffer"])
+
+    def reset_increment_baseline(self) -> None:
+        self.buffer.begin_oplog()
+
+    def incremental_snapshot_state(self) -> "Optional[dict]":
+        ops = self.buffer.incremental_snapshot()
+        return None if ops is None else {"ops": ops}
+
+    def apply_increment(self, inc: dict) -> None:
+        self.buffer.apply_ops(inc["ops"])
 
 
 class LengthBatchWindow(WindowProcessor):
@@ -167,8 +179,9 @@ class TimeWindow(WindowProcessor):
 
     def __init__(self, duration_ms: int):
         super().__init__()
+        from .snapshot import SnapshotableEventBuffer
         self.duration = duration_ms
-        self.buffer: list[StreamEvent] = []
+        self.buffer = SnapshotableEventBuffer()
 
     def process(self, events: list[StreamEvent]) -> None:
         out: list[StreamEvent] = []
@@ -188,7 +201,7 @@ class TimeWindow(WindowProcessor):
     def _expire(self, now: int) -> list[StreamEvent]:
         out = []
         while self.buffer and self.buffer[0].timestamp + self.duration <= now:
-            out.append(self._expired(self.buffer.pop(0), now))
+            out.append(self._expired(self.buffer.popleft(), now))
         return out
 
     def _on_timer(self, ts: int) -> None:
@@ -198,14 +211,30 @@ class TimeWindow(WindowProcessor):
         return list(self.buffer)
 
     def snapshot_state(self) -> dict:
-        return {"buffer": [(e.timestamp, list(e.data)) for e in self.buffer]}
+        return {"buffer": self.buffer.capture()}
 
     def restore_state(self, state: dict) -> None:
-        self.buffer = [StreamEvent(ts, d) for ts, d in state["buffer"]]
+        self.buffer.restore(state["buffer"])
         # re-arm expiry timers (fresh scheduler after restore)
         for e in self.buffer:
             self.app_context.scheduler.notify_at(
                 e.timestamp + self.duration, self._on_timer)
+
+    def reset_increment_baseline(self) -> None:
+        self.buffer.begin_oplog()
+
+    def incremental_snapshot_state(self) -> "Optional[dict]":
+        ops = self.buffer.incremental_snapshot()
+        return None if ops is None else {"ops": ops}
+
+    def apply_increment(self, inc: dict) -> None:
+        self.buffer.apply_ops(inc["ops"])
+        # arm timers only for the newly appended events; survivors from the
+        # base restore already have theirs
+        for op in inc["ops"]:
+            if op[0] == "a":
+                self.app_context.scheduler.notify_at(
+                    op[1] + self.duration, self._on_timer)
 
 
 class TimeBatchWindow(WindowProcessor):

@@ -1,0 +1,120 @@
+"""Incremental-aggregation purging tests (reference:
+``aggregation/IncrementalDataPurger.java`` — periodic retention-based bucket
+removal per duration, ``@purge`` + ``@retentionPeriod`` annotations).
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.aggregation import parse_retention
+from siddhi_tpu.query_api.definition import TimePeriodDuration
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def test_parse_retention():
+    assert parse_retention("120 sec") == 120_000
+    assert parse_retention("24 hours") == 86_400_000
+    assert parse_retention("1 year") == 365 * 86_400_000
+    assert parse_retention("all") is None
+    with pytest.raises(Exception):
+        parse_retention("10 parsecs")
+
+
+APP = """
+define stream S (sym string, p double, ts long);
+@purge(enable='true', interval='10 sec',
+       @retentionPeriod(sec='30 sec', min='all'))
+define aggregation A
+from S select sym, sum(p) as total
+group by sym
+aggregate by ts every sec, min;
+"""
+
+
+def test_purge_drops_old_second_buckets_keeps_minutes(manager):
+    rt = manager.create_siddhi_app_runtime(APP, playback=True)
+    rt.start()
+    ih = rt.input_handler("S")
+    # events at t=1s and t=2s (event time via `aggregate by ts`)
+    ih.send(["a", 1.0, 1_000], timestamp=1_000)
+    ih.send(["a", 2.0, 2_000], timestamp=2_000)
+    agg = rt.ctx.aggregations["A"]
+    assert len(agg.stores[TimePeriodDuration.SECONDS]) == 2
+    # advance wall clock far past retention; the 10s purge timer fires
+    rt.advance_time(60_000)
+    assert len(agg.stores[TimePeriodDuration.SECONDS]) == 0
+    # minutes retention is 'all': rollups survive
+    assert len(agg.stores[TimePeriodDuration.MINUTES]) == 1
+    rows = rt.query("from A within 0L, 100000L per 'min' select sym, total")
+    assert [e.data for e in rows] == [["a", 3.0]]
+
+
+def test_purge_timer_rearms(manager):
+    rt = manager.create_siddhi_app_runtime(APP, playback=True)
+    rt.start()
+    ih = rt.input_handler("S")
+    ih.send(["a", 1.0, 1_000], timestamp=1_000)
+    rt.advance_time(60_000)          # first purge cycle
+    agg = rt.ctx.aggregations["A"]
+    assert len(agg.stores[TimePeriodDuration.SECONDS]) == 0
+    ih.send(["a", 5.0, 61_000], timestamp=61_000)
+    assert len(agg.stores[TimePeriodDuration.SECONDS]) == 1
+    rt.advance_time(120_000)         # later cycles still firing
+    assert len(agg.stores[TimePeriodDuration.SECONDS]) == 0
+
+
+def test_current_bucket_never_purged(manager):
+    rt = manager.create_siddhi_app_runtime("""
+        define stream S (sym string, p double, ts long);
+        @purge(enable='true', interval='1 sec',
+               @retentionPeriod(sec='0 sec'))
+        define aggregation A
+        from S select sym, sum(p) as total
+        aggregate by ts every sec;
+    """, playback=True)
+    rt.start()
+    ih = rt.input_handler("S")
+    ih.send(["a", 1.0, 5_000], timestamp=5_000)
+    agg = rt.ctx.aggregations["A"]
+    agg.purge(5_500)                 # same second as the event
+    assert len(agg.stores[TimePeriodDuration.SECONDS]) == 1
+
+
+def test_purge_disabled_by_default(manager):
+    rt = manager.create_siddhi_app_runtime("""
+        define stream S (sym string, p double, ts long);
+        define aggregation A
+        from S select sym, sum(p) as total
+        aggregate by ts every sec;
+    """, playback=True)
+    rt.start()
+    agg = rt.ctx.aggregations["A"]
+    assert not agg.purge_enabled
+    ih = rt.input_handler("S")
+    ih.send(["a", 1.0, 1_000], timestamp=1_000)
+    rt.advance_time(10_000_000)
+    assert len(agg.stores[TimePeriodDuration.SECONDS]) == 1
+
+
+def test_manual_purge_returns_count(manager):
+    rt = manager.create_siddhi_app_runtime("""
+        define stream S (sym string, p double, ts long);
+        @purge(enable='false')
+        define aggregation A
+        from S select sym, sum(p) as total
+        aggregate by ts every sec;
+    """, playback=True)
+    rt.start()
+    agg = rt.ctx.aggregations["A"]
+    assert not agg.purge_enabled     # explicit disable honored
+    ih = rt.input_handler("S")
+    for i in range(5):
+        ih.send(["a", 1.0, 1_000 * (i + 1)], timestamp=1_000 * (i + 1))
+    # default sec retention = 120s
+    assert agg.purge(now=300_000) == 5

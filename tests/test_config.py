@@ -1,0 +1,126 @@
+"""Config system tests (reference: ``core/config/`` YAML suite —
+``YAMLConfigManager``, ``InMemoryConfigManager``, ConfigReader injection,
+``${var}`` substitution via SiddhiCompiler.updateVariables).
+"""
+
+import pytest
+
+from siddhi_tpu import (
+    InMemoryConfigManager,
+    SiddhiManager,
+    StreamCallback,
+    YAMLConfigManager,
+)
+from siddhi_tpu.core.io import Source
+
+
+YAML = """
+properties:
+  THRESH: "50"
+extensions:
+  - extension:
+      namespace: source
+      name: probe
+      properties:
+        default.topic: configured-topic
+        retries: "3"
+  - extension:
+      name: bare
+      properties:
+        k: v
+refs:
+  store1:
+    type: rdbms
+    url: jdbc:none
+"""
+
+
+def test_yaml_config_reader_scoping():
+    cm = YAMLConfigManager(yaml_content=YAML)
+    r = cm.generate_config_reader("source", "probe")
+    assert r.read_config("default.topic") == "configured-topic"
+    assert r.read_config("retries") == "3"
+    assert r.read_config("missing", "dflt") == "dflt"
+    # other scopes see nothing
+    assert cm.generate_config_reader("sink", "probe").get_all_configs() == {}
+    assert cm.extract_property("THRESH") == "50"
+    assert cm.extract_system_configs("store1")["type"] == "rdbms"
+
+
+def test_yaml_malformed_rejected():
+    with pytest.raises(ValueError):
+        YAMLConfigManager(yaml_content="- just\n- a list\n")
+    with pytest.raises(ValueError):
+        YAMLConfigManager(yaml_content=YAML, path="/tmp/x.yaml")
+
+
+def test_in_memory_config_manager():
+    cm = InMemoryConfigManager({"source.inMemory.topic": "t1", "flag": "on"})
+    assert cm.generate_config_reader(
+        "source", "inMemory").read_config("topic") == "t1"
+    assert cm.extract_property("flag") == "on"
+    assert cm.extract_property("nope") is None
+
+
+def test_var_substitution_from_config_manager():
+    m = SiddhiManager()
+    m.set_config_manager(YAMLConfigManager(yaml_content=YAML))
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (v int);
+        from S[v > ${THRESH}] select v insert into O;
+    """, playback=True)
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(e.data for e in evs)))
+    rt.start()
+    ih = rt.input_handler("S")
+    ih.send([49], timestamp=1)
+    ih.send([51], timestamp=2)
+    assert got == [[51]]
+    m.shutdown()
+
+
+def test_config_reader_injected_into_source():
+    seen = {}
+
+    class ProbeSource(Source):
+        def init(self, definition, options, mapper, handler):
+            seen["topic"] = self.config_reader.read_config(
+                "default.topic", "fallback")
+            seen["missing"] = self.config_reader.read_config("nope", "fb")
+
+        def connect(self):
+            pass
+
+    m = SiddhiManager()
+    m.set_config_manager(YAMLConfigManager(yaml_content=YAML))
+    m.set_extension("source:probe", ProbeSource)
+    rt = m.create_siddhi_app_runtime("""
+        @source(type='probe')
+        define stream S (v int);
+        from S select v insert into O;
+    """, playback=True)
+    rt.start()
+    assert seen == {"topic": "configured-topic", "missing": "fb"}
+    m.shutdown()
+
+
+def test_no_config_manager_gives_empty_reader():
+    seen = {}
+
+    class ProbeSource(Source):
+        def init(self, definition, options, mapper, handler):
+            seen["v"] = self.config_reader.read_config("k", "default")
+
+        def connect(self):
+            pass
+
+    m = SiddhiManager()
+    m.set_extension("source:probe", ProbeSource)
+    rt = m.create_siddhi_app_runtime("""
+        @source(type='probe')
+        define stream S (v int);
+        from S select v insert into O;
+    """, playback=True)
+    rt.start()
+    assert seen == {"v": "default"}
+    m.shutdown()

@@ -1,0 +1,240 @@
+"""Incremental snapshot/persistence tests (reference:
+``SnapshotService.incrementalSnapshot:189``, ``IncrementalSnapshot.java``,
+``SnapshotableStreamEventQueue`` op-logs, ``IncrementalPersistenceStore``,
+``IncrementalFileSystemPersistenceStore``, ``IncrementalPersistenceTestCase``).
+"""
+
+import pickle
+
+import pytest
+
+from siddhi_tpu import (
+    IncrementalFileSystemPersistenceStore,
+    IncrementalPersistenceStore,
+    SiddhiManager,
+    StreamCallback,
+)
+from siddhi_tpu.core.snapshot import SnapshotableEventBuffer
+from siddhi_tpu.core.event import StreamEvent
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+APP = """
+define stream S (v long);
+from S#window.length(5) select sum(v) as total insert into O;
+"""
+
+
+def _fresh(manager, app=APP):
+    rt = manager.create_siddhi_app_runtime(app, playback=True)
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    return rt, got
+
+
+# ------------------------------------------------------------------- buffer
+
+def test_buffer_oplog_roundtrip():
+    b = SnapshotableEventBuffer()
+    b.append(StreamEvent(1, [10]))
+    base = b.full_snapshot()
+    b.append(StreamEvent(2, [20]))
+    b.popleft()
+    ops = b.incremental_snapshot()
+    assert ops is not None and len(ops) == 2
+
+    b2 = SnapshotableEventBuffer()
+    b2.restore(base)
+    b2.apply_ops(ops)
+    assert [(e.timestamp, e.data) for e in b2.items] == [(2, [20])]
+
+
+def test_buffer_without_baseline_forces_full():
+    b = SnapshotableEventBuffer()
+    b.append(StreamEvent(1, [10]))
+    assert b.incremental_snapshot() is None       # no snapshot taken yet
+    b.full_snapshot()
+    assert b.incremental_snapshot() == []          # now delta (empty)
+
+
+def test_buffer_oplog_overflow_falls_back_to_full():
+    b = SnapshotableEventBuffer(max_oplog=3)
+    b.full_snapshot()
+    for i in range(5):
+        b.append(StreamEvent(i, [i]))
+    assert b.incremental_snapshot() is None        # log blew past cap
+    b.full_snapshot()
+    assert b.incremental_snapshot() == []
+
+
+# ------------------------------------------------------------------ persist
+
+def test_incremental_chain_restores(manager):
+    store = IncrementalPersistenceStore()
+    manager.set_persistence_store(store)
+    rt, _ = _fresh(manager)
+    ih = rt.input_handler("S")
+    ih.send([10], timestamp=1)
+    rev1 = rt.persist()                 # base
+    ih.send([20], timestamp=2)
+    rev2 = rt.persist()                 # increment
+    ih.send([30], timestamp=3)
+    rev3 = rt.persist()                 # increment
+
+    # increments are real deltas, not fresh fulls
+    blob2 = pickle.loads(store.load(rt.name, rev2))
+    assert blob2["type"] == "increment" and blob2["parent"] == rev1
+    win_entries = [v for v in blob2["states"].values()
+                   if isinstance(v, tuple) and v[0] == "inc"]
+    assert win_entries, "window should snapshot incrementally"
+
+    rt2, got2 = _fresh(manager)
+    assert rt2.restore_last_revision() == rev3
+    rt2.input_handler("S").send([5], timestamp=4)
+    assert [e.data[0] for e in got2] == [65]       # 10+20+30+5
+
+
+def test_restore_intermediate_revision(manager):
+    store = IncrementalPersistenceStore()
+    manager.set_persistence_store(store)
+    rt, _ = _fresh(manager)
+    ih = rt.input_handler("S")
+    ih.send([10], timestamp=1)
+    rt.persist()
+    ih.send([20], timestamp=2)
+    rev2 = rt.persist()
+    ih.send([999], timestamp=3)
+    rt.persist()
+
+    rt2, got2 = _fresh(manager)
+    rt2.restore_revision(rev2)
+    rt2.input_handler("S").send([5], timestamp=4)
+    assert [e.data[0] for e in got2] == [35]       # 10+20+5, not 999
+
+
+def test_periodic_full_baseline(manager):
+    store = IncrementalPersistenceStore()
+    manager.set_persistence_store(store)
+    rt, _ = _fresh(manager)
+    rt.persistence.base_interval = 2
+    ih = rt.input_handler("S")
+    revs = []
+    for i in range(5):
+        ih.send([i], timestamp=i + 1)
+        revs.append(rt.persist())
+    kinds = [pickle.loads(store.load(rt.name, r)).get("type", "base")
+             for r in revs]
+    assert kinds == ["base", "increment", "increment", "base", "increment"]
+
+
+def test_length_window_expiry_travels_in_increment(manager):
+    """Sliding-out events must replay through the op-log (pop ops)."""
+    store = IncrementalPersistenceStore()
+    manager.set_persistence_store(store)
+    app = """
+        define stream S (v long);
+        from S#window.length(2) select sum(v) as total insert into O;
+    """
+    rt, _ = _fresh(manager, app)
+    ih = rt.input_handler("S")
+    ih.send([1], timestamp=1)
+    rt.persist()
+    ih.send([2], timestamp=2)
+    ih.send([4], timestamp=3)          # evicts [1]
+    rev = rt.persist()
+
+    rt2, got2 = _fresh(manager, app)
+    rt2.restore_revision(rev)
+    rt2.input_handler("S").send([8], timestamp=4)   # evicts [2]
+    assert [e.data[0] for e in got2] == [12]        # 4+8
+
+
+def test_incremental_filesystem_store(manager, tmp_path):
+    store = IncrementalFileSystemPersistenceStore(str(tmp_path))
+    manager.set_persistence_store(store)
+    rt, _ = _fresh(manager)
+    ih = rt.input_handler("S")
+    ih.send([10], timestamp=1)
+    rt.persist()
+    ih.send([20], timestamp=2)
+    rev2 = rt.persist()
+
+    m2 = SiddhiManager()
+    m2.set_persistence_store(
+        IncrementalFileSystemPersistenceStore(str(tmp_path)))
+    rt2 = m2.create_siddhi_app_runtime(APP, playback=True)
+    got2 = []
+    rt2.add_callback("O", StreamCallback(lambda evs: got2.extend(evs)))
+    rt2.start()
+    assert rt2.restore_last_revision() == rev2
+    rt2.input_handler("S").send([5], timestamp=3)
+    assert [e.data[0] for e in got2] == [35]
+    m2.shutdown()
+
+
+def test_restore_invalidates_chain(manager):
+    """Review regression: persisting after a restore must write a fresh base,
+    not an increment chained to the pre-restore revision."""
+    store = IncrementalPersistenceStore()
+    manager.set_persistence_store(store)
+    rt, got = _fresh(manager)
+    ih = rt.input_handler("S")
+    ih.send([10], timestamp=1)
+    rev1 = rt.persist()
+    ih.send([20], timestamp=2)
+    rt.persist()
+    rt.restore_revision(rev1)           # back to window=[10]
+    ih.send([30], timestamp=3)
+    rev3 = rt.persist()
+    data3 = pickle.loads(store.load(rt.name, rev3))
+    assert data3.get("type") != "increment"   # fresh base
+
+    rt2, got2 = _fresh(manager)
+    rt2.restore_revision(rev3)
+    rt2.input_handler("S").send([5], timestamp=4)
+    assert [e.data[0] for e in got2] == [45]  # 10+30+5 — [20] must NOT reappear
+
+
+def test_plain_snapshot_does_not_break_chain(manager):
+    """Review regression: rt.snapshot() is read-only — it must not consume
+    op-log entries belonging to the incremental chain."""
+    store = IncrementalPersistenceStore()
+    manager.set_persistence_store(store)
+    rt, _ = _fresh(manager)
+    ih = rt.input_handler("S")
+    ih.send([1], timestamp=1)
+    rt.persist()                        # base
+    ih.send([2], timestamp=2)
+    rt.snapshot()                       # plain full snapshot mid-chain
+    ih.send([4], timestamp=3)
+    rev = rt.persist()                  # increment must still carry [2]
+
+    rt2, got2 = _fresh(manager)
+    rt2.restore_revision(rev)
+    rt2.input_handler("S").send([8], timestamp=4)
+    assert [e.data[0] for e in got2] == [15]   # 1+2+4+8
+
+
+def test_unchanged_elements_skipped_in_increment(manager):
+    store = IncrementalPersistenceStore()
+    manager.set_persistence_store(store)
+    app = """
+        define stream S (v long);
+        define table T (v long);
+        from S#window.length(3) select v insert into O;
+    """
+    rt, _ = _fresh(manager, app)
+    ih = rt.input_handler("S")
+    ih.send([10], timestamp=1)
+    rt.persist()
+    ih.send([20], timestamp=2)          # table T untouched
+    rev2 = rt.persist()
+    blob = pickle.loads(store.load(rt.name, rev2))
+    assert blob["states"]["table-T"] == ("skip",)
