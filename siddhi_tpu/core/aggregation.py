@@ -111,26 +111,17 @@ _PURGE_DEFAULT_RETENTION: dict[TimePeriodDuration, Optional[int]] = {
     TimePeriodDuration.YEARS: None,
 }
 
-_TIME_UNIT_MS = {
-    "ms": 1, "millisecond": 1, "milliseconds": 1,
-    "sec": 1000, "second": 1000, "seconds": 1000,
-    "min": 60_000, "minute": 60_000, "minutes": 60_000,
-    "hour": 3_600_000, "hours": 3_600_000, "h": 3_600_000,
-    "day": 86_400_000, "days": 86_400_000,
-    "month": 30 * 86_400_000, "months": 30 * 86_400_000,
-    "year": 365 * 86_400_000, "years": 365 * 86_400_000,
-}
-
-
 def parse_retention(text: str) -> Optional[int]:
-    """'120 sec' / '24 hours' / '1 year' → ms; 'all' → None (keep forever)."""
+    """'120 sec' / '24 hours' / '1 year' → ms; 'all' → None (keep forever).
+    Units shared with the SiddhiQL time-literal table."""
+    from ..compiler.tokenizer import TIME_UNITS
     text = text.strip().lower()
     if text == "all":
         return None
     parts = text.split()
     try:
         if len(parts) == 2:
-            return int(float(parts[0]) * _TIME_UNIT_MS[parts[1]])
+            return int(float(parts[0]) * TIME_UNITS[parts[1]])
         return int(text)   # bare ms
     except (ValueError, KeyError):
         raise SiddhiAppRuntimeError(
@@ -210,6 +201,10 @@ class AggregationRuntime:
             (purge_ann.get("enable") or "true").lower() == "true"
         self.purge_interval = parse_retention(
             (purge_ann.get("interval") if purge_ann else None) or "15 min")
+        if self.purge_enabled and self.purge_interval is None:
+            raise SiddhiAppRuntimeError(
+                "@purge interval must be a time value ('all' is only valid "
+                "inside @retentionPeriod)")
         self.retention: dict[TimePeriodDuration, Optional[int]] = \
             dict(_PURGE_DEFAULT_RETENTION)
         rp = purge_ann.nested("retentionPeriod") if purge_ann else None

@@ -22,6 +22,18 @@ from __future__ import annotations
 from typing import Any, Optional
 
 
+def _scalar_str(v: Any) -> str:
+    """YAML-style strings: bare ``true``/``false``/``null``, not Python reprs —
+    every manager yields the same value types for the same config."""
+    if isinstance(v, str):
+        return v
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return "null"
+    return str(v)
+
+
 class ConfigReader:
     """Scoped view of config for one extension: keys under ``<ns>.<name>.``.
 
@@ -64,7 +76,7 @@ class InMemoryConfigManager(ConfigManager):
 
     def __init__(self, configs: Optional[dict] = None,
                  system_configs: Optional[dict] = None):
-        self.configs = dict(configs or {})
+        self.configs = {str(k): _scalar_str(v) for k, v in (configs or {}).items()}
         self.system_configs = dict(system_configs or {})
 
     def generate_config_reader(self, namespace: str, name: str) -> ConfigReader:
@@ -78,8 +90,7 @@ class InMemoryConfigManager(ConfigManager):
         return dict(self.system_configs.get(name, {}))
 
     def extract_property(self, name: str) -> Optional[str]:
-        v = self.configs.get(name)
-        return str(v) if v is not None else None
+        return self.configs.get(name)
 
 
 class YAMLConfigManager(InMemoryConfigManager):
@@ -101,26 +112,17 @@ class YAMLConfigManager(InMemoryConfigManager):
         if not isinstance(root, dict):
             raise ValueError("root of config YAML must be a mapping")
 
-        def scalar(v: Any) -> str:
-            # YAML-style strings: bare `true`/`false`/`null`, not Python reprs
-            if isinstance(v, str):
-                return v
-            if isinstance(v, bool):
-                return "true" if v else "false"
-            if v is None:
-                return "null"
-            return str(v)
-
         configs: dict[str, Any] = {}
         for k, v in (root.get("properties") or {}).items():
-            configs[str(k)] = scalar(v)
+            configs[str(k)] = _scalar_str(v)
         for item in root.get("extensions") or []:
             ext = item.get("extension") if isinstance(item, dict) else None
             if not isinstance(ext, dict):
                 raise ValueError(f"malformed extensions entry: {item!r}")
             ns, name = ext.get("namespace", ""), ext.get("name", "")
             for pk, pv in (ext.get("properties") or {}).items():
-                configs[f"{ns}.{name}.{pk}" if ns else f"{name}.{pk}"] = scalar(pv)
+                configs[f"{ns}.{name}.{pk}" if ns else f"{name}.{pk}"] = \
+                    _scalar_str(pv)
         system_configs = {
             str(k): dict(v) for k, v in (root.get("refs") or {}).items()
             if isinstance(v, dict)

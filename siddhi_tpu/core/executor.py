@@ -380,6 +380,11 @@ class ExecutorBuilder:
         if self.context is not None:
             ext = self.context.lookup_scalar_function(expr.namespace, name)
             if ext is not None:
+                from .extension import validate_extension_args
+                try:
+                    validate_extension_args(type(ext), types)
+                except TypeError as e:
+                    raise ExecutorBuildError(str(e)) from None
                 fn, rt = ext.bind(fns, types)
                 return fn, rt
         if key in self.extra_functions:

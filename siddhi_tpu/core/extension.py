@@ -1,11 +1,15 @@
 """Extension registry: the ``@extension`` decorator ≈ the reference's ``@Extension``
 annotation + ``SiddhiExtensionLoader`` (annotation-scanned classpath loading,
 ``util/SiddhiExtensionLoader.java:99``). Python entry points replace classpath
-scanning; kinds mirror the reference's extension types.
+scanning; kinds mirror the reference's extension types. Parameter metadata +
+validation mirror ``siddhi-annotations`` (``@Parameter``/``@ParameterOverload``/
+``@ReturnAttribute``/``@Example`` and
+``util/extension/validator/InputParameterValidator.java``).
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from ..query_api.definition import DataType, StreamDefinition
@@ -21,18 +25,97 @@ EXTENSION_KINDS = {
 }
 
 
-def extension(name: str, kind: str = "function"):
-    """Class decorator: ``@extension("str:concat", kind="function")``."""
+@dataclass
+class Parameter:
+    """Reference ``@Parameter`` — one declared argument of an extension."""
+
+    name: str
+    types: list[DataType]
+    description: str = ""
+    optional: bool = False
+    default: Optional[str] = None
+    dynamic: bool = False
+
+
+@dataclass
+class ReturnAttribute:
+    """Reference ``@ReturnAttribute``."""
+
+    name: str
+    types: list[DataType]
+    description: str = ""
+
+
+@dataclass
+class Example:
+    """Reference ``@Example``."""
+
+    syntax: str
+    description: str = ""
+
+
+@dataclass
+class ExtensionMeta:
+    """Reference ``@Extension`` metadata block, attached as
+    ``cls.extension_meta`` and consumed by the doc generator + validator."""
+
+    name: str
+    kind: str
+    description: str = ""
+    parameters: list[Parameter] = field(default_factory=list)
+    return_attributes: list[ReturnAttribute] = field(default_factory=list)
+    examples: list[Example] = field(default_factory=list)
+
+
+def extension(name: str, kind: str = "function", description: str = "",
+              parameters: Optional[list[Parameter]] = None,
+              return_attributes: Optional[list[ReturnAttribute]] = None,
+              examples: Optional[list[Example]] = None):
+    """Class decorator: ``@extension("str:concat", kind="function",
+    parameters=[Parameter("s1", [DataType.STRING]), ...])``.
+
+    Parameter metadata, when given, is validated against call-site argument
+    types at build time (reference ``InputParameterValidator``).
+    """
     if kind not in EXTENSION_KINDS:
         raise ValueError(f"unknown extension kind '{kind}'")
 
     def deco(cls):
         cls.extension_kind = kind
         cls.extension_name = name
+        cls.extension_meta = ExtensionMeta(
+            name=name, kind=kind, description=description,
+            parameters=list(parameters or []),
+            return_attributes=list(return_attributes or []),
+            examples=list(examples or []))
         GLOBAL_EXTENSIONS[name] = cls
         return cls
 
     return deco
+
+
+def validate_extension_args(cls, arg_types: list[Optional[DataType]]) -> None:
+    """Check call-site argument types against declared ``Parameter`` metadata
+    (reference ``InputParameterValidator.java``). No-op without metadata."""
+    meta: Optional[ExtensionMeta] = getattr(cls, "extension_meta", None)
+    if meta is None or not meta.parameters:
+        return
+    params = meta.parameters
+    required = sum(1 for p in params if not p.optional)
+    if not (required <= len(arg_types) <= len(params)):
+        expected = str(required) if required == len(params) else \
+            f"{required}..{len(params)}"
+        raise TypeError(
+            f"extension '{meta.name}' expects {expected} argument(s), "
+            f"got {len(arg_types)}")
+    for i, at in enumerate(arg_types):
+        p = params[i]
+        if at is None or DataType.OBJECT in p.types:
+            continue        # unknown/any — accept
+        if at not in p.types:
+            raise TypeError(
+                f"extension '{meta.name}' parameter '{p.name}' accepts "
+                f"{[t.value for t in p.types]}, got {at.value}")
 
 
 class ScalarFunctionExtension:

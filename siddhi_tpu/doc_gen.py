@@ -1,0 +1,79 @@
+"""Extension documentation generator.
+
+Reference: ``modules/siddhi-doc-gen`` — a Maven mojo that scans ``@Extension``
+metadata and renders markdown docs (freemarker → mkdocs). Here:
+``generate_extension_docs`` renders the same shape from ``ExtensionMeta``
+blocks attached by the ``@extension`` decorator.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .core.extension import GLOBAL_EXTENSIONS, ExtensionMeta
+
+
+def _types_str(types) -> str:
+    return ", ".join(t.value for t in types) if types else "any"
+
+
+def generate_extension_docs(extensions: Optional[dict] = None,
+                            title: str = "Extensions") -> str:
+    """Render markdown API docs for registered extensions, grouped by kind."""
+    exts = extensions if extensions is not None else GLOBAL_EXTENSIONS
+    by_kind: dict[str, list[tuple[str, ExtensionMeta]]] = {}
+    for name, cls in sorted(exts.items()):
+        meta = getattr(cls, "extension_meta", None)
+        if meta is None:
+            meta = ExtensionMeta(
+                name=name, kind=getattr(cls, "extension_kind", "function"),
+                description=(cls.__doc__ or "").strip().split("\n")[0])
+        by_kind.setdefault(meta.kind, []).append((name, meta))
+
+    lines = [f"# {title}", ""]
+    for kind in sorted(by_kind):
+        lines.append(f"## {kind.replace('_', ' ').title()}")
+        lines.append("")
+        for name, meta in by_kind[kind]:
+            lines.append(f"### {name}")
+            lines.append("")
+            if meta.description:
+                lines.append(meta.description)
+                lines.append("")
+            if meta.parameters:
+                lines.append("**Parameters**")
+                lines.append("")
+                lines.append("| name | types | optional | default | description |")
+                lines.append("|---|---|---|---|---|")
+                for p in meta.parameters:
+                    lines.append(
+                        f"| {p.name} | {_types_str(p.types)} | "
+                        f"{'yes' if p.optional else 'no'} | "
+                        f"{p.default if p.default is not None else '–'} | "
+                        f"{p.description} |")
+                lines.append("")
+            if meta.return_attributes:
+                lines.append("**Returns**")
+                lines.append("")
+                for r in meta.return_attributes:
+                    lines.append(f"- `{r.name}` ({_types_str(r.types)})"
+                                 f"{': ' + r.description if r.description else ''}")
+                lines.append("")
+            if meta.examples:
+                lines.append("**Examples**")
+                lines.append("")
+                for ex in meta.examples:
+                    lines.append("```sql")
+                    lines.append(ex.syntax)
+                    lines.append("```")
+                    if ex.description:
+                        lines.append("")
+                        lines.append(ex.description)
+                    lines.append("")
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def write_extension_docs(path: str, extensions: Optional[dict] = None,
+                         title: str = "Extensions") -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(generate_extension_docs(extensions, title))

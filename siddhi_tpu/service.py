@@ -1,0 +1,180 @@
+"""REST deployment service.
+
+Reference: ``modules/siddhi-service`` —
+``impl/SiddhiApiServiceImpl.java:45`` (deploy ``:51``, undeploy ``:100``): a
+small HTTP wrapper that deploys SiddhiQL app text onto a shared
+``SiddhiManager``, keeps runtimes + input handlers by app name, and undeploys
+on request. Endpoints (stdlib http.server, threaded; no framework deps):
+
+    POST   /siddhi-apps                      body = SiddhiQL text → deploy+start
+    GET    /siddhi-apps                      list deployed app names
+    GET    /siddhi-apps/{name}/status        {"state": "running"|"stopped"}
+    DELETE /siddhi-apps/{name}               undeploy (shutdown + forget)
+    POST   /siddhi-apps/{name}/streams/{sid} body = JSON {"data": [...],
+                                             "timestamp": ms?} → send event
+
+Responses are JSON ``{"status": "OK"|"ERROR", "message": ...}`` like the
+reference's ``ApiResponseMessage``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .core.manager import SiddhiManager
+
+
+class SiddhiService:
+    """Deploy/undeploy SiddhiQL apps over HTTP on a shared manager."""
+
+    def __init__(self, manager: Optional[SiddhiManager] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 playback: bool = False):
+        self.manager = manager or SiddhiManager()
+        self.playback = playback
+        self._lock = threading.Lock()
+        self.runtimes: dict[str, object] = {}
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):        # quiet by default
+                pass
+
+            def _reply(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length") or 0)
+                return self.rfile.read(n) if n else b""
+
+            def do_POST(self):
+                parts = [p for p in self.path.split("/") if p]
+                if parts == ["siddhi-apps"]:
+                    code, payload = service.deploy(self._body().decode())
+                elif len(parts) == 4 and parts[0] == "siddhi-apps" \
+                        and parts[2] == "streams":
+                    code, payload = service.send_event(
+                        parts[1], parts[3], self._body().decode())
+                else:
+                    code, payload = 404, {"status": "ERROR",
+                                          "message": "unknown path"}
+                self._reply(code, payload)
+
+            def do_GET(self):
+                parts = [p for p in self.path.split("/") if p]
+                if parts == ["siddhi-apps"]:
+                    self._reply(200, {"status": "OK",
+                                      "apps": sorted(service.runtimes)})
+                elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                        and parts[2] == "status":
+                    code, payload = service.status(parts[1])
+                    self._reply(code, payload)
+                else:
+                    self._reply(404, {"status": "ERROR",
+                                      "message": "unknown path"})
+
+            def do_DELETE(self):
+                parts = [p for p in self.path.split("/") if p]
+                if len(parts) == 2 and parts[0] == "siddhi-apps":
+                    code, payload = service.undeploy(parts[1])
+                    self._reply(code, payload)
+                else:
+                    self._reply(404, {"status": "ERROR",
+                                      "message": "unknown path"})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- operations (also usable programmatically) -----------------------------
+    def deploy(self, app_text: str) -> tuple[int, dict]:
+        with self._lock:
+            try:
+                from .compiler import parse, update_variables
+                parsed = parse(update_variables(
+                    app_text, None, self.manager.context.config_manager)
+                    if "${" in app_text else app_text)
+            except Exception as e:
+                return 400, {"status": "ERROR", "message": str(e)}
+            # duplicate check BEFORE registering — creating first would clobber
+            # the running app's slot in manager.runtimes
+            if parsed.name() in self.runtimes:
+                return 409, {"status": "ERROR",
+                             "message": f"app '{parsed.name()}' already deployed"}
+            try:
+                rt = self.manager.create_siddhi_app_runtime(
+                    parsed, playback=self.playback)
+            except Exception as e:
+                return 400, {"status": "ERROR", "message": str(e)}
+            try:
+                rt.start()
+            except Exception as e:
+                self.manager.runtimes.pop(rt.name, None)
+                return 500, {"status": "ERROR",
+                             "message": f"start failed: {e}"}
+            self.runtimes[rt.name] = rt
+            return 200, {"status": "OK", "name": rt.name,
+                         "message": "Siddhi app deployed and runtime created"}
+
+    def undeploy(self, name: str) -> tuple[int, dict]:
+        with self._lock:
+            rt = self.runtimes.pop(name, None)
+            if rt is None:
+                return 404, {"status": "ERROR",
+                             "message": f"no app '{name}' deployed"}
+            rt.shutdown()
+            self.manager.runtimes.pop(name, None)
+            return 200, {"status": "OK",
+                         "message": "Siddhi app removed successfully"}
+
+    def status(self, name: str) -> tuple[int, dict]:
+        rt = self.runtimes.get(name)
+        if rt is None:
+            return 404, {"status": "ERROR",
+                         "message": f"no app '{name}' deployed"}
+        running = getattr(rt, "_started", False)
+        return 200, {"status": "OK",
+                     "state": "running" if running else "stopped"}
+
+    def send_event(self, name: str, stream_id: str,
+                   body: str) -> tuple[int, dict]:
+        rt = self.runtimes.get(name)
+        if rt is None:
+            return 404, {"status": "ERROR",
+                         "message": f"no app '{name}' deployed"}
+        try:
+            payload = json.loads(body)
+            data = payload["data"]
+            ts = payload.get("timestamp")
+            rt.input_handler(stream_id).send(data, timestamp=ts)
+        except Exception as e:
+            return 400, {"status": "ERROR", "message": str(e)}
+        return 200, {"status": "OK", "message": "event sent"}
+
+    # -- lifecycle -------------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        with self._lock:
+            for name, rt in list(self.runtimes.items()):
+                rt.shutdown()
+                self.manager.runtimes.pop(name, None)
+            self.runtimes.clear()

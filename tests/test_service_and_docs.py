@@ -1,0 +1,178 @@
+"""L9 tests: REST deploy service, extension metadata validation, doc-gen
+(reference: ``modules/siddhi-service`` ``SiddhiApiServiceImpl.java:45``,
+``modules/siddhi-annotations`` ``InputParameterValidator.java``,
+``modules/siddhi-doc-gen``).
+"""
+
+import json
+import http.client
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.extension import (
+    Example,
+    Parameter,
+    ReturnAttribute,
+    ScalarFunctionExtension,
+    extension,
+    validate_extension_args,
+)
+from siddhi_tpu.doc_gen import generate_extension_docs
+from siddhi_tpu.query_api.definition import DataType
+from siddhi_tpu.service import SiddhiService
+
+
+# ------------------------------------------------------------------ service
+
+APP = """
+@app:name('StockApp')
+define stream S (sym string, p double);
+from S[p > 10] select sym, p insert into O;
+"""
+
+
+@pytest.fixture
+def service():
+    svc = SiddhiService(playback=True)
+    svc.start()
+    yield svc
+    svc.stop()
+
+
+def _req(svc, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", svc.port, timeout=10)
+    conn.request(method, path, body=body)
+    resp = conn.getresponse()
+    data = json.loads(resp.read().decode())
+    conn.close()
+    return resp.status, data
+
+
+def test_deploy_list_status_undeploy(service):
+    code, data = _req(service, "POST", "/siddhi-apps", APP)
+    assert code == 200 and data["status"] == "OK" and data["name"] == "StockApp"
+
+    code, data = _req(service, "GET", "/siddhi-apps")
+    assert code == 200 and data["apps"] == ["StockApp"]
+
+    code, data = _req(service, "GET", "/siddhi-apps/StockApp/status")
+    assert code == 200 and data["state"] == "running"
+
+    code, data = _req(service, "DELETE", "/siddhi-apps/StockApp")
+    assert code == 200
+    code, data = _req(service, "GET", "/siddhi-apps")
+    assert data["apps"] == []
+
+
+def test_deploy_duplicate_rejected(service):
+    assert _req(service, "POST", "/siddhi-apps", APP)[0] == 200
+    code, data = _req(service, "POST", "/siddhi-apps", APP)
+    assert code == 409 and "already deployed" in data["message"]
+
+
+def test_deploy_bad_dsl_rejected(service):
+    code, data = _req(service, "POST", "/siddhi-apps",
+                      "define stream S oops;")
+    assert code == 400 and data["status"] == "ERROR"
+
+
+def test_send_event_through_rest(service):
+    _req(service, "POST", "/siddhi-apps", APP)
+    rt = service.runtimes["StockApp"]
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(e.data for e in evs)))
+    code, _ = _req(service, "POST", "/siddhi-apps/StockApp/streams/S",
+                   json.dumps({"data": ["ibm", 12.5], "timestamp": 1000}))
+    assert code == 200
+    code, _ = _req(service, "POST", "/siddhi-apps/StockApp/streams/S",
+                   json.dumps({"data": ["low", 5.0], "timestamp": 2000}))
+    assert code == 200
+    assert got == [["ibm", 12.5]]
+    # bad stream
+    code, data = _req(service, "POST", "/siddhi-apps/StockApp/streams/Nope",
+                      json.dumps({"data": [1]}))
+    assert code == 400
+    # unknown app
+    code, _ = _req(service, "POST", "/siddhi-apps/Ghost/streams/S",
+                   json.dumps({"data": [1]}))
+    assert code == 404
+
+
+# --------------------------------------------------------------- validation
+
+class _Concat(ScalarFunctionExtension):
+    return_type = DataType.STRING
+
+    def execute(self, args):
+        return "".join(str(a) for a in args)
+
+
+CONCAT_META = dict(
+    kind="function",
+    description="Concatenates two strings.",
+    parameters=[
+        Parameter("s1", [DataType.STRING], "first string"),
+        Parameter("s2", [DataType.STRING], "second string", optional=True,
+                  default=""),
+    ],
+    return_attributes=[ReturnAttribute("out", [DataType.STRING])],
+    examples=[Example("select custom:concat2(a, b) as ab",
+                      "joins a and b")],
+)
+
+
+def test_validate_extension_args():
+    cls = extension("custom:concat2", **CONCAT_META)(_Concat)
+    validate_extension_args(cls, [DataType.STRING, DataType.STRING])
+    validate_extension_args(cls, [DataType.STRING])          # optional s2
+    with pytest.raises(TypeError, match="expects 1..2"):
+        validate_extension_args(cls, [])
+    with pytest.raises(TypeError, match="accepts"):
+        validate_extension_args(cls, [DataType.INT])
+
+
+def test_build_time_validation_in_query():
+    extension("custom:concat2", **CONCAT_META)(_Concat)
+    m = SiddhiManager()
+    with pytest.raises(Exception, match="accepts"):
+        m.create_siddhi_app_runtime("""
+            define stream S (v int);
+            from S select custom:concat2(v) as x insert into O;
+        """, playback=True)
+    # correct types build + run fine
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (a string, b string);
+        from S select custom:concat2(a, b) as x insert into O;
+    """, playback=True)
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(e.data for e in evs)))
+    rt.start()
+    rt.input_handler("S").send(["x", "y"], timestamp=1)
+    assert got == [["xy"]]
+    m.shutdown()
+
+
+# ------------------------------------------------------------------ doc-gen
+
+def test_generate_extension_docs():
+    cls = extension("custom:concat2", **CONCAT_META)(_Concat)
+    md = generate_extension_docs({"custom:concat2": cls}, title="My Exts")
+    assert "# My Exts" in md
+    assert "### custom:concat2" in md
+    assert "Concatenates two strings." in md
+    assert "| s1 | string | no |" in md
+    assert "| s2 | string | yes |" in md
+    assert "- `out` (string)" in md
+    assert "select custom:concat2(a, b) as ab" in md
+
+
+def test_docs_fall_back_to_docstring():
+    class NoMeta(ScalarFunctionExtension):
+        """One-liner about this extension."""
+        def execute(self, args):
+            return None
+
+    md = generate_extension_docs({"x:y": NoMeta})
+    assert "### x:y" in md
+    assert "One-liner about this extension." in md
