@@ -87,6 +87,7 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
     strict = (ann.get("strict") or "false").lower() == "true"
     batch = int(ann.get("batch") or 1024)
     slots = int(ann.get("slots") or 64)
+    window_cap = int(ann.get("window") or 4096)
 
     from ..tpu.expr_compile import DeviceCompileError
 
@@ -108,7 +109,8 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
             d = stream_defs.get(ist.stream_id)
             if d is None:
                 raise DeviceCompileError(f"undefined stream '{ist.stream_id}'")
-            compiled = CompiledStreamQuery(query, d, batch_capacity=batch)
+            compiled = CompiledStreamQuery(query, d, batch_capacity=batch,
+                                           window_capacity=window_cap)
 
             class _StreamRT:
                 def __init__(self):
@@ -131,8 +133,24 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                     b = self.builder.emit()
                     self.state, out = self.compiled.step(self.state, b)
                     rows = self.compiled.decode_outputs(out)
+                    self._check_counters()
                     if self.callback and rows:
                         self.callback(rows)
+
+                def _check_counters(self):
+                    # surface bounded-state overflow instead of silently
+                    # diverging from the host semantics
+                    for key, what in (("window_drops", "alive events evicted "
+                                       "(raise @device(window='N'))"),
+                                      ("ts_regressions", "out-of-order "
+                                       "timestamps clamped")):
+                        c = self.state.get(key)
+                        if c is None:
+                            continue
+                        c = int(c)
+                        if c > getattr(self, f"_warned_{key}", 0):
+                            log.warning("query '%s': %d %s", name, c, what)
+                            setattr(self, f"_warned_{key}", c)
 
                 def snapshot_state(self):
                     import jax

@@ -42,6 +42,11 @@ from .expr_compile import ColumnResolver, DeviceCompileError, compile_expression
 
 _INVERTIBLE_AGGS = {"sum", "count", "avg"}
 
+# event-time sentinels bounding every real timestamp (keep searchsorted input
+# sorted: empty tail slots sit at the front, batch padding at the back)
+_TS_NEG = -(2 ** 62)
+_TS_POS = 2 ** 62
+
 _JNP_DTYPES = {
     DataType.STRING: jnp.int32,
     DataType.INT: jnp.int32,
@@ -68,7 +73,8 @@ class CompiledStreamQuery:
     QueryRuntime role)."""
 
     def __init__(self, query: Query, definition: StreamDefinition,
-                 batch_capacity: int = 4096, group_capacity: int = 1024):
+                 batch_capacity: int = 4096, group_capacity: int = 1024,
+                 window_capacity: int = 4096):
         ist = query.input_stream
         if not isinstance(ist, SingleInputStream):
             raise DeviceCompileError("device path covers single-stream queries")
@@ -84,6 +90,8 @@ class CompiledStreamQuery:
         self.filter_fns: list[Callable] = []
         self.window_kind: Optional[str] = None
         self.window_n = 0
+        self.window_ms = 0
+        self.time_key: Optional[str] = None     # externalTime ts column
         for h in ist.handlers:
             if isinstance(h, Filter):
                 fn, _ = compile_expression(h.expr, resolver)
@@ -91,12 +99,38 @@ class CompiledStreamQuery:
             elif isinstance(h, Window):
                 if self.window_kind is not None:
                     raise DeviceCompileError("multiple windows not supported")
+                def const_param(idx: int) -> int:
+                    if len(h.params) <= idx or \
+                            not hasattr(h.params[idx], "value"):
+                        raise DeviceCompileError(
+                            f"window '{h.name}' needs a constant parameter "
+                            f"at position {idx}")
+                    return int(h.params[idx].value)
+
                 if h.name in ("length", "lengthBatch"):
                     self.window_kind = h.name
+                    self.window_n = const_param(0)
+                elif h.name == "time":
+                    # sliding event-time window; the device clock IS event time
+                    # (watermark ingress), so time == externalTime on arrival ts
+                    self.window_kind = "time"
+                    self.window_ms = const_param(0)
+                    self.window_n = window_capacity
+                elif h.name == "externalTime":
+                    if len(h.params) != 2 or not isinstance(h.params[0], Variable):
+                        raise DeviceCompileError(
+                            "externalTime needs (timestamp attribute, duration)")
+                    key, kt = resolver.resolve(h.params[0])
+                    if kt not in (DataType.LONG, DataType.INT):
+                        raise DeviceCompileError(
+                            "externalTime attribute must be long/int")
+                    self.window_kind = "time"
+                    self.time_key = key
+                    self.window_ms = const_param(1)
+                    self.window_n = window_capacity
                 else:
                     raise DeviceCompileError(
                         f"window '{h.name}' has no device kernel yet")
-                self.window_n = int(h.params[0].value)
             else:
                 raise DeviceCompileError("stream functions not on device path")
 
@@ -157,9 +191,15 @@ class CompiledStreamQuery:
         N = max(self.window_n, 1)
         A = len(self.agg_idx)
         state: dict[str, Any] = {}
-        if self.window_kind in ("length", "lengthBatch"):
+        if self.window_kind in ("length", "lengthBatch", "time"):
             state["tail_vals"] = jnp.zeros((A, N), dtype=jnp.float64)
             state["tail_ones"] = jnp.zeros((N,), dtype=jnp.float64)
+        if self.window_kind == "time":
+            # sentinel = long-expired; keeps the concat ts array sorted
+            state["tail_ts"] = jnp.full((N,), _TS_NEG, dtype=jnp.int64)
+            state["window_drops"] = jnp.zeros((), dtype=jnp.int64)
+            state["last_ts"] = jnp.asarray(_TS_NEG, dtype=jnp.int64)
+            state["ts_regressions"] = jnp.zeros((), dtype=jnp.int64)
         if self.window_kind == "lengthBatch":
             state["rem_count"] = jnp.zeros((), dtype=jnp.int32)
             state["rem_ts"] = jnp.zeros((N,), dtype=jnp.int64)
@@ -181,6 +221,7 @@ class CompiledStreamQuery:
         specs = self.specs
         value_idx, agg_idx = self.value_idx, self.agg_idx
         window_kind, N = self.window_kind, max(self.window_n, 1)
+        window_ms, time_key = self.window_ms, self.time_key
         group_key = self.group_key
         K = self.K
 
@@ -226,6 +267,17 @@ class CompiledStreamQuery:
             if window_kind == "lengthBatch":
                 return _length_batch(state, specs, value_idx, agg_idx, proj_c,
                                      av, ones_c, cts, k, N, B)
+
+            if window_kind == "time":
+                wts = compact(cols[time_key].astype(jnp.int64)) if time_key \
+                    else cts
+                state, sums, cnts = _time_window(
+                    state, av, ones_c, wts, k, N, B, window_ms)
+                out, out_valid = _materialize(
+                    specs, value_idx, agg_idx, proj_c, sums, cnts,
+                    jnp.arange(B) < k)
+                return state, {"out": out, "valid": out_valid, "ts": cts,
+                               "count": k}
 
             if group_key is not None:
                 keys = compact(cols[group_key].astype(jnp.int32)) % K
@@ -309,6 +361,61 @@ def _length_window(state, av, ones_c, k, N, B):
     new_tail_o = jax.lax.dynamic_slice(zo, (k,), (N,))
     return ({**state, "tail_vals": new_tail_v, "tail_ones": new_tail_o},
             sums, cnts)
+
+
+def _time_window(state, av, ones_c, wts, k, N, B, D):
+    """Sliding event-time window: per-event aggregates over events with
+    ``ts > now - D`` via searchsorted on the (sorted) tail+batch timestamp
+    axis + leading-zero cumsum differences. Requires non-decreasing event
+    time (the watermark ingress guarantees it). Fixed tail capacity N; events
+    evicted while still alive are counted in ``window_drops`` (explicit
+    bounded-state overflow policy, SURVEY §7 hard part 1)."""
+    A = av.shape[0]
+    valid = jnp.arange(B) < k
+    # searchsorted needs a sorted ts axis: clamp regressions to the running
+    # max (the event is treated as arriving "now") and count them — loud,
+    # not silently corrupting (externalTime columns carry no order guarantee)
+    raw = jnp.where(valid, wts, _TS_POS)
+    mono = jnp.maximum(jax.lax.cummax(raw), state["last_ts"])
+    regressed = jnp.sum(jnp.where(valid & (raw < mono), 1, 0)).astype(jnp.int64)
+    # padding slots (>= k) get +sentinel ts so the concat stays sorted
+    wts_s = jnp.where(valid, mono, _TS_POS)
+    z = jnp.concatenate([state["tail_vals"], av], axis=1)          # [A, N+B]
+    zo = jnp.concatenate([state["tail_ones"], ones_c])             # [N+B]
+    zts = jnp.concatenate([state["tail_ts"], wts_s])               # [N+B]
+
+    j = jnp.arange(B) + N
+    lo = jnp.searchsorted(zts, wts_s - D, side="right")            # [B]
+    cso = jnp.concatenate([jnp.zeros((1,)), jnp.cumsum(zo)])
+    cnts = cso[j + 1] - cso[lo]
+    if A:
+        csz = jnp.concatenate([jnp.zeros((A, 1)), jnp.cumsum(z, axis=1)], axis=1)
+        sums = csz[:, j + 1] - csz[:, lo]
+        new_tail_v = jax.vmap(
+            lambda row: jax.lax.dynamic_slice(row, (k,), (N,)))(z)
+    else:
+        sums = jnp.zeros((0, B))
+        new_tail_v = state["tail_vals"]
+
+    # overflow: entries sliced off the front that were still alive w.r.t. the
+    # newest event's clock
+    newest = zts[jnp.maximum(N + k - 1, 0)]
+    sliced = jnp.arange(N + B) < k
+    drops = jnp.sum(jnp.where(sliced & (zts > newest - D), zo, 0.0)
+                    ).astype(jnp.int64)
+
+    new_state = {
+        **state,
+        "tail_vals": new_tail_v,
+        "tail_ones": jax.lax.dynamic_slice(zo, (k,), (N,)),
+        "tail_ts": jax.lax.dynamic_slice(zts, (k,), (N,)),
+        "window_drops": state["window_drops"] + drops,
+        "last_ts": jnp.maximum(state["last_ts"],
+                               jnp.where(k > 0, mono[jnp.maximum(k - 1, 0)],
+                                         state["last_ts"])),
+        "ts_regressions": state["ts_regressions"] + regressed,
+    }
+    return new_state, sums, cnts
 
 
 def _length_batch(state, specs, value_idx, agg_idx, proj_c, av, ones_c, cts,

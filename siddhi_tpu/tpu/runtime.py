@@ -20,7 +20,8 @@ from .query_compile import CompiledStreamQuery
 
 class DeviceStreamRuntime:
     def __init__(self, app_or_text, batch_capacity: int = 4096,
-                 group_capacity: int = 1024, query_index: int = 0):
+                 group_capacity: int = 1024, query_index: int = 0,
+                 window_capacity: int = 4096):
         app = _parse(app_or_text) if isinstance(app_or_text, str) else app_or_text
         queries = app.queries
         if not queries:
@@ -31,7 +32,8 @@ class DeviceStreamRuntime:
             raise KeyError(f"stream '{sid}' not defined")
         self.definition = app.stream_definitions[sid]
         self.compiled = CompiledStreamQuery(
-            query, self.definition, batch_capacity, group_capacity)
+            query, self.definition, batch_capacity, group_capacity,
+            window_capacity)
         self.builder = BatchBuilder(self.compiled.schema, batch_capacity)
         self.state = self.compiled.init_state()
         self.callback: Optional[Callable[[list[list]], None]] = None

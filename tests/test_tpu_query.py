@@ -104,6 +104,128 @@ def test_parity_projection_math():
     assert_parity(app, rows, batch_capacity=17)
 
 
+def _parity_with_ts(app, rows, tss, batch_capacity=64):
+    """Parity runner with explicit per-row event timestamps."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, playback=True)
+    expected = []
+    rt.add_callback("O", StreamCallback(
+        lambda evs: expected.extend(e.data for e in evs)))
+    rt.start()
+    ih = rt.input_handler("S")
+    for r, ts in zip(rows, tss):
+        ih.send(r, timestamp=ts)
+    m.shutdown()
+
+    drt = DeviceStreamRuntime(app, batch_capacity=batch_capacity)
+    actual = []
+    drt.add_callback(actual.extend)
+    for r, ts in zip(rows, tss):
+        drt.send(r, timestamp=ts)
+    drt.flush()
+
+    assert len(expected) == len(actual), (len(expected), len(actual))
+    for e, a in zip(expected, actual):
+        for x, y in zip(e, a):
+            if isinstance(x, float) or isinstance(y, float):
+                assert y == pytest.approx(x, rel=1e-9), (e, a)
+            else:
+                assert x == y, (e, a)
+
+
+def _bursty_ts(n, seed, max_gap=40):
+    """Irregular non-decreasing event times: bursts + idle gaps."""
+    rng = random.Random(seed)
+    ts, out = 1000, []
+    for _ in range(n):
+        ts += rng.choice([0, 1, 1, 2, 5, max_gap])
+        out.append(ts)
+    return out
+
+
+def test_parity_time_window():
+    app = """
+    define stream S (sym string, v long);
+    from S#window.time(100)
+    select sym, sum(v) as s, count() as c, avg(v) as a insert into O;
+    """
+    rng = random.Random(6)
+    rows = [[rng.choice("abc"), rng.randrange(100)] for _ in range(400)]
+    _parity_with_ts(app, rows, _bursty_ts(400, 7), batch_capacity=32)
+
+
+def test_parity_time_window_with_filter():
+    app = """
+    define stream S (sym string, v long);
+    from S[v > 20]#window.time(60)
+    select sym, sum(v) as s, count() as c insert into O;
+    """
+    rng = random.Random(8)
+    rows = [[rng.choice("xy"), rng.randrange(100)] for _ in range(300)]
+    _parity_with_ts(app, rows, _bursty_ts(300, 9), batch_capacity=13)
+
+
+def test_parity_external_time_window():
+    app = """
+    define stream S (sym string, v long, ets long);
+    from S#window.externalTime(ets, 80)
+    select sym, sum(v) as s, count() as c insert into O;
+    """
+    rng = random.Random(10)
+    ets = _bursty_ts(300, 11)
+    rows = [[rng.choice("pq"), rng.randrange(50), t] for t in ets]
+    # arrival ts == external ts here (watermark clock is event time); the
+    # kernel still reads the ets column explicitly
+    _parity_with_ts(app, rows, ets, batch_capacity=29)
+
+
+def test_external_time_out_of_order_clamped_and_counted():
+    """Review regression: a regressing externalTime column must not corrupt
+    the sorted window axis — regressions clamp to the running max and count."""
+    from siddhi_tpu.tpu import DeviceStreamRuntime as DSR
+    app = """
+    define stream S (v long, ets long);
+    from S#window.externalTime(ets, 80) select sum(v) as s, count() as c
+    insert into O;
+    """
+    drt = DSR(app, batch_capacity=4)
+    got = []
+    drt.add_callback(got.extend)
+    for v, ets in [(1, 1000), (1, 1100), (2, 1050), (3, 1120)]:
+        drt.send([v, ets], timestamp=ets)
+    drt.flush()
+    st = drt.snapshot_state()
+    assert int(st["ts_regressions"]) == 1
+    # clamped semantics: 1000 expires at 1100; the 1050 event is treated as
+    # arriving at the running max (1100) so it joins that window; at 1120
+    # both 1100-stamped events are still alive
+    assert got == [[1, 1], [1, 1], [3, 2], [6, 3]]
+
+
+def test_external_time_bad_arity_is_compile_error():
+    from siddhi_tpu.tpu import DeviceStreamRuntime as DSR
+    with pytest.raises(DeviceCompileError):
+        DSR("""
+        define stream S (v long, ets long);
+        from S#window.externalTime(ets) select sum(v) as s insert into O;
+        """)
+
+
+def test_time_window_drop_counter():
+    """Tail-capacity overflow is surfaced, not silent."""
+    from siddhi_tpu.tpu import DeviceStreamRuntime as DSR
+    app = """
+    define stream S (v long);
+    from S#window.time(1000000) select sum(v) as s insert into O;
+    """
+    drt = DSR(app, batch_capacity=8, window_capacity=8)
+    for i in range(64):
+        drt.send([1], timestamp=1000 + i)
+    drt.flush()
+    drops = int(drt.snapshot_state()["window_drops"])
+    assert drops > 0
+
+
 def test_device_state_snapshot_roundtrip():
     app = """
     define stream S (v long);
@@ -132,7 +254,7 @@ def test_unsupported_falls_back_cleanly():
     with pytest.raises(DeviceCompileError):
         DeviceStreamRuntime("""
         define stream S (v long);
-        from S#window.time(1 sec) select sum(v) as s insert into O;
+        from S#window.session(1 sec) select sum(v) as s insert into O;
         """)
     with pytest.raises(DeviceCompileError):
         DeviceStreamRuntime("""
