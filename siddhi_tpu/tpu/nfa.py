@@ -18,10 +18,17 @@ unbounded cloned ``StateEvent`` lists) becomes:
   exhaustion is an explicit drop-newest policy with an overflow counter.
 
 Scope (host interpreter is the fallback for the rest): linear chains of
-stream/count states over one or more input streams, ``every`` scopes starting at
-state 0, stream-level ``within``, final state must be a stream state. Logical
-(and/or), absent, element-level within, and `e[k]` indexing beyond first/last
-stay on the host path this round.
+stream/count/logical/absent states over one or more input streams, ``every``
+scopes starting at state 0, stream-level ``within``. Logical ``and``/``or``
+(incl. ``X and not Y`` without ``for``) use per-slot done flags + masked side
+binds; standalone ``not X for t`` carries a per-slot arrival clock — expiry is
+evaluated in a pre-pass on the next arriving event (host timers fire before
+event delivery, so observable timing matches under the event-driven clock).
+Still host-only: final count states, element-level ``within``, absent without
+``for``, patterns starting with absent, logical/absent directly after a count
+state or inside sequences, sibling-alias references inside a logical state,
+and `e[k]` indexing beyond first/last. An OR output referencing the unmatched
+side's alias emits that side's zero value (host emits null).
 """
 
 from __future__ import annotations
@@ -141,15 +148,36 @@ class MergedBatchBuilder:
 # ---------------------------------------------------------------------------
 
 @dataclass
-class _DevState:
-    index: int
-    kind: str                    # 'stream' | 'count'
+class _DevBranch:
     stream_idx: int
     alias: str
-    predicate: Optional[Callable]    # fn(env) -> bool/[C]
+    predicate: Optional[Callable] = None   # fn(env) -> bool/[C]
+    is_absent: bool = False
+
+
+@dataclass
+class _DevState:
+    index: int
+    kind: str                    # 'stream' | 'count' | 'logical' | 'absent'
+    branches: "list[_DevBranch]"
+    logical_type: Optional[str] = None     # 'and' | 'or'
+    waiting_ms: Optional[int] = None       # absent `for`
     min_count: int = 1
     max_count: int = 1
     ends_every: bool = False     # reseed scope [0..index]
+
+    # single-branch conveniences (stream/count states)
+    @property
+    def stream_idx(self) -> int:
+        return self.branches[0].stream_idx
+
+    @property
+    def alias(self) -> str:
+        return self.branches[0].alias
+
+    @property
+    def predicate(self):
+        return self.branches[0].predicate
 
 
 class _NFAResolver:
@@ -161,25 +189,36 @@ class _NFAResolver:
       ``b{q}_first_{attr}`` / ``b{q}_last_{attr}`` — count-state variants
     """
 
-    def __init__(self, nfa: "DeviceNFACompiler", current_state: int):
+    def __init__(self, nfa: "DeviceNFACompiler", current_state: Optional[int],
+                 current_alias: Optional[str] = None):
         self.nfa = nfa
         self.current = current_state
+        self.current_alias = current_alias
 
     def resolve(self, var: Variable) -> tuple[str, DataType]:
         nfa = self.nfa
         alias = var.stream_id
         cur = nfa.states[self.current] if self.current is not None else None
-        if alias is None or (cur is not None and alias == cur.alias):
+        cur_aliases = [b.alias for b in cur.branches] if cur is not None else []
+        if alias is None or (cur is not None and alias in cur_aliases):
+            # candidate-event reference: the state currently being matched.
+            # A logical branch predicate only sees its own event — sibling
+            # references need the host path (unbound-side semantics).
             if cur is None:
                 raise DeviceCompileError("bare attribute outside a state context")
-            sid = nfa.compiled.alias_defs[cur.alias].id
+            a = alias or self.current_alias or cur.branches[0].alias
+            if self.current_alias is not None and a != self.current_alias:
+                raise DeviceCompileError(
+                    "sibling alias reference inside a logical state needs "
+                    "the host path")
+            sid = nfa.compiled.alias_defs[a].id
             key = nfa.merged.col_key(sid, var.attribute)
-            if var.attribute not in nfa.compiled.alias_defs[cur.alias].attribute_names:
+            if var.attribute not in nfa.compiled.alias_defs[a].attribute_names:
                 raise DeviceCompileError(f"unknown attribute '{var.attribute}'")
             return f"ev_{key}", nfa.merged.columns[key]
-        if alias not in nfa.alias_state:
+        if alias not in nfa.alias_branch:
             raise DeviceCompileError(f"unknown alias '{alias}'")
-        q = nfa.alias_state[alias]
+        q, bi = nfa.alias_branch[alias]
         d = nfa.compiled.alias_defs[alias]
         if var.attribute not in d.attribute_names:
             raise DeviceCompileError(f"unknown attribute '{var.attribute}'")
@@ -189,6 +228,8 @@ class _NFAResolver:
                 variant = f"b{q}_first_{var.attribute}"
             else:          # last / None
                 variant = f"b{q}_last_{var.attribute}"
+        elif nfa.states[q].kind == "logical":
+            variant = f"b{q}x{bi}_{var.attribute}"
         else:
             if var.stream_index not in (None,):
                 from ..query_api.expression import LAST_INDEX
@@ -213,14 +254,17 @@ class _NFAResolver:
         return dic.encode(value)
 
     def _bound_to_merged(self, key: str) -> str:
-        # b{q}[_first|_last]_{attr}
+        # b{q}[x{bi}][_first|_last]_{attr}
         body = key[1:]
         q_str, rest = body.split("_", 1)
-        q = int(q_str)
-        for pref in ("first_", "last_"):
-            if rest.startswith(pref):
-                rest = rest[len(pref):]
-        alias = self.nfa.states[q].alias
+        if "x" in q_str:
+            q_part, bi_part = q_str.split("x")
+            alias = self.nfa.states[int(q_part)].branches[int(bi_part)].alias
+        else:
+            for pref in ("first_", "last_"):
+                if rest.startswith(pref):
+                    rest = rest[len(pref):]
+            alias = self.nfa.states[int(q_str)].alias
         sid = self.nfa.compiled.alias_defs[alias].id
         return self.nfa.merged.col_key(sid, rest)
 
@@ -242,28 +286,55 @@ class DeviceNFACompiler:
 
         # validate + lower nodes
         self.states: list[_DevState] = []
-        self.alias_state: dict[str, int] = {}
+        self.alias_branch: dict[str, tuple[int, int]] = {}   # alias → (state, branch)
         self.referenced: set[tuple[int, str, DataType]] = set()
         nodes = self.compiled.nodes
         for node in nodes:
-            if node.kind not in ("stream", "count"):
+            if node.kind not in ("stream", "count", "logical", "absent"):
                 raise DeviceCompileError(
                     f"'{node.kind}' states need the host path")
             if node.within_ms is not None:
                 raise DeviceCompileError("element-level within needs host path")
             if node.reseed_to not in (None, 0):
                 raise DeviceCompileError("`every` scope must start the pattern")
-            b = node.branches[0]
-            sid_idx = self.merged.stream_index[b.stream_id]
+            if node.kind == "logical" and node.waiting_time_ms is not None:
+                raise DeviceCompileError(
+                    "`and not X for t` needs the host path")
+            if node.kind == "absent":
+                if node.waiting_time_ms is None:
+                    raise DeviceCompileError(
+                        "absent without `for` needs the host path")
+                if node.index == 0:
+                    raise DeviceCompileError(
+                        "pattern starting with absent needs the host path")
+            if node.kind in ("logical", "absent") and node.index > 0 \
+                    and nodes[node.index - 1].kind == "count":
+                raise DeviceCompileError(
+                    "logical/absent after a count state needs the host path")
+            if node.kind in ("logical", "absent") and self.is_sequence:
+                raise DeviceCompileError(
+                    "logical/absent in sequences needs the host path")
+            if node.kind == "logical" and node.index == 0 and \
+                    any(b.is_absent for b in node.branches):
+                raise DeviceCompileError(
+                    "pattern starting with `X and not Y` needs the host path")
+            branches = [
+                _DevBranch(stream_idx=self.merged.stream_index[b.stream_id],
+                           alias=b.alias, is_absent=b.is_absent)
+                for b in node.branches
+            ]
             st = _DevState(
-                index=node.index, kind=node.kind, stream_idx=sid_idx,
-                alias=b.alias, predicate=None,
+                index=node.index, kind=node.kind, branches=branches,
+                logical_type=(node.logical_type.value
+                              if node.logical_type is not None else None),
+                waiting_ms=node.waiting_time_ms,
                 min_count=node.min_count, max_count=node.max_count,
                 ends_every=node.reseed_to == 0,
             )
             self.states.append(st)
-            self.alias_state[b.alias] = node.index
-        if self.states[-1].kind != "stream":
+            for bi, b in enumerate(node.branches):
+                self.alias_branch[b.alias] = (node.index, bi)
+        if self.states[-1].kind == "count":
             raise DeviceCompileError("final count state needs the host path")
 
         self.S = len(self.states)
@@ -283,13 +354,15 @@ class DeviceNFACompiler:
         # recover filter ASTs from the host compiler's branch filters is not
         # possible (already closures), so re-walk the AST tree in node order
         from ..query_api import (
+            AbsentStreamStateElement,
             CountStateElement,
             EveryStateElement,
             Filter,
+            LogicalStateElement,
             NextStateElement,
             StreamStateElement,
         )
-        filters: list[Any] = []
+        filters: list[list[Any]] = []     # per node, per branch
 
         def walk(el):
             if isinstance(el, NextStateElement):
@@ -298,9 +371,16 @@ class DeviceNFACompiler:
             elif isinstance(el, EveryStateElement):
                 walk(el.inner)
             elif isinstance(el, StreamStateElement):
-                filters.append(_filter_of(el.stream))
+                filters.append([_filter_of(el.stream)])
             elif isinstance(el, CountStateElement):
-                filters.append(_filter_of(el.stream.stream))
+                filters.append([_filter_of(el.stream.stream)])
+            elif isinstance(el, LogicalStateElement):
+                row = []
+                for sub in (el.first, el.second):
+                    row.append(_filter_of(sub.stream))
+                filters.append(row)
+            elif isinstance(el, AbsentStreamStateElement):
+                filters.append([_filter_of(el.stream)])
             else:
                 raise DeviceCompileError(
                     f"{type(el).__name__} needs the host path")
@@ -315,13 +395,15 @@ class DeviceNFACompiler:
 
         walk(ist.state)
         assert len(filters) == self.S
-        for s, ast in zip(self.states, filters):
-            if ast is None:
-                s.predicate = None
-            else:
-                resolver = _NFAResolver(self, s.index)
-                fn, _ = compile_expression(ast, resolver)
-                s.predicate = fn
+        for s, asts in zip(self.states, filters):
+            assert len(asts) == len(s.branches)
+            for b, ast in zip(s.branches, asts):
+                if ast is None:
+                    b.predicate = None
+                else:
+                    resolver = _NFAResolver(self, s.index, b.alias)
+                    fn, _ = compile_expression(ast, resolver)
+                    b.predicate = fn
 
     def _compile_output(self, query: Query) -> None:
         sel = query.selector
@@ -330,8 +412,11 @@ class DeviceNFACompiler:
         if sel.select_all or not attrs:
             raise DeviceCompileError("pattern select * needs the host path")
         final = self.S - 1
+        # logical/absent finals emit from slot-bound values (possibly with no
+        # candidate event at all), so bare/candidate references must not bind
+        out_ctx = final if self.states[final].kind == "stream" else None
         for oa in attrs:
-            resolver = _NFAResolver(self, final)
+            resolver = _NFAResolver(self, out_ctx)
             fn, t = compile_expression(oa.expr, resolver)
             self.out_specs.append((oa.name, fn, t))
 
@@ -347,8 +432,15 @@ class DeviceNFACompiler:
             if self.states[s].kind == "count":
                 fields["count"] = jnp.zeros((C,), jnp.int32)
                 fields["closed"] = jnp.zeros((C,), jnp.bool_)
+            if self.states[s].kind == "logical" and \
+                    self.states[s].logical_type == "and":
+                for bi in range(len(self.states[s].branches)):
+                    fields[f"done{bi}"] = jnp.zeros((C,), jnp.bool_)
+            if self.states[s].kind == "absent":
+                fields["arrive_ts"] = jnp.zeros((C,), jnp.int64)
             for (q, key, t) in self.referenced:
-                if q < s or (q == s and self.states[s].kind == "count"):
+                if q < s or (q == s and self.states[s].kind in
+                             ("count", "logical")):
                     fields[key] = jnp.zeros((C,), _JNP[t])
             pend[f"p{s}"] = fields
         return {
@@ -399,8 +491,17 @@ class DeviceNFACompiler:
                 new["count"] = slots["count"].at[tgt].set(
                     jnp.where(ok, cnew, 0), mode="drop")
                 new["closed"] = slots["closed"].at[tgt].set(False, mode="drop")
-            for key, arr in values.items():
-                if key in slots:
+            # every field is written for inserted slots: either the provided
+            # value or a zero reset — a freed slot must not leak stale bound
+            # values / done flags into the partial that reuses it
+            for key in slots:
+                if key in ("valid", "first_ts", "count", "closed"):
+                    continue
+                arr = values.get(key)
+                if arr is None:
+                    new[key] = slots[key].at[tgt].set(
+                        jnp.zeros((), slots[key].dtype), mode="drop")
+                else:
                     new[key] = slots[key].at[tgt].set(
                         jnp.where(ok, arr, jnp.zeros((), arr.dtype)), mode="drop")
             dropped = jnp.maximum(n_ins - n_free, 0)
@@ -429,6 +530,58 @@ class DeviceNFACompiler:
             out_cols = [jnp.zeros((2, C), _JNP[t]) for (_, _, t) in out_specs]
             touched = {s: jnp.zeros((C,), jnp.bool_) for s in range(S)}
 
+            def emit_rows(out_mask, out_cols, n_match, mask, row, emit_env):
+                """Accumulate matched slots into output row `row`."""
+                out_mask = out_mask.at[row].set(out_mask[row] | mask)
+                for oi, (_, fn, t) in enumerate(out_specs):
+                    val = jnp.broadcast_to(fn(emit_env), (C,)).astype(
+                        out_cols[oi].dtype)
+                    out_cols[oi] = out_cols[oi].at[row].set(
+                        jnp.where(mask, val, out_cols[oi][row]))
+                return out_mask, out_cols, \
+                    n_match + jnp.sum(mask.astype(jnp.int64))
+
+            # ---- absent expiry pre-pass: host timers fire BEFORE the event
+            # is delivered, so established non-occurrences advance first (the
+            # arriving event can then match the successor state). Ascending
+            # order lets a partial hop a chain of expired absents in one step.
+            for s in [i for i, stx in enumerate(states) if stx.kind == "absent"]:
+                st = states[s]
+                slots = pend[f"p{s}"]
+                adv = slots["valid"] & ev_ok & (slots["arrive_ts"] > 0) & \
+                    (ev_ts >= slots["arrive_ts"] + st.waiting_ms)
+                ns = dict(slots)
+                ns["valid"] = ns["valid"] & ~adv
+                pend[f"p{s}"] = ns
+                touched[s] = touched[s] | adv
+                n_adv = jnp.sum(adv.astype(jnp.int64))
+                if s == S - 1:
+                    emit_env = {f"ev_{k}": ev["cols"][k] for k in ev["cols"]}
+                    for (q, key, t) in referenced:
+                        if key in slots:
+                            emit_env[key] = slots[key]
+                    out_mask, out_cols, n_match = emit_rows(
+                        out_mask, out_cols, n_match, adv, 0, emit_env)
+                else:
+                    values = {key: slots[key] for (q, key, t) in referenced
+                              if key in slots and q < s}
+                    if states[s + 1].kind == "absent":
+                        # the successor's non-occurrence clock starts at THIS
+                        # absent's established expiry time, not at the event
+                        # that surfaced it — host chains timers back-to-back
+                        values["arrive_ts"] = (
+                            slots["arrive_ts"] + st.waiting_ms).astype(jnp.int64)
+                    new_tgt, dropped, inserted = insert(
+                        pend[f"p{s+1}"], adv, values,
+                        jnp.where(slots["first_ts"] > 0, slots["first_ts"],
+                                  ev_ts),
+                        jnp.zeros((C,), jnp.int32))
+                    pend[f"p{s+1}"] = new_tgt
+                    touched[s + 1] = touched[s + 1] | inserted
+                    drops = drops + dropped.astype(jnp.int64)
+                if every_end == s:
+                    seeds = seeds + n_adv
+
             def env_for(level: int, ev):
                 env = {f"ev_{k}": ev["cols"][k] for k in ev["cols"]}
                 env.update({key: pend[f"p{level}"][key]
@@ -438,8 +591,192 @@ class DeviceNFACompiler:
 
             seed_pred_cache = {}
 
+            def logical_state(s, st, pend, seeds, drops, n_match, out_mask,
+                              out_cols, touched, ev, ev_ts, ev_tag, ev_ok,
+                              env_for):
+                pres = [bi for bi, br in enumerate(st.branches)
+                        if not br.is_absent]
+                absent_bis = [bi for bi, br in enumerate(st.branches)
+                              if br.is_absent]
+                slots = pend[f"p{s}"]
+                env = env_for(s, ev)
+                bm = []
+                for br in st.branches:
+                    g = ev_ok & (ev_tag == br.stream_idx)
+                    p_ = jnp.ones((C,), jnp.bool_) if br.predicate is None \
+                        else jnp.broadcast_to(br.predicate(env), (C,))
+                    bm.append(slots["valid"] & g & p_)
+                if absent_bis:
+                    # `X and not Y`: Y's arrival kills the partial
+                    kill = jnp.zeros((C,), jnp.bool_)
+                    for bi in absent_bis:
+                        kill = kill | bm[bi]
+                    ns = dict(slots)
+                    ns["valid"] = ns["valid"] & ~kill
+                    pend[f"p{s}"] = ns
+                    touched[s] = touched[s] | kill
+                    bm = [m & ~kill for m in bm]
+                    slots = pend[f"p{s}"]
+
+                def side_bind(values, bi, mask, into=None):
+                    """Masked bind of branch bi's event columns into values."""
+                    br = st.branches[bi]
+                    sid = self.compiled.alias_defs[br.alias].id
+                    for (q, key, t) in referenced:
+                        if q == s and key.startswith(f"b{s}x{bi}_"):
+                            attr = key[len(f"b{s}x{bi}_"):]
+                            mk = self.merged.col_key(sid, attr)
+                            base = into[key] if into is not None else \
+                                jnp.zeros((C,), _JNP[t])
+                            values[key] = jnp.where(
+                                mask, ev["cols"][mk].astype(_JNP[t]), base)
+
+                if st.logical_type == "and" and not absent_bis:
+                    # both sides must arrive (any order); flags + in-place bind
+                    m0 = bm[0]
+                    m1 = bm[1] & ~m0       # one event binds one side (host:
+                    ns = dict(slots)       # first matching branch wins)
+                    for bi, ap in ((0, m0), (1, m1)):
+                        ns[f"done{bi}"] = ns[f"done{bi}"] | ap
+                        side_bind(ns, bi, ap, into=ns)
+                    complete = ns["valid"] & ns["done0"] & ns["done1"]
+                    ns["valid"] = ns["valid"] & ~complete
+                    touched[s] = touched[s] | m0 | m1
+                    pend[f"p{s}"] = ns
+                    advance, adv_src = complete, ns
+                    values = {key: ns[key] for (q, key, t) in referenced
+                              if key in ns and q <= s}
+                else:
+                    # OR — or `X and not Y` (present match advances)
+                    m0 = bm[pres[0]]
+                    m1 = (bm[pres[1]] & ~m0) if len(pres) > 1 \
+                        else jnp.zeros((C,), jnp.bool_)
+                    advance = m0 | m1
+                    touched[s] = touched[s] | advance
+                    ns = dict(slots)
+                    ns["valid"] = ns["valid"] & ~advance
+                    pend[f"p{s}"] = ns
+                    adv_src = slots
+                    values = {key: slots[key] for (q, key, t) in referenced
+                              if key in slots and q < s}
+                    side_bind(values, pres[0], m0)
+                    if len(pres) > 1:
+                        side_bind(values, pres[1], m1)
+
+                first_ts_new = jnp.where(adv_src["first_ts"] > 0,
+                                         adv_src["first_ts"], ev_ts)
+                n_adv = jnp.sum(advance.astype(jnp.int64))
+                if s == S - 1:
+                    emit_env = {f"ev_{k}": ev["cols"][k] for k in ev["cols"]}
+                    for (q, key, t) in referenced:
+                        if key in values:
+                            emit_env[key] = values[key]
+                        elif key in adv_src:
+                            emit_env[key] = adv_src[key]
+                    out_mask, out_cols, n_match = emit_rows(
+                        out_mask, out_cols, n_match, advance, 0, emit_env)
+                else:
+                    if states[s + 1].kind == "absent":
+                        values["arrive_ts"] = jnp.broadcast_to(
+                            ev_ts, (C,)).astype(jnp.int64)
+                    new_tgt, dropped, inserted = insert(
+                        pend[f"p{s+1}"], advance, values, first_ts_new,
+                        jnp.zeros((C,), jnp.int32))
+                    pend[f"p{s+1}"] = new_tgt
+                    touched[s + 1] = touched[s + 1] | inserted
+                    drops = drops + dropped.astype(jnp.int64)
+                if every_end == s:
+                    seeds = seeds + n_adv
+
+                # ---- seeding at a logical state 0 (no absent branches here;
+                # rejected at compile time)
+                if s == 0:
+                    env0 = {f"ev_{k}": ev["cols"][k] for k in ev["cols"]}
+                    # AND seeds linger half-bound, so `every` must NOT seed on
+                    # each event (host keeps ONE seed, rebinding sides, until
+                    # completion replenishes) — gate on the seed counter; OR
+                    # consumes its seed immediately, so always_seed is safe
+                    is_and0 = st.logical_type == "and"
+                    seeds_ok = jnp.array(True) if (always_seed and not is_and0) \
+                        else seeds > 0
+                    cans = {}
+                    taken = jnp.asarray(False)
+                    for bi in pres:
+                        br = st.branches[bi]
+                        g0 = ev_ok & (ev_tag == br.stream_idx)
+                        p0 = jnp.asarray(True) if br.predicate is None \
+                            else jnp.asarray(br.predicate(env0))
+                        c = g0 & p0 & ~taken
+                        taken = taken | c
+                        cans[bi] = c & seeds_ok
+                    can_any = taken & seeds_ok
+                    if st.logical_type == "and":
+                        seed_vals = {}
+                        for bi in pres:
+                            seed_vals[f"done{bi}"] = jnp.broadcast_to(
+                                cans[bi], (C,))
+                            side_bind(seed_vals, bi, cans[bi])
+                        ins_mask = jnp.zeros((C,), jnp.bool_).at[0].set(can_any)
+                        new0, dropped, inserted = insert(
+                            pend["p0"], ins_mask, seed_vals,
+                            jnp.broadcast_to(ev_ts, (C,)))
+                        pend["p0"] = new0
+                        touched[0] = touched[0] | inserted
+                        drops = drops + dropped.astype(jnp.int64)
+                    else:    # OR seed completes the state immediately
+                        seed_vals = {key: jnp.zeros((C,), _JNP[t])
+                                     for (q, key, t) in referenced if q == 0}
+                        for bi in pres:
+                            side_bind(seed_vals, bi, cans[bi], into=seed_vals)
+                        if S == 1:
+                            ins0 = jnp.zeros((C,), jnp.bool_).at[0].set(can_any)
+                            emit_env = {f"ev_{k}": ev["cols"][k]
+                                        for k in ev["cols"]}
+                            for (q, key, t) in referenced:
+                                if q == 0:
+                                    emit_env[key] = seed_vals[key]
+                            out_mask, out_cols, n_match = emit_rows(
+                                out_mask, out_cols, n_match, ins0, 0, emit_env)
+                        else:
+                            ins_mask = jnp.zeros((C,), jnp.bool_).at[0].set(
+                                can_any)
+                            if states[1].kind == "absent":
+                                seed_vals["arrive_ts"] = jnp.broadcast_to(
+                                    ev_ts, (C,)).astype(jnp.int64)
+                            new1, dropped, inserted = insert(
+                                pend["p1"], ins_mask, seed_vals,
+                                jnp.broadcast_to(ev_ts, (C,)))
+                            pend["p1"] = new1
+                            touched[1] = touched[1] | inserted
+                            drops = drops + dropped.astype(jnp.int64)
+                    if not always_seed or is_and0:
+                        seeds = seeds - can_any.astype(jnp.int64)
+
+                return pend, seeds, drops, n_match, out_mask, out_cols
+
             for s in range(S - 1, -1, -1):
                 st = states[s]
+                if st.kind == "absent":
+                    # expiry ran in the pre-pass; here the forbidden event
+                    # kills still-waiting partials
+                    br = st.branches[0]
+                    g = ev_ok & (ev_tag == br.stream_idx)
+                    env = env_for(s, ev)
+                    p_ = jnp.ones((C,), jnp.bool_) if br.predicate is None \
+                        else jnp.broadcast_to(br.predicate(env), (C,))
+                    cur = pend[f"p{s}"]
+                    kill = cur["valid"] & g & p_
+                    ns = dict(cur)
+                    ns["valid"] = ns["valid"] & ~kill
+                    pend[f"p{s}"] = ns
+                    touched[s] = touched[s] | kill
+                    continue
+                if st.kind == "logical":
+                    (pend, seeds, drops, n_match, out_mask, out_cols) = \
+                        logical_state(s, st, pend, seeds, drops, n_match,
+                                      out_mask, out_cols, touched, ev, ev_ts,
+                                      ev_tag, ev_ok, env_for)
+                    continue
                 gate = ev_ok & (ev_tag == st.stream_idx)
                 # ---- candidate source A: pending[s]
                 slots = pend[f"p{s}"]
@@ -496,7 +833,6 @@ class DeviceNFACompiler:
                             src["first_ts"] > 0, src["first_ts"], ev_ts)
                         if s == S - 1:
                             # emit matches
-                            out_mask = out_mask.at[src_i].set(matched)
                             emit_env = {f"ev_{k}": ev["cols"][k]
                                         for k in ev["cols"]}
                             for (q, key, t) in referenced:
@@ -504,16 +840,17 @@ class DeviceNFACompiler:
                                     emit_env[key] = src[key]
                                 elif q == s:
                                     emit_env[key] = values[key]
-                            for oi, (_, fn, t) in enumerate(out_specs):
-                                val = jnp.broadcast_to(
-                                    fn(emit_env), (C,)).astype(out_cols[oi].dtype)
-                                out_cols[oi] = out_cols[oi].at[src_i].set(
-                                    jnp.where(matched, val, 0))
-                            n_match = n_match + jnp.sum(matched)
+                            out_mask, out_cols, n_match = emit_rows(
+                                out_mask, out_cols, n_match, matched, src_i,
+                                emit_env)
                             n_adv = jnp.sum(matched.astype(jnp.int64))
                         else:
                             # a count target starts with 0 occurrences (its own
-                            # events arrive later via the extension path)
+                            # events arrive later via the extension path); an
+                            # absent target's non-occurrence clock starts now
+                            if states[s + 1].kind == "absent":
+                                values["arrive_ts"] = jnp.broadcast_to(
+                                    ev_ts, (C,)).astype(jnp.int64)
                             new_tgt, dropped, inserted = insert(
                                 pend[f"p{s+1}"], matched, values, first_ts_new,
                                 jnp.zeros((C,), jnp.int32))
@@ -566,18 +903,17 @@ class DeviceNFACompiler:
                     else:
                         if S == 1:
                             # single-state pattern: immediate match
-                            out_mask = out_mask.at[0, 0].set(can_seed)
                             emit_env = {f"ev_{k}": ev["cols"][k] for k in ev["cols"]}
                             for (q, key, t) in referenced:
                                 if q == 0:
                                     emit_env[key] = seed_vals[key]
-                            for oi, (_, fn, t) in enumerate(out_specs):
-                                val = jnp.broadcast_to(
-                                    fn(emit_env), (C,)).astype(out_cols[oi].dtype)
-                                out_cols[oi] = out_cols[oi].at[0].set(
-                                    jnp.where(ins_mask, val, 0))
-                            n_match = n_match + can_seed.astype(jnp.int64)
+                            out_mask, out_cols, n_match = emit_rows(
+                                out_mask, out_cols, n_match, ins_mask, 0,
+                                emit_env)
                         else:
+                            if states[1].kind == "absent":
+                                seed_vals["arrive_ts"] = jnp.broadcast_to(
+                                    ev_ts, (C,)).astype(jnp.int64)
                             new1, dropped, inserted = insert(
                                 pend["p1"], ins_mask, seed_vals,
                                 jnp.broadcast_to(ev_ts, (C,)))

@@ -157,13 +157,176 @@ def test_partitioned_mesh_parity():
 
 
 def test_unsupported_patterns_fall_back():
+    # absent without `for` (followed-by semantics) stays on host
     with pytest.raises(DeviceCompileError):
         DeviceNFARuntime("""
         define stream A (v long); define stream B (v long); define stream C (v long);
-        from e1=A and e2=B -> e3=C select e3.v as v insert into O;
+        from e1=A -> not B -> e3=C select e3.v as v insert into O;
         """)
+    # sibling alias reference inside a logical state (unbound-side semantics)
+    with pytest.raises(DeviceCompileError):
+        DeviceNFARuntime("""
+        define stream A (v long); define stream B (v long); define stream C (v long);
+        from e1=A -> e2=B and e3=C[v > e2.v] select e1.v as v insert into O;
+        """)
+    # pattern starting with absent
     with pytest.raises(DeviceCompileError):
         DeviceNFARuntime("""
         define stream A (v long); define stream B (v long);
-        from e1=A -> not B for 1 sec select e1.v as v insert into O;
+        from not A for 1 sec -> e2=B select e2.v as v insert into O;
         """)
+    # sequences with logical states
+    with pytest.raises(DeviceCompileError):
+        DeviceNFARuntime("""
+        define stream A (v long); define stream B (v long); define stream C (v long);
+        from every e1=A, e2=B and e3=C select e1.v as v insert into O;
+        """)
+
+
+# ---------------------------------------------------------------- logical/absent
+
+APP_AND_CHAIN = """
+define stream A (v long);
+define stream B (v long);
+define stream C (v long);
+from every e1=A[v > 0] -> e2=B[v > 10] and e3=C[v > 20]
+select e1.v as a, e2.v as b, e3.v as c insert into O;
+"""
+
+
+def test_parity_logical_and_mid_chain():
+    evs = [("A", [1], 1000), ("B", [11], 1001), ("C", [21], 1002),
+           ("A", [2], 1003), ("C", [25], 1004), ("B", [15], 1005),
+           ("B", [5], 1006), ("C", [30], 1007)]
+    assert_match_parity(APP_AND_CHAIN, evs)
+
+
+def test_parity_logical_and_randomized():
+    rng = random.Random(21)
+    evs = []
+    for i in range(300):
+        sid = rng.choice(["A", "B", "C"])
+        evs.append((sid, [rng.randrange(40)], 1000 + i))
+    assert_match_parity(APP_AND_CHAIN, evs, slot_capacity=64)
+
+
+def test_parity_logical_or_randomized():
+    app = """
+    define stream A (v long);
+    define stream B (v long);
+    define stream C (v long);
+    from every e1=A[v > 5] -> e2=B[v > 10] or e3=C[v > 20]
+    select e1.v as a insert into O;
+    """
+    rng = random.Random(22)
+    evs = [(rng.choice(["A", "B", "C"]), [rng.randrange(40)], 1000 + i)
+           for i in range(300)]
+    assert_match_parity(app, evs, slot_capacity=64)
+
+
+def test_parity_logical_first_state():
+    # logical at state 0 (AND + OR), seeds consumed correctly without `every`
+    app_and = """
+    define stream A (v long);
+    define stream B (v long);
+    define stream C (v long);
+    from e1=A[v > 0] and e2=B[v > 0] -> e3=C[v > 0]
+    select e1.v as a, e2.v as b, e3.v as c insert into O;
+    """
+    evs = [("B", [7], 1), ("A", [3], 2), ("C", [9], 3), ("C", [4], 4)]
+    assert_match_parity(app_and, evs)
+    app_or = """
+    define stream A (v long);
+    define stream B (v long);
+    define stream C (v long);
+    from every e1=A[v > 0] or e2=B[v > 0] -> e3=C[v > 0]
+    select e3.v as c insert into O;
+    """
+    evs2 = [("B", [7], 1), ("C", [9], 2), ("A", [3], 3), ("C", [4], 4)]
+    assert_match_parity(app_or, evs2)
+
+
+def test_parity_and_not():
+    app = """
+    define stream A (v long);
+    define stream B (v long);
+    define stream C (v long);
+    from every e1=A[v > 0] -> e2=B[v > 10] and not C
+    select e1.v as a, e2.v as b insert into O;
+    """
+    evs = [("A", [1], 1), ("C", [0], 2), ("B", [11], 3),
+           ("A", [2], 4), ("B", [12], 5)]
+    assert_match_parity(app, evs)
+
+
+APP_ABSENT_CHAIN = """
+define stream A (v long);
+define stream B (v long);
+define stream C (v long);
+from every e1=A[v > 0] -> not B for 100 -> e3=C[v > 0]
+select e1.v as a, e3.v as c insert into O;
+"""
+
+
+def test_parity_absent_mid_chain():
+    evs = [("A", [1], 1000), ("B", [9], 1050), ("C", [7], 1200),   # killed
+           ("A", [2], 2000), ("C", [8], 2150),                     # matches
+           ("A", [3], 3000), ("C", [9], 3050)]                     # too early
+    assert_match_parity(APP_ABSENT_CHAIN, evs)
+
+
+def test_parity_absent_randomized():
+    rng = random.Random(23)
+    evs, ts = [], 1000
+    for _ in range(250):
+        ts += rng.choice([10, 30, 60, 150])
+        evs.append((rng.choice(["A", "B", "C"]), [rng.randrange(20)], ts))
+    assert_match_parity(APP_ABSENT_CHAIN, evs, slot_capacity=64)
+
+
+def test_parity_chained_absents():
+    """Review regression: back-to-back absents chain their timers — the second
+    wait starts at the first's expiry, not at the next event arrival."""
+    app = """
+    define stream A (v long);
+    define stream B (v long);
+    define stream C (v long);
+    define stream D (v long);
+    from every e1=A[v > 0] -> not B for 100 -> not C for 50 -> e4=D[v > 0]
+    select e1.v as a, e4.v as d insert into O;
+    """
+    evs = [("A", [1], 1000), ("D", [5], 1300),    # both waits long since done
+           ("A", [2], 2000), ("C", [3], 2120),    # C inside second window
+           ("D", [6], 2300)]
+    assert_match_parity(app, evs)
+
+
+def test_parity_every_and_first_state():
+    """Review regression: `every (A and B)` keeps ONE half-bound seed that
+    rebinds sides — it must not spawn a seed per matching event."""
+    app = """
+    define stream A (v long);
+    define stream B (v long);
+    define stream C (v long);
+    from every (e1=A[v > 0] and e2=B[v > 0]) -> e3=C[v > 0]
+    select e1.v as a, e2.v as b, e3.v as c insert into O;
+    """
+    evs = [("A", [1], 1), ("A", [2], 2), ("B", [3], 3), ("C", [4], 4),
+           ("B", [5], 5), ("A", [6], 6), ("C", [7], 7)]
+    assert_match_parity(app, evs)
+
+
+def test_parity_absent_final():
+    # `A -> not B for t` at the end: emission on the next event past the wait
+    app = """
+    define stream A (v long);
+    define stream B (v long);
+    from every e1=A[v > 0] -> not B for 100
+    select e1.v as a insert into O;
+    """
+    evs = [("A", [1], 1000), ("A", [2], 1200),    # A@1000 established by 1200
+           ("B", [9], 1250),                       # kills A@1200's waiter
+           ("A", [3], 1400)]                       # nothing pending besides new
+    exp = oracle(app, evs)
+    act = device(app, evs)
+    assert sorted(map(tuple, exp)) == sorted(map(tuple, act))
