@@ -99,8 +99,10 @@ def _load():
         return lib
 
 
+# 'd' emits as float32: parse keeps full double precision in the staging
+# cells, but emit narrows to the device policy float (tpu/dtypes.py)
 _TYPE_NP = {
-    "f": np.float32, "d": np.float64, "i": np.int32, "l": np.int64,
+    "f": np.float32, "d": np.float32, "i": np.int32, "l": np.int64,
     "b": np.uint8, "s": np.int32,
 }
 

@@ -269,8 +269,11 @@ int64_t sp_emit_lane(void* h, int32_t lane_idx, void** col_ptrs, int64_t* ts_out
         char t = g->types[c];
         const std::vector<Cell>& src = lane.cols[c];
         switch (t) {
-            case 'd': { double* p = (double*)col_ptrs[c];
-                for (int64_t i = 0; i < n; i++) p[i] = src[i].d; break; }
+            // 'd' narrows to float32 at emit: the device dtype policy
+            // (tpu/dtypes.py) carries DOUBLE as f32, so packing f64 here
+            // would only add a second conversion copy on the Python side
+            case 'd': { float* p = (float*)col_ptrs[c];
+                for (int64_t i = 0; i < n; i++) p[i] = (float)src[i].d; break; }
             case 'f': { float* p = (float*)col_ptrs[c];
                 for (int64_t i = 0; i < n; i++) p[i] = src[i].f; break; }
             case 'l': { int64_t* p = (int64_t*)col_ptrs[c];

@@ -8,7 +8,10 @@ checkpointing is ``device_get`` and multi-chip scaling is ``shard_map`` over a
 
 import jax
 
-# The engine carries aggregate state in float64/int64; enable x64 before use.
+# x64 is enabled ONLY so int64 timestamps/LONG columns are representable
+# (TPU lowers s64 as paired s32 — fine for the compares/adds event time
+# needs). Float compute is pinned to float32 by the dtype policy
+# (``dtypes.py``); no float64 array is ever created on the device path.
 jax.config.update("jax_enable_x64", True)
 
 from .batch import BatchBuilder, BatchSchema, StringDictionary, columns_from_rows

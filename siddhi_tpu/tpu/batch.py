@@ -83,7 +83,8 @@ class BatchSchema:
             raise TypeError(
                 f"attribute '{name}': OBJECT attributes are host-only and cannot "
                 "enter the device path")
-        return np.dtype(t.numpy_dtype)
+        from .dtypes import NP
+        return np.dtype(NP[t])
 
     def encode_value(self, name: str, v: Any):
         t = self.definition.attribute_type(name)

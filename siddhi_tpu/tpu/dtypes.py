@@ -1,0 +1,52 @@
+"""Device dtype policy: TPU-safe representations for every ``DataType``.
+
+TPU (v5e) has no native float64 — f64 HLOs fail to lower or run emulated at
+unusable speed — and the VPU/MXU want f32/bf16. int64 lowers (as paired s32)
+and is cheap for the compare/subtract arithmetic timestamps need. Policy:
+
+- ``DOUBLE``/``FLOAT`` → float32 on device (host interpreter keeps Python
+  float64 semantics; parity tests compare with f32 tolerances).
+- ``INT``/string codes → int32.
+- ``LONG`` and event timestamps → int64 (emulated on TPU; used only for
+  compares, min/max and additions — never in hot elementwise math).
+- Aggregation accumulators (sums/counts) → float32 (``FACC``). Sliding-window
+  sums use cumsum *differences* over bounded buffers, so error stays at
+  O(sqrt(N)·eps·magnitude), well inside the engine's advertised precision.
+
+``jax_enable_x64`` stays on solely so int64 arrays are representable; no
+float64 array is ever created on the device path (reference contrast:
+``io.siddhi.query.api.definition.Attribute.Type`` keeps Java's 8-byte
+long/double everywhere — fine for a JVM, hostile to a TPU).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..query_api.definition import DataType
+
+# device (jnp) representation per declared attribute type
+JNP = {
+    DataType.STRING: jnp.int32,   # dictionary codes
+    DataType.INT: jnp.int32,
+    DataType.LONG: jnp.int64,
+    DataType.FLOAT: jnp.float32,
+    DataType.DOUBLE: jnp.float32,
+    DataType.BOOL: jnp.bool_,
+}
+
+# host staging (numpy) representation — must mirror JNP so device_put never
+# materializes a 64-bit float on device
+NP = {
+    DataType.STRING: np.int32,
+    DataType.INT: np.int32,
+    DataType.LONG: np.int64,
+    DataType.FLOAT: np.float32,
+    DataType.DOUBLE: np.float32,
+    DataType.BOOL: np.bool_,
+}
+
+FACC = jnp.float32        # aggregation accumulator float
+TS = jnp.int64            # event-time representation
+NP_TS = np.int64

@@ -41,6 +41,11 @@ class DeviceCompileError(Exception):
 _NUM_ORDER = [DataType.INT, DataType.LONG, DataType.FLOAT, DataType.DOUBLE]
 
 
+def _policy_dtype(t: DataType):
+    from .dtypes import JNP
+    return JNP[t]
+
+
 def promote(a: DataType, b: DataType) -> DataType:
     if a in _NUM_ORDER and b in _NUM_ORDER:
         return _NUM_ORDER[max(_NUM_ORDER.index(a), _NUM_ORDER.index(b))]
@@ -109,7 +114,12 @@ def compile_expression(expr: Expression, resolver: ColumnResolver
         int_result = rtype in (DataType.INT, DataType.LONG)
 
         def run(cols):
-            a, b = lf(cols), rf(cols)
+            # pin both operands to the policy dtype of the promoted type: JAX
+            # x64 promotion would otherwise materialize float64 for mixed
+            # int64/float32 operands (dtypes.py invariant: no f64 on device)
+            jdt = _policy_dtype(rtype)
+            a = jnp.asarray(lf(cols)).astype(jdt)
+            b = jnp.asarray(rf(cols)).astype(jdt)
             if op == MathOp.ADD:
                 return a + b
             if op == MathOp.SUB:
@@ -122,10 +132,9 @@ def compile_expression(expr: Expression, resolver: ColumnResolver
                     q = jnp.abs(a) // jnp.abs(b)
                     return jnp.where((a >= 0) == (b >= 0), q, -q)
                 return a / b
-            if int_result:
-                return a - b * jnp.trunc(a / b).astype(a.dtype) if a.dtype.kind == 'f' \
-                    else jnp.sign(a) * (jnp.abs(a) % jnp.abs(b))
-            return jnp.sign(a) * jnp.abs(jnp.fmod(a, b)) if False else jnp.fmod(a, b)
+            if int_result:     # operands pinned to an int dtype above
+                return jnp.sign(a) * (jnp.abs(a) % jnp.abs(b))
+            return jnp.fmod(a, b)
 
         return run, rtype
 
@@ -137,6 +146,55 @@ def compile_expression(expr: Expression, resolver: ColumnResolver
         return _compile_function(expr, resolver)
 
     raise DeviceCompileError(f"expression {type(expr).__name__} not device-compilable")
+
+
+_FLIP = {CompareOp.LT: CompareOp.GT, CompareOp.GT: CompareOp.LT,
+         CompareOp.LE: CompareOp.GE, CompareOp.GE: CompareOp.LE,
+         CompareOp.EQ: CompareOp.EQ, CompareOp.NEQ: CompareOp.NEQ}
+
+
+def _fold_int_vs_float_const(col_fn, op: CompareOp, c: float):
+    """``int_col OP float_const`` as an exact int64 comparison.
+
+    For any integer a: a > c ⟺ a ≥ ⌊c⌋+1; a ≥ c ⟺ a ≥ ⌈c⌉; a < c ⟺ a ≤ ⌈c⌉-1;
+    a ≤ c ⟺ a ≤ ⌊c⌋; a == c only possible when c is integral."""
+    import math
+
+    I64_MIN, I64_MAX = -(2 ** 63), 2 ** 63 - 1
+
+    def const_bool(v: bool):
+        return lambda cols: jnp.broadcast_to(
+            jnp.asarray(v), jnp.shape(col_fn(cols)))
+
+    def ge(bound: int):
+        if bound > I64_MAX:
+            return const_bool(False)
+        if bound <= I64_MIN:
+            return const_bool(True)
+        return lambda cols: col_fn(cols) >= bound
+
+    def le(bound: int):
+        if bound >= I64_MAX:
+            return const_bool(True)
+        if bound < I64_MIN:
+            return const_bool(False)
+        return lambda cols: col_fn(cols) <= bound
+
+    if op == CompareOp.GT:
+        return ge(math.floor(c) + 1)
+    if op == CompareOp.GE:
+        return ge(math.ceil(c))
+    if op == CompareOp.LT:
+        return le(math.ceil(c) - 1)
+    if op == CompareOp.LE:
+        return le(math.floor(c))
+    integral = float(c).is_integer() and I64_MIN <= c <= I64_MAX
+    if not integral:
+        return const_bool(op == CompareOp.NEQ)
+    ic = int(c)
+    if op == CompareOp.EQ:
+        return lambda cols: col_fn(cols) == ic
+    return lambda cols: col_fn(cols) != ic
 
 
 def _compile_compare(expr: Compare, resolver: ColumnResolver):
@@ -160,8 +218,30 @@ def _compile_compare(expr: Compare, resolver: ColumnResolver):
         raise DeviceCompileError("string ordering not supported on device")
     op = expr.op
 
+    # int column vs float CONSTANT: fold the constant into an exact int64
+    # bound at compile time — casting the column to f32 would misfire above
+    # 2^24 (f64 is banned on device, so exactness must come from folding)
+    _INTS = (DataType.INT, DataType.LONG)
+    if lt in _INTS and isinstance(expr.right, Constant) \
+            and rt in (DataType.FLOAT, DataType.DOUBLE):
+        return _fold_int_vs_float_const(lf, op, float(expr.right.value)), \
+            DataType.BOOL
+    if rt in _INTS and isinstance(expr.left, Constant) \
+            and lt in (DataType.FLOAT, DataType.DOUBLE):
+        return _fold_int_vs_float_const(
+            rf, _FLIP[op], float(expr.left.value)), DataType.BOOL
+
+    # numeric compares: pin both sides to the promoted policy dtype so mixed
+    # int64/float32 operands never promote to float64 (string codes and bools
+    # already share one dtype per side)
+    cmp_dt = _policy_dtype(promote(lt, rt)) \
+        if lt in _NUM_ORDER and rt in _NUM_ORDER and lt != rt else None
+
     def run(cols):
         a, b = lf(cols), rf(cols)
+        if cmp_dt is not None:
+            a = jnp.asarray(a).astype(cmp_dt)
+            b = jnp.asarray(b).astype(cmp_dt)
         if op == CompareOp.EQ:
             return a == b
         if op == CompareOp.NEQ:
@@ -183,15 +263,21 @@ def _compile_function(expr: AttributeFunction, resolver: ColumnResolver):
         c, _ = compile_expression(expr.args[0], resolver)
         a, ta = compile_expression(expr.args[1], resolver)
         b, tb = compile_expression(expr.args[2], resolver)
-        return (lambda cols: jnp.where(c(cols), a(cols), b(cols))), promote(ta, tb)
+        rt = promote(ta, tb)
+        jdt = _policy_dtype(rt)
+        return (lambda cols: jnp.where(
+            c(cols), jnp.asarray(a(cols)).astype(jdt),
+            jnp.asarray(b(cols)).astype(jdt))), rt
     if name in ("convert", "cast"):
         src, _ = compile_expression(expr.args[0], resolver)
         target = expr.args[1]
         if not isinstance(target, Constant):
             raise DeviceCompileError("convert target must be constant")
-        tmap = {"int": (jnp.int32, DataType.INT), "long": (jnp.int64, DataType.LONG),
-                "float": (jnp.float32, DataType.FLOAT),
-                "double": (jnp.float64, DataType.DOUBLE),
+        from .dtypes import JNP as _J
+        tmap = {"int": (_J[DataType.INT], DataType.INT),
+                "long": (_J[DataType.LONG], DataType.LONG),
+                "float": (_J[DataType.FLOAT], DataType.FLOAT),
+                "double": (_J[DataType.DOUBLE], DataType.DOUBLE),
                 "bool": (jnp.bool_, DataType.BOOL)}
         if str(target.value).lower() not in tmap:
             raise DeviceCompileError(f"convert to {target.value!r} not on device")
@@ -199,12 +285,17 @@ def _compile_function(expr: AttributeFunction, resolver: ColumnResolver):
         return (lambda cols: src(cols).astype(jdt)), dt
     if name == "eventTimestamp" and not expr.args:
         return (lambda cols: cols["__ts__"]), DataType.LONG
-    if name == "maximum":
+    if name in ("maximum", "minimum"):
         fns = [compile_expression(a, resolver) for a in expr.args]
         t = fns[0][1]
-        return (lambda cols: jnp.stack([f(cols) for f, _ in fns]).max(0)), t
-    if name == "minimum":
-        fns = [compile_expression(a, resolver) for a in expr.args]
-        t = fns[0][1]
-        return (lambda cols: jnp.stack([f(cols) for f, _ in fns]).min(0)), t
+        for _, ti in fns[1:]:
+            t = promote(t, ti)
+        jdt = _policy_dtype(t)
+        red = jnp.max if name == "maximum" else jnp.min
+
+        def run(cols, fns=fns, jdt=jdt, red=red):
+            vs = [jnp.asarray(f(cols)).astype(jdt) for f, _ in fns]
+            return red(jnp.stack(jnp.broadcast_arrays(*vs)), axis=0)
+
+        return run, t
     raise DeviceCompileError(f"function '{name}' not device-compilable")

@@ -48,24 +48,8 @@ from ..query_api import (
 )
 from ..query_api.definition import DataType, StreamDefinition
 from .batch import StringDictionary
+from .dtypes import JNP as _JNP, NP as _NP
 from .expr_compile import DeviceCompileError, compile_expression
-
-_JNP = {
-    DataType.STRING: jnp.int32,
-    DataType.INT: jnp.int32,
-    DataType.LONG: jnp.int64,
-    DataType.FLOAT: jnp.float32,
-    DataType.DOUBLE: jnp.float64,
-    DataType.BOOL: jnp.bool_,
-}
-_NP = {
-    DataType.STRING: np.int32,
-    DataType.INT: np.int32,
-    DataType.LONG: np.int64,
-    DataType.FLOAT: np.float32,
-    DataType.DOUBLE: np.float64,
-    DataType.BOOL: np.bool_,
-}
 
 
 # ---------------------------------------------------------------------------

@@ -17,6 +17,13 @@ The TPU-native replacement for the hot path the reference interprets per event
   (MXU-friendly) with a carried dense per-key state [K].
 - Masked events (filter rejections, padding) are *compacted* with a stable
   scatter so window semantics see only accepted events.
+
+Numeric policy (dtypes.py): integer-argument aggregates (count, sum/avg over
+INT/LONG) accumulate in **int64** — exact, like the reference's Java longs
+(``SumAttributeAggregatorExecutor``'s long branch) — while float aggregates
+accumulate in float32 with **Kahan compensation** on the carried cross-batch
+bases (windowed sums recompute from raw tails each batch, so only the
+unbounded running/group-by bases can compound error).
 """
 
 from __future__ import annotations
@@ -38,6 +45,7 @@ from ..query_api import (
 )
 from ..query_api.definition import DataType, StreamDefinition
 from .batch import BatchSchema
+from .dtypes import FACC, JNP as _JNP_DTYPES
 from .expr_compile import ColumnResolver, DeviceCompileError, compile_expression
 
 _INVERTIBLE_AGGS = {"sum", "count", "avg"}
@@ -47,14 +55,7 @@ _INVERTIBLE_AGGS = {"sum", "count", "avg"}
 _TS_NEG = -(2 ** 62)
 _TS_POS = 2 ** 62
 
-_JNP_DTYPES = {
-    DataType.STRING: jnp.int32,
-    DataType.INT: jnp.int32,
-    DataType.LONG: jnp.int64,
-    DataType.FLOAT: jnp.float32,
-    DataType.DOUBLE: jnp.float64,
-    DataType.BOOL: jnp.bool_,
-}
+_IACC = jnp.int64        # exact integer accumulator
 
 
 @dataclass
@@ -64,6 +65,14 @@ class _Spec:
     fn: Optional[Callable] = None      # projection or aggregate-arg program
     dtype: DataType = DataType.DOUBLE
     source_attr: Optional[str] = None  # raw column name for string decode
+    acc_int: bool = False              # accumulate exactly in int64
+
+
+def _kahan_add(base, comp, add):
+    """One compensated accumulation step: returns (new_base, new_comp)."""
+    y = add - comp
+    t = base + y
+    return t, (t - base) - y
 
 
 class CompiledStreamQuery:
@@ -168,14 +177,17 @@ class CompiledStreamQuery:
                 arg_fn, at = (None, DataType.LONG)
                 if e.args:
                     arg_fn, at = compile_expression(e.args[0], resolver)
+                elif e.name != "count":
+                    raise DeviceCompileError(f"{e.name}() needs an argument")
+                int_arg = at in (DataType.INT, DataType.LONG)
                 if e.name == "count":
                     dt = DataType.LONG
                 elif e.name == "avg":
                     dt = DataType.DOUBLE
                 else:
-                    dt = DataType.LONG if at in (DataType.INT, DataType.LONG) \
-                        else DataType.DOUBLE
-                self.specs.append(_Spec(oa.name, e.name, arg_fn, dt))
+                    dt = DataType.LONG if int_arg else DataType.DOUBLE
+                self.specs.append(_Spec(oa.name, e.name, arg_fn, dt,
+                                        acc_int=int_arg and e.name != "count"))
             else:
                 fn, t = compile_expression(e, resolver)
                 src = e.attribute if isinstance(e, Variable) and t == DataType.STRING \
@@ -183,17 +195,24 @@ class CompiledStreamQuery:
                 self.specs.append(_Spec(oa.name, "value", fn, t, src))
 
         self.value_idx = [i for i, s in enumerate(self.specs) if s.kind == "value"]
+        # aggregate lanes: counts ride the ones/cnts axis; sums/avgs split into
+        # an exact-int stack and a float stack
+        self.iagg_idx = [i for i, s in enumerate(self.specs)
+                         if s.kind in ("sum", "avg") and s.acc_int]
+        self.fagg_idx = [i for i, s in enumerate(self.specs)
+                        if s.kind in ("sum", "avg") and not s.acc_int]
         self.agg_idx = [i for i, s in enumerate(self.specs) if s.kind != "value"]
         self._step = jax.jit(self._make_step(), donate_argnums=(0,))
 
     # ------------------------------------------------------------------ state
     def init_state(self) -> dict:
         N = max(self.window_n, 1)
-        A = len(self.agg_idx)
+        AF, AI = len(self.fagg_idx), len(self.iagg_idx)
         state: dict[str, Any] = {}
         if self.window_kind in ("length", "lengthBatch", "time"):
-            state["tail_vals"] = jnp.zeros((A, N), dtype=jnp.float64)
-            state["tail_ones"] = jnp.zeros((N,), dtype=jnp.float64)
+            state["tail_fvals"] = jnp.zeros((AF, N), dtype=FACC)
+            state["tail_ivals"] = jnp.zeros((AI, N), dtype=_IACC)
+            state["tail_ones"] = jnp.zeros((N,), dtype=jnp.int32)
         if self.window_kind == "time":
             # sentinel = long-expired; keeps the concat ts array sorted
             state["tail_ts"] = jnp.full((N,), _TS_NEG, dtype=jnp.int64)
@@ -207,11 +226,15 @@ class CompiledStreamQuery:
                 state[f"rem_proj_{i}"] = jnp.zeros(
                     (N,), dtype=_JNP_DTYPES[self.specs[i].dtype])
         if self.group_key is not None:
-            state["key_sums"] = jnp.zeros((A, self.K), dtype=jnp.float64)
-            state["key_counts"] = jnp.zeros((self.K,), dtype=jnp.float64)
+            state["key_fsums"] = jnp.zeros((AF, self.K), dtype=FACC)
+            state["key_fcomp"] = jnp.zeros((AF, self.K), dtype=FACC)
+            state["key_isums"] = jnp.zeros((AI, self.K), dtype=_IACC)
+            state["key_counts"] = jnp.zeros((self.K,), dtype=jnp.int64)
         if self.window_kind is None and self.group_key is None:
-            state["run_sums"] = jnp.zeros((A,), dtype=jnp.float64)
-            state["run_count"] = jnp.zeros((), dtype=jnp.float64)
+            state["run_fsums"] = jnp.zeros((AF,), dtype=FACC)
+            state["run_fcomp"] = jnp.zeros((AF,), dtype=FACC)
+            state["run_isums"] = jnp.zeros((AI,), dtype=_IACC)
+            state["run_count"] = jnp.zeros((), dtype=jnp.int64)
         return state
 
     # ------------------------------------------------------------------- step
@@ -219,7 +242,8 @@ class CompiledStreamQuery:
         B = self.B
         filter_fns = list(self.filter_fns)
         specs = self.specs
-        value_idx, agg_idx = self.value_idx, self.agg_idx
+        value_idx = self.value_idx
+        fagg_idx, iagg_idx = self.fagg_idx, self.iagg_idx
         window_kind, N = self.window_kind, max(self.window_n, 1)
         window_ms, time_key = self.window_ms, self.time_key
         group_key = self.group_key
@@ -246,75 +270,87 @@ class CompiledStreamQuery:
 
             cts = compact(ts)
             proj_c = {i: compact(specs[i].fn(cols)) for i in value_idx}
-            agg_c = []
-            for i in agg_idx:
-                s = specs[i]
-                v = jnp.ones((B,), jnp.float64) if s.fn is None \
-                    else s.fn(cols).astype(jnp.float64)
-                agg_c.append(compact(jnp.where(mask, v, 0.0)))
-            A = len(agg_c)
-            av = jnp.stack(agg_c) if A else jnp.zeros((0, B), jnp.float64)
-            ones_c = compact(jnp.where(mask, 1.0, 0.0))
+
+            def agg_stack(idx, dt):
+                rows = []
+                for i in idx:
+                    v = specs[i].fn(cols).astype(dt)
+                    rows.append(compact(jnp.where(mask, v, jnp.zeros((), dt))))
+                return jnp.stack(rows) if rows else jnp.zeros((0, B), dt)
+
+            av_f = agg_stack(fagg_idx, FACC)
+            av_i = agg_stack(iagg_idx, _IACC)
+            ones_c = compact(mask.astype(jnp.int32))
+            out_valid = jnp.arange(B) < k
+
+            def finish(state, sums_f, sums_i, cnts, ovalid=out_valid, ots=cts,
+                       proj=proj_c, count=None):
+                out = _materialize(specs, value_idx, fagg_idx, iagg_idx, proj,
+                                   sums_f, sums_i, cnts)
+                return state, {"out": out, "valid": ovalid, "ts": ots,
+                               "count": k if count is None else count}
 
             if window_kind == "length":
-                state, sums, cnts = _length_window(state, av, ones_c, k, N, B)
-                out, out_valid = _materialize(
-                    specs, value_idx, agg_idx, proj_c, sums, cnts,
-                    jnp.arange(B) < k)
-                return state, {"out": out, "valid": out_valid, "ts": cts,
-                               "count": k}
+                state, sums_f, sums_i, cnts = _length_window(
+                    state, av_f, av_i, ones_c, k, N, B)
+                return finish(state, sums_f, sums_i, cnts)
 
             if window_kind == "lengthBatch":
-                return _length_batch(state, specs, value_idx, agg_idx, proj_c,
-                                     av, ones_c, cts, k, N, B)
+                return _length_batch(state, specs, value_idx, fagg_idx,
+                                     iagg_idx, proj_c, av_f, av_i, ones_c,
+                                     cts, k, N, B)
 
             if window_kind == "time":
                 wts = compact(cols[time_key].astype(jnp.int64)) if time_key \
                     else cts
-                state, sums, cnts = _time_window(
-                    state, av, ones_c, wts, k, N, B, window_ms)
-                out, out_valid = _materialize(
-                    specs, value_idx, agg_idx, proj_c, sums, cnts,
-                    jnp.arange(B) < k)
-                return state, {"out": out, "valid": out_valid, "ts": cts,
-                               "count": k}
+                state, sums_f, sums_i, cnts = _time_window(
+                    state, av_f, av_i, ones_c, wts, k, N, B, window_ms)
+                return finish(state, sums_f, sums_i, cnts)
 
             if group_key is not None:
                 keys = compact(cols[group_key].astype(jnp.int32)) % K
-                out_valid = jnp.arange(B) < k
-                onehot = jax.nn.one_hot(keys, K, dtype=jnp.float64) \
-                    * out_valid[:, None]                                   # [B,K]
-                if A:
-                    contrib = onehot[None] * av[:, :, None]                # [A,B,K]
+                onehot = (jax.nn.one_hot(keys, K, dtype=jnp.int32)
+                          * out_valid[:, None].astype(jnp.int32))     # [B,K]
+
+                def per_key(av, base, dt):
+                    contrib = onehot[None].astype(dt) * av[:, :, None]  # [A,B,K]
                     ccum = jnp.cumsum(contrib, axis=1)
-                    base = state["key_sums"][:, keys]                      # [A,B]
-                    sums = jnp.take_along_axis(
-                        ccum, keys[None, :, None], axis=2)[:, :, 0] + base
-                    new_key_sums = state["key_sums"] + contrib.sum(axis=1)
-                else:
-                    sums = jnp.zeros((0, B))
-                    new_key_sums = state["key_sums"]
+                    per_ev = jnp.take_along_axis(
+                        ccum, keys[None, :, None], axis=2)[:, :, 0] \
+                        + base[:, keys]
+                    return per_ev, contrib.sum(axis=1)
+
+                sums_f, add_f = per_key(av_f, state["key_fsums"], FACC) \
+                    if len(fagg_idx) else (jnp.zeros((0, B), FACC),
+                                           jnp.zeros((0, K), FACC))
+                sums_i, add_i = per_key(av_i, state["key_isums"], _IACC) \
+                    if len(iagg_idx) else (jnp.zeros((0, B), _IACC),
+                                           jnp.zeros((0, K), _IACC))
                 ocum = jnp.cumsum(onehot, axis=0)
-                cnts = jnp.take_along_axis(ocum, keys[:, None], axis=1)[:, 0] \
-                    + state["key_counts"][keys]
-                state = {**state, "key_sums": new_key_sums,
-                         "key_counts": state["key_counts"] + onehot.sum(axis=0)}
-                out, out_valid = _materialize(
-                    specs, value_idx, agg_idx, proj_c, sums, cnts, out_valid)
-                return state, {"out": out, "valid": out_valid, "ts": cts,
-                               "count": k}
+                cnts = (jnp.take_along_axis(ocum, keys[:, None], axis=1)[:, 0]
+                        .astype(jnp.int64) + state["key_counts"][keys])
+                nf, nc = _kahan_add(state["key_fsums"], state["key_fcomp"],
+                                    add_f)
+                state = {**state, "key_fsums": nf, "key_fcomp": nc,
+                         "key_isums": state["key_isums"] + add_i,
+                         "key_counts": state["key_counts"]
+                         + onehot.sum(axis=0).astype(jnp.int64)}
+                return finish(state, sums_f, sums_i, cnts)
 
             # running aggregates, no window/grouping
-            cs = jnp.cumsum(av, axis=1) if A else jnp.zeros((0, B))
-            cso = jnp.cumsum(ones_c)
-            sums = cs + state["run_sums"][:, None] if A else cs
+            cs_f = jnp.cumsum(av_f, axis=1)
+            cs_i = jnp.cumsum(av_i, axis=1)
+            cso = jnp.cumsum(ones_c).astype(jnp.int64)
+            sums_f = cs_f + state["run_fsums"][:, None]
+            sums_i = cs_i + state["run_isums"][:, None]
             cnts = cso + state["run_count"]
-            state = {**state,
-                     "run_sums": state["run_sums"] + (av.sum(axis=1) if A else 0.0),
-                     "run_count": state["run_count"] + ones_c.sum()}
-            out, out_valid = _materialize(
-                specs, value_idx, agg_idx, proj_c, sums, cnts, jnp.arange(B) < k)
-            return state, {"out": out, "valid": out_valid, "ts": cts, "count": k}
+            nf, nc = _kahan_add(state["run_fsums"], state["run_fcomp"],
+                                av_f.sum(axis=1))
+            state = {**state, "run_fsums": nf, "run_fcomp": nc,
+                     "run_isums": state["run_isums"] + av_i.sum(axis=1),
+                     "run_count": state["run_count"]
+                     + ones_c.sum().astype(jnp.int64)}
+            return finish(state, sums_f, sums_i, cnts)
 
         return step
 
@@ -342,35 +378,45 @@ class CompiledStreamQuery:
 # window kernels
 # ---------------------------------------------------------------------------
 
-def _length_window(state, av, ones_c, k, N, B):
+def _slide_tails(state, z_f, z_i, zo, k, N):
+    """Keep the last-N accepted entries (values + ones) as the new tails."""
+    take = lambda row: jax.lax.dynamic_slice(row, (k,), (N,))
+    return {
+        **state,
+        "tail_fvals": jax.vmap(take)(z_f) if z_f.shape[0] else state["tail_fvals"],
+        "tail_ivals": jax.vmap(take)(z_i) if z_i.shape[0] else state["tail_ivals"],
+        "tail_ones": take(zo),
+    }
+
+
+def _window_sums(z, j, N):
+    """Trailing-N sums at positions ``j`` of the [A, N+B] value axis."""
+    if not z.shape[0]:
+        return jnp.zeros((0, j.shape[0]), z.dtype)
+    cs = jnp.cumsum(z, axis=1)
+    return cs[:, j] - cs[:, j - N]
+
+
+def _length_window(state, av_f, av_i, ones_c, k, N, B):
     """Sliding window sums via tail-buffer + cumsum differences."""
-    A = av.shape[0]
-    z = jnp.concatenate([state["tail_vals"], av], axis=1)          # [A, N+B]
+    z_f = jnp.concatenate([state["tail_fvals"], av_f], axis=1)     # [AF, N+B]
+    z_i = jnp.concatenate([state["tail_ivals"], av_i], axis=1)     # [AI, N+B]
     zo = jnp.concatenate([state["tail_ones"], ones_c])             # [N+B]
     j = jnp.arange(B) + N
-    if A:
-        cs = jnp.cumsum(z, axis=1)
-        sums = cs[:, j] - cs[:, j - N]
-        new_tail_v = jax.vmap(
-            lambda row: jax.lax.dynamic_slice(row, (k,), (N,)))(z)
-    else:
-        sums = jnp.zeros((0, B))
-        new_tail_v = state["tail_vals"]
+    sums_f = _window_sums(z_f, j, N)
+    sums_i = _window_sums(z_i, j, N)
     cso = jnp.cumsum(zo)
-    cnts = cso[j] - cso[j - N]
-    new_tail_o = jax.lax.dynamic_slice(zo, (k,), (N,))
-    return ({**state, "tail_vals": new_tail_v, "tail_ones": new_tail_o},
-            sums, cnts)
+    cnts = (cso[j] - cso[j - N]).astype(jnp.int64)
+    return _slide_tails(state, z_f, z_i, zo, k, N), sums_f, sums_i, cnts
 
 
-def _time_window(state, av, ones_c, wts, k, N, B, D):
+def _time_window(state, av_f, av_i, ones_c, wts, k, N, B, D):
     """Sliding event-time window: per-event aggregates over events with
     ``ts > now - D`` via searchsorted on the (sorted) tail+batch timestamp
     axis + leading-zero cumsum differences. Requires non-decreasing event
     time (the watermark ingress guarantees it). Fixed tail capacity N; events
     evicted while still alive are counted in ``window_drops`` (explicit
     bounded-state overflow policy, SURVEY §7 hard part 1)."""
-    A = av.shape[0]
     valid = jnp.arange(B) < k
     # searchsorted needs a sorted ts axis: clamp regressions to the running
     # max (the event is treated as arriving "now") and count them — loud,
@@ -380,49 +426,49 @@ def _time_window(state, av, ones_c, wts, k, N, B, D):
     regressed = jnp.sum(jnp.where(valid & (raw < mono), 1, 0)).astype(jnp.int64)
     # padding slots (>= k) get +sentinel ts so the concat stays sorted
     wts_s = jnp.where(valid, mono, _TS_POS)
-    z = jnp.concatenate([state["tail_vals"], av], axis=1)          # [A, N+B]
+    z_f = jnp.concatenate([state["tail_fvals"], av_f], axis=1)     # [AF, N+B]
+    z_i = jnp.concatenate([state["tail_ivals"], av_i], axis=1)     # [AI, N+B]
     zo = jnp.concatenate([state["tail_ones"], ones_c])             # [N+B]
     zts = jnp.concatenate([state["tail_ts"], wts_s])               # [N+B]
 
     j = jnp.arange(B) + N
     lo = jnp.searchsorted(zts, wts_s - D, side="right")            # [B]
-    cso = jnp.concatenate([jnp.zeros((1,)), jnp.cumsum(zo)])
-    cnts = cso[j + 1] - cso[lo]
-    if A:
-        csz = jnp.concatenate([jnp.zeros((A, 1)), jnp.cumsum(z, axis=1)], axis=1)
-        sums = csz[:, j + 1] - csz[:, lo]
-        new_tail_v = jax.vmap(
-            lambda row: jax.lax.dynamic_slice(row, (k,), (N,)))(z)
-    else:
-        sums = jnp.zeros((0, B))
-        new_tail_v = state["tail_vals"]
+
+    def lead_sums(z):
+        if not z.shape[0]:
+            return jnp.zeros((0, B), z.dtype)
+        cs = jnp.concatenate(
+            [jnp.zeros((z.shape[0], 1), z.dtype), jnp.cumsum(z, axis=1)], axis=1)
+        return cs[:, j + 1] - cs[:, lo]
+
+    sums_f = lead_sums(z_f)
+    sums_i = lead_sums(z_i)
+    cso = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(zo)])
+    cnts = (cso[j + 1] - cso[lo]).astype(jnp.int64)
 
     # overflow: entries sliced off the front that were still alive w.r.t. the
     # newest event's clock
     newest = zts[jnp.maximum(N + k - 1, 0)]
     sliced = jnp.arange(N + B) < k
-    drops = jnp.sum(jnp.where(sliced & (zts > newest - D), zo, 0.0)
+    drops = jnp.sum(jnp.where(sliced & (zts > newest - D), zo, 0)
                     ).astype(jnp.int64)
 
-    new_state = {
-        **state,
-        "tail_vals": new_tail_v,
-        "tail_ones": jax.lax.dynamic_slice(zo, (k,), (N,)),
+    new_state = _slide_tails(state, z_f, z_i, zo, k, N)
+    new_state.update({
         "tail_ts": jax.lax.dynamic_slice(zts, (k,), (N,)),
         "window_drops": state["window_drops"] + drops,
         "last_ts": jnp.maximum(state["last_ts"],
                                jnp.where(k > 0, mono[jnp.maximum(k - 1, 0)],
                                          state["last_ts"])),
         "ts_regressions": state["ts_regressions"] + regressed,
-    }
-    return new_state, sums, cnts
+    })
+    return new_state, sums_f, sums_i, cnts
 
 
-def _length_batch(state, specs, value_idx, agg_idx, proj_c, av, ones_c, cts,
-                  k, N, B):
+def _length_batch(state, specs, value_idx, fagg_idx, iagg_idx, proj_c,
+                  av_f, av_i, ones_c, cts, k, N, B):
     """Tumbling window: carried remainder (projections + agg args), outputs over
     [N+B] slots covering remainder + current arrivals."""
-    A = av.shape[0]
     r = state["rem_count"]
     M = N + B
     total = r + k
@@ -437,20 +483,28 @@ def _length_batch(state, specs, value_idx, agg_idx, proj_c, av, ones_c, cts,
         return out.at[zpos].set(jnp.where(zm, x, jnp.zeros((), x.dtype)),
                                 mode="drop")
 
-    z = jax.vmap(lambda rr, bb: zc(rr, bb))(state["tail_vals"], av) if A \
-        else jnp.zeros((0, M))
+    z_f = jax.vmap(zc)(state["tail_fvals"], av_f) if len(fagg_idx) \
+        else jnp.zeros((0, M), FACC)
+    z_i = jax.vmap(zc)(state["tail_ivals"], av_i) if len(iagg_idx) \
+        else jnp.zeros((0, M), _IACC)
     zts = zc(state["rem_ts"], cts)
     zproj = {i: zc(state[f"rem_proj_{i}"], proj_c[i]) for i in value_idx}
 
     j2 = jnp.arange(M)
     batch_start = (j2 // N) * N
-    if A:
+
+    def batch_sums(z):
+        if not z.shape[0]:
+            return jnp.zeros((0, M), z.dtype)
         cs = jnp.cumsum(z, axis=1)
-        start_cs = jnp.where(batch_start > 0, cs[:, jnp.maximum(batch_start - 1, 0)], 0.0)
-        sums = cs[:, j2] - start_cs
-    else:
-        sums = jnp.zeros((0, M))
-    cnts = (j2 % N + 1).astype(jnp.float64)
+        start_cs = jnp.where(batch_start > 0,
+                             cs[:, jnp.maximum(batch_start - 1, 0)],
+                             jnp.zeros((), z.dtype))
+        return cs - start_cs
+
+    sums_f = batch_sums(z_f)
+    sums_i = batch_sums(z_i)
+    cnts = (j2 % N + 1).astype(jnp.int64)
 
     full_batches = total // N
     out_valid = (j2 < full_batches * N) & (j2 < total)
@@ -460,37 +514,46 @@ def _length_batch(state, specs, value_idx, agg_idx, proj_c, av, ones_c, cts,
         return jax.lax.dynamic_slice(row, (full_batches * N,), (N,))
     keep = jnp.arange(N) < rem_n
     new_state = {**state, "rem_count": rem_n.astype(jnp.int32)}
-    new_state["tail_vals"] = jnp.where(
-        keep[None, :], jax.vmap(rem_slice)(z), 0.0) if A else state["tail_vals"]
+    new_state["tail_fvals"] = jnp.where(
+        keep[None, :], jax.vmap(rem_slice)(z_f), 0.0) if len(fagg_idx) \
+        else state["tail_fvals"]
+    new_state["tail_ivals"] = jnp.where(
+        keep[None, :], jax.vmap(rem_slice)(z_i), 0) if len(iagg_idx) \
+        else state["tail_ivals"]
     new_state["tail_ones"] = jnp.where(keep, rem_slice(
-        jnp.concatenate([jnp.where(jnp.arange(N) < r, state["tail_ones"], 0.0),
-                         ones_c])), 0.0)
+        jnp.concatenate([jnp.where(jnp.arange(N) < r, state["tail_ones"], 0),
+                         ones_c])), 0)
     new_state["rem_ts"] = jnp.where(keep, rem_slice(zts), 0)
     for i in value_idx:
-        z_i = zproj[i]
+        z_p = zproj[i]
         new_state[f"rem_proj_{i}"] = jnp.where(
-            keep, rem_slice(z_i), jnp.zeros((), z_i.dtype))
+            keep, rem_slice(z_p), jnp.zeros((), z_p.dtype))
 
-    out, out_valid = _materialize(specs, value_idx, agg_idx, zproj, sums, cnts,
-                                  out_valid)
+    out = _materialize(specs, value_idx, fagg_idx, iagg_idx, zproj,
+                       sums_f, sums_i, cnts)
     return new_state, {"out": out, "valid": out_valid, "ts": zts,
                        "count": full_batches * N}
 
 
-def _materialize(specs, value_idx, agg_idx, proj, sums, cnts, out_valid):
+def _materialize(specs, value_idx, fagg_idx, iagg_idx, proj,
+                 sums_f, sums_i, cnts):
     outputs = {}
-    for vi, i in enumerate(value_idx):
+    for i in value_idx:
         outputs[specs[i].name] = proj[i]
-    for ai, i in enumerate(agg_idx):
-        s = specs[i]
-        if s.kind == "sum":
-            v = sums[ai]
-            outputs[s.name] = v.astype(jnp.int64) if s.dtype == DataType.LONG else v
-        elif s.kind == "count":
-            outputs[s.name] = cnts.astype(jnp.int64)
-        else:  # avg
-            outputs[s.name] = sums[ai] / jnp.maximum(cnts, 1.0)
-    return outputs, out_valid
+    fpos = {i: p for p, i in enumerate(fagg_idx)}
+    ipos = {i: p for p, i in enumerate(iagg_idx)}
+    for i, s in enumerate(specs):
+        if s.kind == "value":
+            continue
+        if s.kind == "count":
+            outputs[s.name] = cnts
+        elif s.kind == "sum":
+            outputs[s.name] = sums_i[ipos[i]] if s.acc_int else sums_f[fpos[i]]
+        else:  # avg (always emitted as double → policy float)
+            num = sums_i[ipos[i]].astype(FACC) if s.acc_int \
+                else sums_f[fpos[i]]
+            outputs[s.name] = num / jnp.maximum(cnts, 1).astype(FACC)
+    return outputs
 
 
 def _pyval(v, dtype: DataType):

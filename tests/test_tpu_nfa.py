@@ -44,10 +44,8 @@ def device(app, events, slot_capacity=32, batch_capacity=64):
 
 
 def assert_match_parity(app, events, **kw):
-    exp = sorted(map(tuple, oracle(app, events)))
-    act = sorted(map(tuple, device(app, events, **kw)))
-    assert exp == act, f"oracle={exp[:5]}... device={act[:5]}... " \
-                       f"(n={len(exp)} vs {len(act)})"
+    from util_parity import assert_rows_match
+    assert_rows_match(oracle(app, events), device(app, events, **kw))
 
 
 APP_2STREAM = """

@@ -8,6 +8,7 @@ import pytest
 
 from siddhi_tpu import SiddhiManager, StreamCallback
 from siddhi_tpu.tpu import DeviceCompileError, DeviceStreamRuntime
+from util_parity import rows_equal
 
 
 def interpreter_run(app, rows, stream="S", out="O"):
@@ -38,12 +39,7 @@ def assert_parity(app, rows, batch_capacity=64):
     actual = device_run(app, rows, batch_capacity)
     assert len(expected) == len(actual), (len(expected), len(actual))
     for e, a in zip(expected, actual):
-        assert len(e) == len(a)
-        for x, y in zip(e, a):
-            if isinstance(x, float) or isinstance(y, float):
-                assert y == pytest.approx(x, rel=1e-9), (e, a)
-            else:
-                assert x == y, (e, a)
+        assert rows_equal(e, a), (e, a)
 
 
 APP_FILTER_WINDOW = """
@@ -126,11 +122,7 @@ def _parity_with_ts(app, rows, tss, batch_capacity=64):
 
     assert len(expected) == len(actual), (len(expected), len(actual))
     for e, a in zip(expected, actual):
-        for x, y in zip(e, a):
-            if isinstance(x, float) or isinstance(y, float):
-                assert y == pytest.approx(x, rel=1e-9), (e, a)
-            else:
-                assert x == y, (e, a)
+        assert rows_equal(e, a), (e, a)
 
 
 def _bursty_ts(n, seed, max_gap=40):
@@ -281,3 +273,28 @@ from S select v, v + 1 as w insert into T;
         h.send([i], timestamp=1000 + i)
     rows = sorted(e.data for e in rt.query("from T select v, w"))
     assert rows == [[0, 1], [1, 2], [2, 3], [3, 4]]
+
+
+def test_long_vs_float_constant_compare_exact():
+    """int64 column vs float constant folds to an exact int bound — casting to
+    f32 would round 2^24+1 down and misfire (dtype-policy regression test)."""
+    app = """
+    define stream S (v long);
+    from S[v > 16777216.5] select v insert into O;
+    """
+    big = 16777217          # 2^24 + 1: not representable in float32
+    rows = [[16777215], [16777216], [big], [16777218]]
+    expected = interpreter_run(app, rows)
+    actual = device_run(app, rows)
+    assert [r[0] for r in expected] == [big, 16777218]
+    assert [r[0] for r in actual] == [big, 16777218]
+
+
+def test_argless_sum_rejected_on_device():
+    import pytest as _pytest
+    from siddhi_tpu.tpu import DeviceCompileError as _DCE
+    with _pytest.raises(_DCE):
+        DeviceStreamRuntime("""
+        define stream S (v long);
+        from S select sum() as t insert into O;
+        """)
