@@ -2,21 +2,34 @@
 
 Workload: 8-state rising-chain pattern (``every e1 -> e2[v>e1.v] -> ... -> e8``,
 ``within``) over a synthetic IoT stream, 64-way partitioned — BASELINE.json
-configs #3/#5 shape. Measures steady-state device throughput (events/sec) of the
-compiled, partitioned NFA and compares against the host interpreter running the
-identical app on the same machine (the stand-in for CPU siddhi-core: the
-reference publishes no numbers — see BASELINE.md — and no JVM is available here,
-so the baseline is measured, single-threaded, same-semantics CPU execution).
+configs #3/#5 shape. Reports:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+- steady-state device throughput (events/sec) of the compiled, partitioned NFA;
+- **p99 detection latency** at an offered arrival rate (events get scheduled
+  arrival times at ``BENCH_OFFERED_EVPS``; a batch is released when its last
+  event has arrived; per-event latency = batch completion − scheduled arrival);
+- the same app on the host interpreter as the CPU baseline. The baseline is
+  this repo's own single-threaded Python interpreter (the reference publishes
+  no numbers — BASELINE.md — and no JVM exists in this image), so
+  ``vs_baseline`` flatters the device vs a real JVM; the JSON says so.
+
+Robustness (VERDICT round 1 item 1b): the TPU tunnel can hang PJRT init
+indefinitely, so this process never imports jax. All device/host work runs in
+subprocesses with hard deadlines; the final JSON line is emitted no matter
+what, with ``device_ok``/``error`` flags instead of a stack trace as the
+round's recorded result.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
 N_STATES = int(os.environ.get("BENCH_STATES", 8))
 N_PARTITIONS = int(os.environ.get("BENCH_PARTITIONS", 64))
@@ -25,6 +38,10 @@ SLOT_CAP = int(os.environ.get("BENCH_SLOT_CAP", 64))
 N_DEVICES_KEYS = 256          # distinct device ids in the synthetic stream
 DEVICE_EVENTS = int(os.environ.get("BENCH_EVENTS", 1_000_000))
 BASELINE_EVENTS = int(os.environ.get("BENCH_BASELINE_EVENTS", 20_000))
+OFFERED_EVPS = int(os.environ.get("BENCH_OFFERED_EVPS", 1_000_000))
+DEVICE_DEADLINE_S = int(os.environ.get("BENCH_DEVICE_DEADLINE_S", 1500))
+HOST_DEADLINE_S = int(os.environ.get("BENCH_HOST_DEADLINE_S", 600))
+PROBE_DEADLINE_S = int(os.environ.get("BENCH_PROBE_DEADLINE_S", 420))
 
 
 def make_app() -> str:
@@ -61,21 +78,59 @@ def gen_events(n: int, seed: int = 42):
     return out
 
 
-def bench_device(events) -> float:
-    import jax
+def _envelope_percentile(envelopes, q: float) -> float:
+    """Population quantile from per-batch latency envelopes.
+
+    Each batch contributes ``n`` events whose latencies are ~uniform on
+    [lo, hi]; interpolate each envelope at evenly spaced points weighted by
+    its population share, then take the weighted quantile."""
     import numpy as np
+
+    samples, weights = [], []
+    for lo, hi, n in envelopes:
+        pts = min(max(int(n), 1), 64)
+        xs = np.linspace(lo, hi, pts)
+        samples.append(xs)
+        weights.append(np.full(pts, n / pts))
+    s = np.concatenate(samples)
+    w = np.concatenate(weights)
+    order = np.argsort(s)
+    s, w = s[order], w[order]
+    cw = np.cumsum(w)
+    return float(s[np.searchsorted(cw, q * cw[-1], side="left")])
+
+
+# ---------------------------------------------------------------------------
+# child: device benchmark (runs under the axon/TPU backend)
+# ---------------------------------------------------------------------------
+
+def child_probe() -> None:
+    import jax
+
+    dev = jax.devices()[0]
+    import jax.numpy as jnp
+    y = (jnp.ones((256, 256), jnp.float32) @ jnp.ones((256, 256), jnp.float32))
+    y.block_until_ready()
+    print(json.dumps({"platform": jax.default_backend(),
+                      "device": str(dev)}))
+
+
+def child_device() -> None:
+    import numpy as np
+    import jax
 
     from siddhi_tpu.tpu.partition import PartitionedNFARuntime
 
+    events = gen_events(DEVICE_EVENTS)
     rt = PartitionedNFARuntime(
         make_app(), num_partitions=N_PARTITIONS, key_attr="dev",
         slot_capacity=SLOT_CAP, lane_batch=LANE_BATCH, mesh=None)
 
-    # pre-pack all batches host-side (steady-state: ingress packing overlaps
-    # device compute via double buffering; here we time the device path)
-    lane_rows: dict[int, list] = {i: [] for i in range(N_PARTITIONS)}
-    for dev, v, ts in events:
-        lane_rows[rt.lane_of(dev)].append((dev, v, ts))
+    # pre-pack all batches host-side (steady state: the async ingress overlaps
+    # packing with device compute; here we time the device path itself)
+    lane_rows: dict = {i: [] for i in range(N_PARTITIONS)}
+    for i, (dev, v, ts) in enumerate(events):
+        lane_rows[rt.lane_of(dev)].append((i, dev, v, ts))
 
     packed = []
     pos = {i: 0 for i in range(N_PARTITIONS)}
@@ -83,14 +138,17 @@ def bench_device(events) -> float:
     done = 0
     while done < total:
         batches = []
+        first_idx, last_idx = total, 0
         for lane in range(N_PARTITIONS):
             b = rt.builders[lane]
             rows = lane_rows[lane]
             p = pos[lane]
             take = min(LANE_BATCH, len(rows) - p)
             for j in range(p, p + take):
-                dev, v, ts = rows[j]
+                idx, dev, v, ts = rows[j]
                 b.append("S", [dev, v], ts)
+                first_idx = min(first_idx, idx)
+                last_idx = max(last_idx, idx)
             pos[lane] = p + take
             done += take
             batches.append(b.emit())
@@ -100,21 +158,53 @@ def bench_device(events) -> float:
             "tag": np.stack([bt["tag"] for bt in batches]),
             "ts": np.stack([bt["ts"] for bt in batches]),
             "valid": np.stack([bt["valid"] for bt in batches]),
+            "count": sum(int(bt["count"]) for bt in batches),
+            "first_idx": first_idx,     # oldest event in the batch
+            "last_idx": last_idx,       # newest event in the batch
         })
 
     def run_once(state, b):
-        return rt._vstep(state, b["cols"], b["tag"], b["ts"], b["valid"])
+        return rt.vstep(state, b["cols"], b["tag"], b["ts"], b["valid"])
+
+    def _pack_windowed(rt, evs, window):
+        """Contiguous-arrival windows → padded lane batches (deadline-flush
+        shape). Cuts a window early if any lane fills."""
+        out = []
+        s = 0
+        while s < len(evs):
+            n = 0
+            for dev, v, ts in evs[s: s + window]:
+                b = rt.builders[rt.lane_of(dev)]
+                if b.full:
+                    break
+                b.append("S", [dev, v], ts)
+                n += 1
+            batches = [b.emit() for b in rt.builders]
+            out.append({
+                "cols": {k: np.stack([bt["cols"][k] for bt in batches])
+                         for k in batches[0]["cols"]},
+                "tag": np.stack([bt["tag"] for bt in batches]),
+                "ts": np.stack([bt["ts"] for bt in batches]),
+                "valid": np.stack([bt["valid"] for bt in batches]),
+                "count": n,
+                "first_idx": s,
+                "last_idx": s + n - 1,
+            })
+            s += n
+        return out
 
     # warmup / compile
-    state = rt.state
-    state, ys = run_once(state, packed[0])
+    state, ys = run_once(rt.state, packed[0])
     jax.block_until_ready(state)
 
+    # ---- throughput: unthrottled steady-state rate (fresh state: the warmup
+    # replayed batch 0, which must not double-count into matches/drops)
+    state = rt.init_state()
     t0 = time.perf_counter()
     n_ev = 0
     for b in packed:
         state, ys = run_once(state, b)
-        n_ev += int(b["valid"].sum())
+        n_ev += b["count"]
     jax.block_until_ready(state)
     dt = time.perf_counter() - t0
     rate = n_ev / dt
@@ -122,12 +212,66 @@ def bench_device(events) -> float:
     drops = int(np.sum(jax.device_get(state["drops"])))
     print(f"# device: {n_ev} events in {dt:.3f}s -> {rate:,.0f} ev/s, "
           f"{matches} matches, {drops} dropped partials", file=sys.stderr)
-    return rate
+
+    # ---- p99 detection latency at the offered rate (BASELINE.json metric:
+    # events/sec/chip + p99 detection latency @ 1M ev/s).
+    #
+    # Latency runs in the *deadline-flush* operating mode: batches cover a
+    # contiguous arrival window (lanes partially filled), the way the async
+    # ingress flushes on deadline — holding lanes until full would make tail
+    # latency depend on key skew, not on the engine. Event i "arrives" at
+    # base + i/λ; a window is released when its newest event has arrived;
+    # per-event latency = batch completion − its own arrival.
+    window = max(256, N_PARTITIONS * LANE_BATCH // 4)
+    lat_events = events[: min(len(events), window * 64)]
+    wpacked = _pack_windowed(rt, lat_events, window)
+
+    # capacity in this mode (partial fill costs the full-batch step time)
+    state2 = rt.init_state()
+    t0 = time.perf_counter()
+    for b in wpacked[:8]:
+        state2, ys = run_once(state2, b)
+    jax.block_until_ready(state2)
+    wrate = sum(b["count"] for b in wpacked[:8]) / (time.perf_counter() - t0)
+
+    lam = min(OFFERED_EVPS, wrate * 0.8)    # don't model an overloaded queue
+    state2 = rt.init_state()
+    base = time.perf_counter()
+    envelopes = []      # (lo_latency, hi_latency, n_events) per batch
+    for b in wpacked:
+        release = base + (b["last_idx"] + 1) / lam
+        while time.perf_counter() < release:
+            pass
+        state2, ys = run_once(state2, b)
+        jax.block_until_ready(ys["mask"])
+        fin = time.perf_counter()
+        # arrivals are linear in index and the window is contiguous, so the
+        # batch's event latencies span [fin − arr(newest), fin − arr(oldest)]
+        # uniformly — keep the envelope + population weight instead of
+        # materializing per-event floats
+        envelopes.append((fin - (base + (b["last_idx"] + 1) / lam),
+                          fin - (base + (b["first_idx"] + 1) / lam),
+                          b["count"]))
+    p50 = _envelope_percentile(envelopes, 0.50) * 1e3
+    p99 = _envelope_percentile(envelopes, 0.99) * 1e3
+    print(f"# latency @ {lam:,.0f} ev/s offered (deadline-flush window="
+          f"{window}): p50={p50:.2f}ms p99={p99:.2f}ms over "
+          f"{len(wpacked)} windows", file=sys.stderr)
+
+    print(json.dumps({
+        "rate": rate, "matches": matches, "drops": drops,
+        "p50_ms": round(p50, 3), "p99_ms": round(p99, 3),
+        "offered_evps": round(lam),
+        "platform": jax.default_backend(),
+    }))
 
 
-def bench_interpreter(events) -> float:
+def child_host() -> None:
     from siddhi_tpu import SiddhiManager, StreamCallback
 
+    # identical prefix to the device stream: the seeded RNG is consumed
+    # strictly sequentially, so generating only the baseline count suffices
+    events = gen_events(BASELINE_EVENTS)
     m = SiddhiManager()
     rt = m.create_siddhi_app_runtime(make_app(), playback=True)
     n_matches = 0
@@ -147,20 +291,96 @@ def bench_interpreter(events) -> float:
     rate = len(events) / dt
     print(f"# interpreter: {len(events)} events in {dt:.3f}s -> "
           f"{rate:,.0f} ev/s, {n_matches} matches", file=sys.stderr)
-    return rate
+    print(json.dumps({"rate": rate, "matches": n_matches}))
+
+
+# ---------------------------------------------------------------------------
+# parent: orchestration (no jax import — immune to backend-init hangs)
+# ---------------------------------------------------------------------------
+
+def _run_child(mode: str, deadline_s: int, env=None):
+    """Returns (parsed-json | None, error-string | None)."""
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), mode],
+            capture_output=True, text=True, timeout=deadline_s,
+            env={**os.environ, **(env or {})}, cwd=REPO)
+    except subprocess.TimeoutExpired as e:
+        tail = ""
+        if e.stderr:
+            err = e.stderr if isinstance(e.stderr, str) else e.stderr.decode(
+                errors="replace")
+            tail = " | " + " | ".join(err.strip().splitlines()[-4:])
+        return None, (f"{mode}: deadline {deadline_s}s exceeded "
+                      f"(backend hang?){tail}")
+    sys.stderr.write(p.stderr[-2000:])
+    if p.returncode != 0:
+        tail = (p.stderr or "").strip().splitlines()[-6:]
+        return None, f"{mode}: rc={p.returncode}: " + " | ".join(tail)
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            return json.loads(line), None
+        except json.JSONDecodeError:
+            continue
+    return None, f"{mode}: no JSON in output"
 
 
 def main() -> None:
-    events = gen_events(DEVICE_EVENTS)
-    device_rate = bench_device(events)
-    interp_rate = bench_interpreter(events[:BASELINE_EVENTS])
-    print(json.dumps({
-        "metric": f"{N_STATES}-state partitioned pattern throughput",
-        "value": round(device_rate),
-        "unit": "events/sec",
-        "vs_baseline": round(device_rate / interp_rate, 2),
-    }))
+    notes = []
+    # 1) cheap backend probe with its own deadline: a dead tunnel must not
+    #    burn the whole device deadline
+    probe, err = _run_child("--probe-child", PROBE_DEADLINE_S)
+    device = None
+    if probe is None:
+        notes.append(f"device probe failed: {err}")
+    else:
+        device, err = _run_child("--device-child", DEVICE_DEADLINE_S)
+        if device is None:
+            notes.append(f"device bench failed: {err}")
+
+    host, herr = _run_child("--host-child", HOST_DEADLINE_S,
+                            env={"JAX_PLATFORMS": "cpu"})
+    if host is None:
+        notes.append(f"host baseline failed: {herr}")
+
+    metric = f"{N_STATES}-state partitioned pattern throughput"
+    if device and host:
+        out = {
+            "metric": metric,
+            "value": round(device["rate"]),
+            "unit": "events/sec",
+            "vs_baseline": round(device["rate"] / host["rate"], 2),
+            "p99_detection_latency_ms": device["p99_ms"],
+            "p50_detection_latency_ms": device["p50_ms"],
+            "offered_evps": device["offered_evps"],
+            "platform": device.get("platform"),
+            "device_ok": True,
+            "baseline": "repo host interpreter (single-threaded Python; "
+                        "no JVM in image — flatters vs_baseline vs real "
+                        "siddhi-core)",
+        }
+    elif host:
+        out = {
+            "metric": metric + " (HOST-ONLY FALLBACK: device unavailable)",
+            "value": round(host["rate"]),
+            "unit": "events/sec",
+            "vs_baseline": 1.0,
+            "device_ok": False,
+        }
+    else:
+        out = {"metric": metric, "value": 0, "unit": "events/sec",
+               "vs_baseline": 0.0, "device_ok": False}
+    if notes:
+        out["notes"] = notes
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--probe-child":
+        child_probe()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--device-child":
+        child_device()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--host-child":
+        child_host()
+    else:
+        main()

@@ -936,6 +936,14 @@ class DeviceNFACompiler:
         return step
 
     # -------------------------------------------------------------- execution
+    def make_step(self):
+        """Public builder for the un-jitted single-lane step function
+        ``(state, cols, tag, ts, valid) -> (state, ys)`` — the composable
+        surface ``vmap``/``shard_map`` wrappers (partition runtime, bench,
+        ``__graft_entry__``) build on. ``self.step`` is the jitted
+        single-lane convenience over the same function."""
+        return self._make_step()
+
     def step(self, state, batch: dict):
         return self._step(state, batch["cols"], batch["tag"], batch["ts"],
                           batch["valid"])

@@ -66,7 +66,7 @@ class PartitionedNFARuntime:
         ]
 
         # vmap the single-lane step over the lane axis
-        step = self.compiler._make_step()
+        step = self.compiler.make_step()
         vstep = jax.vmap(step, in_axes=(0, 0, 0, 0, 0))
         if mesh is not None:
             from jax.experimental.shard_map import shard_map
@@ -80,18 +80,26 @@ class PartitionedNFARuntime:
             self._sharding = NamedSharding(mesh, spec)
         else:
             self._sharding = None
-        self._vstep = jax.jit(vstep, donate_argnums=(0,))
+        # public jittable step over [P, ...]-stacked lane state and batches
+        # (the API bench/__graft_entry__ drive; donates the carried state)
+        self.vstep = jax.jit(vstep, donate_argnums=(0,))
+        self._vstep = self.vstep      # backwards-compat alias
 
+        self.state = self.init_state()
+        self.callback: Optional[Callable[[list[list]], None]] = None
+
+    def init_state(self):
+        """Fresh [P, ...]-stacked lane state (sharded if a mesh was given)."""
         single = self.compiler.init_state()
-        self.state = jax.tree_util.tree_map(
-            lambda x: jnp.broadcast_to(x[None], (num_partitions,) + x.shape).copy(),
+        state = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x[None], (self.P,) + x.shape).copy(),
             single)
         if self._sharding is not None:
-            self.state = jax.device_put(
-                self.state, jax.tree_util.tree_map(
-                    lambda _: self._sharding, self.state,
+            state = jax.device_put(
+                state, jax.tree_util.tree_map(
+                    lambda _: self._sharding, state,
                     is_leaf=lambda x: hasattr(x, "shape")))
-        self.callback: Optional[Callable[[list[list]], None]] = None
+        return state
 
     def lane_of(self, key) -> int:
         return _hash_key(key) % self.P
