@@ -98,12 +98,38 @@ class SiddhiAppRuntime:
                 cache_ann = store_ann.nested("cache")
                 if cache_ann is not None:
                     from .table import CacheTable
-                    table = CacheTable(
-                        td, ctx, backing=table,
-                        max_size=int(cache_ann.get("size")
-                                     or cache_ann.get("cache.size") or "128"),
-                        policy=(cache_ann.get("cache.policy")
-                                or cache_ann.get("policy") or "FIFO"))
+                    # the reference requires an explicit size and rejects
+                    # unknown cache keys (CacheTable config validation) — a
+                    # silent 128/FIFO default would mask config typos
+                    known = {"size", "cache.size", "policy", "cache.policy"}
+                    bad = [e.key for e in cache_ann.elements
+                           if e.key and e.key not in known]
+                    if bad:
+                        raise SiddhiAppCreationError(
+                            f"table '{td.id}': unrecognized @cache key(s) "
+                            f"{bad}; known: {sorted(known)}")
+                    size_s = cache_ann.get("size") or cache_ann.get("cache.size")
+                    if size_s is None:
+                        raise SiddhiAppCreationError(
+                            f"table '{td.id}': @cache requires a 'size'")
+                    try:
+                        size = int(size_s)
+                    except ValueError:
+                        raise SiddhiAppCreationError(
+                            f"table '{td.id}': @cache size '{size_s}' is not "
+                            f"an integer") from None
+                    if size < 1:
+                        raise SiddhiAppCreationError(
+                            f"table '{td.id}': @cache size must be >= 1, "
+                            f"got {size}")
+                    try:
+                        table = CacheTable(
+                            td, ctx, backing=table, max_size=size,
+                            policy=(cache_ann.get("cache.policy")
+                                    or cache_ann.get("policy") or "FIFO"))
+                    except ValueError as e:    # e.g. unknown policy name
+                        raise SiddhiAppCreationError(
+                            f"table '{td.id}': {e}") from None
                     table.preload()
             else:
                 table = InMemoryTable(td, ctx)

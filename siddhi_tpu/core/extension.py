@@ -83,9 +83,22 @@ def extension(name: str, kind: str = "function", description: str = "",
     def deco(cls):
         cls.extension_kind = kind
         cls.extension_name = name
+        plist = list(parameters or [])
+        # positional validation matches arg i against params[i], which is only
+        # sound when every optional parameter trails the required ones —
+        # reject bad metadata at declaration, not with misleading call errors
+        seen_optional = False
+        for p in plist:
+            if p.optional:
+                seen_optional = True
+            elif seen_optional:
+                raise ValueError(
+                    f"extension '{name}': required parameter '{p.name}' "
+                    f"follows an optional one; optional parameters must be "
+                    f"trailing")
         cls.extension_meta = ExtensionMeta(
             name=name, kind=kind, description=description,
-            parameters=list(parameters or []),
+            parameters=plist,
             return_attributes=list(return_attributes or []),
             examples=list(examples or []))
         GLOBAL_EXTENSIONS[name] = cls

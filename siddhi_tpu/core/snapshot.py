@@ -187,6 +187,9 @@ class SnapshotService:
             raise ValueError(
                 "cannot restore an increment alone; restore its chain")
         with self.app_context.root_lock:
+            # pre-restore digests would otherwise mark unchanged-looking
+            # elements as ('skip',) against a baseline that no longer exists
+            self._digests = {}
             for element_id, state in data["states"].items():
                 holder = self.app_context.state_registry.get(element_id)
                 if holder is not None:

@@ -104,8 +104,10 @@ class SiddhiService:
             except Exception as e:
                 return 400, {"status": "ERROR", "message": str(e)}
             # duplicate check BEFORE registering — creating first would clobber
-            # the running app's slot in manager.runtimes
-            if parsed.name() in self.runtimes:
+            # the running app's slot in manager.runtimes; an app created
+            # programmatically on the shared manager counts as a duplicate too
+            if parsed.name() in self.runtimes or \
+                    parsed.name() in self.manager.runtimes:
                 return 409, {"status": "ERROR",
                              "message": f"app '{parsed.name()}' already deployed"}
             try:

@@ -411,7 +411,9 @@ class DeviceNFACompiler:
         for s in range(S):
             fields: dict[str, Any] = {
                 "valid": jnp.zeros((C,), jnp.bool_),
-                "first_ts": jnp.zeros((C,), jnp.int64),
+                # -1 = unset: ts 0 is a legal event time (same sentinel rule
+                # as arrive_ts below)
+                "first_ts": jnp.full((C,), -1, jnp.int64),
             }
             if self.states[s].kind == "count":
                 fields["count"] = jnp.zeros((C,), jnp.int32)
@@ -421,7 +423,9 @@ class DeviceNFACompiler:
                 for bi in range(len(self.states[s].branches)):
                     fields[f"done{bi}"] = jnp.zeros((C,), jnp.bool_)
             if self.states[s].kind == "absent":
-                fields["arrive_ts"] = jnp.zeros((C,), jnp.int64)
+                # -1 = unarmed: ts 0 is a legal event time, so 0 cannot be
+                # the "no arrival yet" sentinel (advisor round-1 finding)
+                fields["arrive_ts"] = jnp.full((C,), -1, jnp.int64)
             for (q, key, t) in self.referenced:
                 if q < s or (q == s and self.states[s].kind in
                              ("count", "logical")):
@@ -469,25 +473,26 @@ class DeviceNFACompiler:
             new["valid"] = slots["valid"].at[tgt].set(
                 jnp.where(ok, True, False), mode="drop")
             new["first_ts"] = slots["first_ts"].at[tgt].set(
-                jnp.where(ok, ts_new, 0), mode="drop")
+                jnp.where(ok, ts_new, -1), mode="drop")
             if "count" in slots:
                 cnew = counts_new if counts_new is not None else jnp.ones((C,), jnp.int32)
                 new["count"] = slots["count"].at[tgt].set(
                     jnp.where(ok, cnew, 0), mode="drop")
                 new["closed"] = slots["closed"].at[tgt].set(False, mode="drop")
             # every field is written for inserted slots: either the provided
-            # value or a zero reset — a freed slot must not leak stale bound
+            # value or a reset — a freed slot must not leak stale bound
             # values / done flags into the partial that reuses it
             for key in slots:
                 if key in ("valid", "first_ts", "count", "closed"):
                     continue
+                reset = jnp.asarray(-1 if key == "arrive_ts" else 0,
+                                    slots[key].dtype)
                 arr = values.get(key)
                 if arr is None:
-                    new[key] = slots[key].at[tgt].set(
-                        jnp.zeros((), slots[key].dtype), mode="drop")
+                    new[key] = slots[key].at[tgt].set(reset, mode="drop")
                 else:
                     new[key] = slots[key].at[tgt].set(
-                        jnp.where(ok, arr, jnp.zeros((), arr.dtype)), mode="drop")
+                        jnp.where(ok, arr, reset), mode="drop")
             dropped = jnp.maximum(n_ins - n_free, 0)
             inserted = jnp.zeros((C,), jnp.bool_).at[tgt].set(ok, mode="drop")
             return new, dropped, inserted
@@ -505,7 +510,7 @@ class DeviceNFACompiler:
             if within is not None:
                 for s in range(S):
                     slots = dict(pend[f"p{s}"])
-                    has_first = slots["first_ts"] > 0
+                    has_first = slots["first_ts"] >= 0
                     alive = ~(has_first & (ev_ts - slots["first_ts"] > within))
                     slots["valid"] = slots["valid"] & alive
                     pend[f"p{s}"] = slots
@@ -532,7 +537,7 @@ class DeviceNFACompiler:
             for s in [i for i, stx in enumerate(states) if stx.kind == "absent"]:
                 st = states[s]
                 slots = pend[f"p{s}"]
-                adv = slots["valid"] & ev_ok & (slots["arrive_ts"] > 0) & \
+                adv = slots["valid"] & ev_ok & (slots["arrive_ts"] >= 0) & \
                     (ev_ts >= slots["arrive_ts"] + st.waiting_ms)
                 ns = dict(slots)
                 ns["valid"] = ns["valid"] & ~adv
@@ -557,8 +562,8 @@ class DeviceNFACompiler:
                             slots["arrive_ts"] + st.waiting_ms).astype(jnp.int64)
                     new_tgt, dropped, inserted = insert(
                         pend[f"p{s+1}"], adv, values,
-                        jnp.where(slots["first_ts"] > 0, slots["first_ts"],
-                                  ev_ts),
+                        jnp.where(slots["first_ts"] >= 0,
+                                  slots["first_ts"], ev_ts),
                         jnp.zeros((C,), jnp.int32))
                     pend[f"p{s+1}"] = new_tgt
                     touched[s + 1] = touched[s + 1] | inserted
@@ -647,7 +652,7 @@ class DeviceNFACompiler:
                     if len(pres) > 1:
                         side_bind(values, pres[1], m1)
 
-                first_ts_new = jnp.where(adv_src["first_ts"] > 0,
+                first_ts_new = jnp.where(adv_src["first_ts"] >= 0,
                                          adv_src["first_ts"], ev_ts)
                 n_adv = jnp.sum(advance.astype(jnp.int64))
                 if s == S - 1:
@@ -814,7 +819,7 @@ class DeviceNFACompiler:
                                 values[key] = jnp.broadcast_to(
                                     ev["cols"][mk].astype(_JNP[t]), (C,))
                         first_ts_new = jnp.where(
-                            src["first_ts"] > 0, src["first_ts"], ev_ts)
+                            src["first_ts"] >= 0, src["first_ts"], ev_ts)
                         if s == S - 1:
                             # emit matches
                             emit_env = {f"ev_{k}": ev["cols"][k]

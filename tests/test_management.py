@@ -474,3 +474,50 @@ group by sym aggregate every days;
     rows = rt.query(
         "from TA within '2017-**-** **:**:**' per 'days' select sym, total")
     assert sum(r.data[1] for r in rows) == 100.0
+
+
+def _manager_with_store():
+    from siddhi_tpu import SiddhiManager
+    from test_cache_table import CountingStore
+    m = SiddhiManager()
+    m.set_extension("store:counting", CountingStore)
+    return m
+
+
+def test_cache_requires_size():
+    from siddhi_tpu.core.errors import SiddhiAppCreationError
+    import pytest
+    m = _manager_with_store()
+    with pytest.raises(SiddhiAppCreationError, match="size"):
+        m.create_siddhi_app_runtime("""
+        @store(type='counting', @cache(policy='LRU'))
+        define table T (k string, v long);
+        define stream S (k string, v long);
+        from S insert into T;
+        """, playback=True)
+
+
+def test_cache_rejects_unknown_keys():
+    from siddhi_tpu.core.errors import SiddhiAppCreationError
+    import pytest
+    m = _manager_with_store()
+    with pytest.raises(SiddhiAppCreationError, match="unrecognized"):
+        m.create_siddhi_app_runtime("""
+        @store(type='counting', @cache(size='4', polciy='LRU'))
+        define table T (k string, v long);
+        define stream S (k string, v long);
+        from S insert into T;
+        """, playback=True)
+
+
+def test_extension_optional_params_must_trail():
+    import pytest
+    from siddhi_tpu.core.extension import Parameter, extension
+    from siddhi_tpu.query_api.definition import DataType
+    with pytest.raises(ValueError, match="trailing"):
+        @extension("test:badopt", kind="function", parameters=[
+            Parameter("a", [DataType.INT], optional=True),
+            Parameter("b", [DataType.INT]),
+        ])
+        class Bad:
+            pass

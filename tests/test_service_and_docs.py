@@ -176,3 +176,19 @@ def test_docs_fall_back_to_docstring():
     md = generate_extension_docs({"x:y": NoMeta})
     assert "### x:y" in md
     assert "One-liner about this extension." in md
+
+
+def test_deploy_conflicts_with_programmatic_runtime():
+    """Deploying an app whose name matches a runtime created directly on the
+    shared manager must 409, not clobber its slot."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.service import SiddhiService
+    m = SiddhiManager()
+    app = "@app(name='Shared')\ndefine stream S (v long);\n" \
+          "from S select v insert into O;"
+    rt = m.create_siddhi_app_runtime(app, playback=True)
+    rt.start()
+    svc = SiddhiService(m)
+    code, body = svc.deploy(app)
+    assert code == 409, (code, body)
+    assert m.runtimes["Shared"] is rt

@@ -328,3 +328,30 @@ def test_parity_absent_final():
     exp = oracle(app, evs)
     act = device(app, evs)
     assert sorted(map(tuple, exp)) == sorted(map(tuple, act))
+
+
+def test_absent_for_arms_at_timestamp_zero():
+    """A partial whose predecessor matched at ts=0 must still expire its
+    `not X for t` wait (arrive_ts==0 is a real arm time, not 'unset')."""
+    app = """
+    define stream A (v long); define stream B (v long); define stream C (v long);
+    from e1=A -> not B for 100 -> e3=C
+    select e1.v as a, e3.v as c insert into O;
+    """
+    evs = [("A", [7], 0),          # arms the non-occurrence clock at ts=0
+           ("C", [9], 200)]        # after expiry: must match (7, 9)
+    assert_match_parity(app, evs)
+
+
+def test_within_expires_partial_seeded_at_timestamp_zero():
+    """`within` must expire a partial whose chain started at ts=0
+    (first_ts==0 is a real bind time, not 'unset')."""
+    app = """
+    define stream A (v long); define stream B (v long);
+    from e1=A -> e2=B within 100
+    select e1.v as a, e2.v as b insert into O;
+    """
+    evs = [("A", [7], 0), ("B", [9], 500)]      # expired: no match
+    assert_match_parity(app, evs)
+    evs2 = [("A", [7], 0), ("B", [9], 50)]      # inside window: match
+    assert_match_parity(app, evs2)
