@@ -24,7 +24,10 @@ def rows_equal(e, a, rel=1e-5, abs_=1e-5):
 
 
 def _sort_key(row):
-    return tuple((round(v, 3) if isinstance(v, float) else v) for v in row)
+    return tuple(
+        (1, 0) if v is None else
+        (0, round(v, 3)) if isinstance(v, float) else (0, v)
+        for v in row)
 
 
 def assert_rows_match(expected, actual, rel=1e-5, abs_=1e-5):
