@@ -6,24 +6,28 @@ The TPU-native replacement for the hot path the reference interprets per event
 
 - All mutable runtime state is a pytree carried through the jitted step
   (checkpoint = ``jax.device_get(state)``, restore = ``device_put``).
-- Sliding ``lengthWindow(N)`` with invertible aggregates (sum/count/avg) avoids
-  any per-event scan: keep the last-N accepted values as a carried *tail buffer*;
-  per-event window aggregates are ``cumsum(concat(tail, batch))`` differences —
-  one fused elementwise pipeline on the VPU.
-- ``lengthBatch(N)`` (tumbling) carries the open batch's events (aggregate args
-  *and* projected columns) as a remainder buffer; emission covers remainder +
-  current arrivals whenever batches complete.
-- Group-by running aggregates use a one-hot [B,K] cumulative contribution
-  (MXU-friendly) with a carried dense per-key state [K].
+- Sliding ``lengthWindow(N)``: keep the last-N accepted values as a carried
+  *tail buffer*; per-event window sums are ``cumsum(concat(tail, batch))``
+  differences — one fused elementwise pipeline on the VPU.
+- Sliding min/max (non-invertible) use a log-doubling sparse table over the
+  same concat axis: O((N+B)·log N) work, no per-event scan
+  (reference: ``MinAttributeAggregatorExecutor``'s deque has no batch analog).
+- stdDev carries RAW values and computes shifted moments per batch
+  (``var = E[(x-c)²] − (E[x-c])²`` holds for any c; centering at a per-batch
+  mean keeps f32 conditioning; running/group-by variants center at the
+  carried mean — Welford merged at batch granularity).
+- ``lengthBatch(N)`` (tumbling) carries the open batch's events (aggregate
+  args *and* projected columns) as a remainder buffer.
+- Group-by (multi-key: codes mixed into one bucket id mod K) uses one-hot
+  [B,K] cumulative contributions with carried dense per-key state [K].
+- ``having`` compiles over the materialized output columns and masks
+  emission (reference ``QuerySelector`` having executor).
 - Masked events (filter rejections, padding) are *compacted* with a stable
   scatter so window semantics see only accepted events.
 
-Numeric policy (dtypes.py): integer-argument aggregates (count, sum/avg over
-INT/LONG) accumulate in **int64** — exact, like the reference's Java longs
-(``SumAttributeAggregatorExecutor``'s long branch) — while float aggregates
-accumulate in float32 with **Kahan compensation** on the carried cross-batch
-bases (windowed sums recompute from raw tails each batch, so only the
-unbounded running/group-by bases can compound error).
+Numeric policy (dtypes.py): integer-argument sums/avgs accumulate in int64 —
+exact, like the reference's Java longs — float aggregates in float32 with
+Kahan compensation on unbounded carried bases.
 """
 
 from __future__ import annotations
@@ -48,8 +52,6 @@ from .batch import BatchSchema
 from .dtypes import FACC, JNP as _JNP_DTYPES
 from .expr_compile import ColumnResolver, DeviceCompileError, compile_expression
 
-_INVERTIBLE_AGGS = {"sum", "count", "avg"}
-
 # event-time sentinels bounding every real timestamp (keep searchsorted input
 # sorted: empty tail slots sit at the front, batch padding at the back)
 _TS_NEG = -(2 ** 62)
@@ -61,7 +63,7 @@ _IACC = jnp.int64        # exact integer accumulator
 @dataclass
 class _Spec:
     name: str           # output name
-    kind: str           # 'value' | 'sum' | 'count' | 'avg'
+    kind: str           # 'value' | 'sum' | 'count' | 'avg' | 'min' | 'max' | 'stdDev'
     fn: Optional[Callable] = None      # projection or aggregate-arg program
     dtype: DataType = DataType.DOUBLE
     source_attr: Optional[str] = None  # raw column name for string decode
@@ -73,6 +75,68 @@ def _kahan_add(base, comp, add):
     y = add - comp
     t = base + y
     return t, (t - base) - y
+
+
+def _avalanche(x):
+    """splitmix64 finalizer: spreads packed multi-key ids over buckets."""
+    x = x.astype(jnp.uint64)
+    x = (x ^ (x >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    x = x ^ (x >> 31)
+    return (x & jnp.uint64(0x7FFFFFFFFFFFFFFF)).astype(jnp.int64)
+
+
+def _ident(dtype, is_min: bool):
+    """Reduction identity for min/max lanes."""
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf if is_min else -jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.max if is_min else info.min, dtype)
+
+
+def _range_reduce(z, lo, j, is_min: bool):
+    """min/max of ``z`` over inclusive index ranges [lo_b, j_b], vectorized.
+
+    Log-doubling sparse table: T_k[i] covers [i−2^k+1, i]; a range of length m
+    is the overlap of two 2^⌊log2 m⌋ spans. O(M log M) build, O(B) query."""
+    M = z.shape[0]
+    red = jnp.minimum if is_min else jnp.maximum
+    ident = _ident(z.dtype, is_min)
+    tables = [z]
+    span = 1
+    while span < M:
+        prev = tables[-1]
+        shifted = jnp.concatenate(
+            [jnp.full((min(span, M),), ident, z.dtype), prev[:M - span]])
+        tables.append(red(prev, shifted))
+        span *= 2
+    T = jnp.stack(tables)                              # [KK, M]
+    m = jnp.maximum(j - lo + 1, 1).astype(jnp.int32)
+    kk = 31 - jax.lax.clz(m)                           # floor(log2 m)
+    p2 = (1 << kk).astype(jnp.int32)
+    return red(T[kk, j], T[kk, jnp.clip(lo + p2 - 1, 0, M - 1)])
+
+
+class _OutputResolver:
+    """Resolves ``having`` variables against the select list's output names."""
+
+    def __init__(self, specs: list[_Spec], schema: BatchSchema):
+        self.specs = {s.name: s for s in specs}
+        self.schema = schema
+
+    def resolve(self, var: Variable) -> tuple[str, DataType]:
+        s = self.specs.get(var.attribute)
+        if s is None:
+            raise DeviceCompileError(
+                f"having references '{var.attribute}', not an output "
+                f"attribute")
+        return s.name, s.dtype
+
+    def encode_string(self, key: str, value: str) -> int:
+        s = self.specs[key]
+        if s.source_attr and s.source_attr in self.schema.dictionaries:
+            return self.schema.dictionaries[s.source_attr].encode(value)
+        raise DeviceCompileError(f"no dictionary for having key '{key}'")
 
 
 class CompiledStreamQuery:
@@ -143,20 +207,20 @@ class CompiledStreamQuery:
             else:
                 raise DeviceCompileError("stream functions not on device path")
 
-        # group-by: single key column (string codes or int)
-        self.group_key: Optional[str] = None
-        if query.selector.group_by:
-            if len(query.selector.group_by) != 1:
-                raise DeviceCompileError("device path supports one group-by key")
-            key, kt = resolver.resolve(query.selector.group_by[0])
+        # group-by: one or more key columns (string codes / ints), mixed into
+        # a single bucket id modulo K (same dense-table design as the
+        # reference's per-group aggregator map, bounded for static shapes)
+        self.group_keys: list[str] = []
+        self.group_key_types: list[DataType] = []
+        for gb in (query.selector.group_by or []):
+            key, kt = resolver.resolve(gb)
             if kt not in (DataType.STRING, DataType.INT, DataType.LONG):
                 raise DeviceCompileError("group key must be string/int")
-            self.group_key = key
-            if self.window_kind is not None:
-                raise DeviceCompileError(
-                    "group-by with windows not on device path yet")
-        if query.selector.having is not None:
-            raise DeviceCompileError("having not on device path yet")
+            self.group_keys.append(key)
+            self.group_key_types.append(kt)
+        if self.group_keys and self.window_kind is not None:
+            raise DeviceCompileError(
+                "group-by with windows not on device path yet")
 
         # select list
         self.specs: list[_Spec] = []
@@ -171,23 +235,34 @@ class CompiledStreamQuery:
             if isinstance(e, AttributeFunction) and e.namespace is None \
                     and e.name in ("sum", "count", "avg", "min", "max",
                                    "distinctCount", "stdDev"):
-                if e.name not in _INVERTIBLE_AGGS:
+                if e.name == "distinctCount":
                     raise DeviceCompileError(
-                        f"aggregator '{e.name}' needs the host path")
+                        "aggregator 'distinctCount' needs the host path")
                 arg_fn, at = (None, DataType.LONG)
                 if e.args:
                     arg_fn, at = compile_expression(e.args[0], resolver)
+                    if at not in (DataType.INT, DataType.LONG,
+                                  DataType.FLOAT, DataType.DOUBLE):
+                        # e.g. min(sym): the host compares strings
+                        # lexicographically; dictionary codes are arrival-
+                        # ordered, so aggregating them would silently diverge
+                        raise DeviceCompileError(
+                            f"{e.name}() over non-numeric arguments needs "
+                            f"the host path")
                 elif e.name != "count":
                     raise DeviceCompileError(f"{e.name}() needs an argument")
                 int_arg = at in (DataType.INT, DataType.LONG)
                 if e.name == "count":
                     dt = DataType.LONG
-                elif e.name == "avg":
+                elif e.name in ("avg", "stdDev"):
                     dt = DataType.DOUBLE
+                elif e.name in ("min", "max"):
+                    dt = at          # reference: min/max keep the arg type
                 else:
                     dt = DataType.LONG if int_arg else DataType.DOUBLE
                 self.specs.append(_Spec(oa.name, e.name, arg_fn, dt,
-                                        acc_int=int_arg and e.name != "count"))
+                                        acc_int=int_arg and
+                                        e.name in ("sum", "avg")))
             else:
                 fn, t = compile_expression(e, resolver)
                 src = e.attribute if isinstance(e, Variable) and t == DataType.STRING \
@@ -195,24 +270,46 @@ class CompiledStreamQuery:
                 self.specs.append(_Spec(oa.name, "value", fn, t, src))
 
         self.value_idx = [i for i, s in enumerate(self.specs) if s.kind == "value"]
-        # aggregate lanes: counts ride the ones/cnts axis; sums/avgs split into
-        # an exact-int stack and a float stack
+        # aggregate lanes: counts ride the ones/cnts axis; sums/avgs split
+        # into an exact-int stack and a float stack; min/max keep individual
+        # policy-dtype lanes; stdDev lanes carry raw float values
         self.iagg_idx = [i for i, s in enumerate(self.specs)
                          if s.kind in ("sum", "avg") and s.acc_int]
         self.fagg_idx = [i for i, s in enumerate(self.specs)
-                        if s.kind in ("sum", "avg") and not s.acc_int]
+                         if s.kind in ("sum", "avg") and not s.acc_int]
+        self.magg_idx = [i for i, s in enumerate(self.specs)
+                         if s.kind in ("min", "max")]
+        self.sagg_idx = [i for i, s in enumerate(self.specs)
+                         if s.kind == "stdDev"]
         self.agg_idx = [i for i, s in enumerate(self.specs) if s.kind != "value"]
+
+        # having: post-filter over materialized output columns (reference
+        # ``QuerySelector``'s havingConditionExecutor)
+        self.having_fn: Optional[Callable] = None
+        if query.selector.having is not None:
+            hres = _OutputResolver(self.specs, self.schema)
+            self.having_fn, _ = compile_expression(query.selector.having, hres)
         self._step = jax.jit(self._make_step(), donate_argnums=(0,))
+
+    def _mdtype(self, i: int):
+        return _JNP_DTYPES[self.specs[i].dtype]
 
     # ------------------------------------------------------------------ state
     def init_state(self) -> dict:
         N = max(self.window_n, 1)
         AF, AI = len(self.fagg_idx), len(self.iagg_idx)
+        AS = len(self.sagg_idx)
         state: dict[str, Any] = {}
-        if self.window_kind in ("length", "lengthBatch", "time"):
+        windowed = self.window_kind in ("length", "lengthBatch", "time")
+        if windowed:
             state["tail_fvals"] = jnp.zeros((AF, N), dtype=FACC)
             state["tail_ivals"] = jnp.zeros((AI, N), dtype=_IACC)
+            state["tail_svals"] = jnp.zeros((AS, N), dtype=FACC)
             state["tail_ones"] = jnp.zeros((N,), dtype=jnp.int32)
+            for i in self.magg_idx:
+                dt = self._mdtype(i)
+                state[f"tail_m{i}"] = jnp.full(
+                    (N,), _ident(dt, self.specs[i].kind == "min"), dt)
         if self.window_kind == "time":
             # sentinel = long-expired; keeps the concat ts array sorted
             state["tail_ts"] = jnp.full((N,), _TS_NEG, dtype=jnp.int64)
@@ -225,16 +322,33 @@ class CompiledStreamQuery:
             for i in self.value_idx:
                 state[f"rem_proj_{i}"] = jnp.zeros(
                     (N,), dtype=_JNP_DTYPES[self.specs[i].dtype])
-        if self.group_key is not None:
-            state["key_fsums"] = jnp.zeros((AF, self.K), dtype=FACC)
-            state["key_fcomp"] = jnp.zeros((AF, self.K), dtype=FACC)
-            state["key_isums"] = jnp.zeros((AI, self.K), dtype=_IACC)
-            state["key_counts"] = jnp.zeros((self.K,), dtype=jnp.int64)
-        if self.window_kind is None and self.group_key is None:
+        if self.group_keys:
+            K = self.K
+            state["key_fsums"] = jnp.zeros((AF, K), dtype=FACC)
+            state["key_fcomp"] = jnp.zeros((AF, K), dtype=FACC)
+            state["key_isums"] = jnp.zeros((AI, K), dtype=_IACC)
+            state["key_counts"] = jnp.zeros((K,), dtype=jnp.int64)
+            state["key_owner"] = jnp.zeros((K,), dtype=jnp.int64)
+            state["key_owned"] = jnp.zeros((K,), dtype=jnp.bool_)
+            state["group_collisions"] = jnp.zeros((), dtype=jnp.int64)
+            for i in self.magg_idx:
+                dt = self._mdtype(i)
+                state[f"key_m{i}"] = jnp.full(
+                    (K,), _ident(dt, self.specs[i].kind == "min"), dt)
+            state["key_smean"] = jnp.zeros((AS, K), dtype=FACC)
+            state["key_sm2"] = jnp.zeros((AS, K), dtype=FACC)
+            state["key_scnt"] = jnp.zeros((AS, K), dtype=FACC)
+        if self.window_kind is None and not self.group_keys:
             state["run_fsums"] = jnp.zeros((AF,), dtype=FACC)
             state["run_fcomp"] = jnp.zeros((AF,), dtype=FACC)
             state["run_isums"] = jnp.zeros((AI,), dtype=_IACC)
             state["run_count"] = jnp.zeros((), dtype=jnp.int64)
+            for i in self.magg_idx:
+                dt = self._mdtype(i)
+                state[f"run_m{i}"] = _ident(dt, self.specs[i].kind == "min")
+            state["run_smean"] = jnp.zeros((AS,), dtype=FACC)
+            state["run_sm2"] = jnp.zeros((AS,), dtype=FACC)
+            state["run_scnt"] = jnp.zeros((AS,), dtype=FACC)
         return state
 
     # ------------------------------------------------------------------- step
@@ -244,10 +358,15 @@ class CompiledStreamQuery:
         specs = self.specs
         value_idx = self.value_idx
         fagg_idx, iagg_idx = self.fagg_idx, self.iagg_idx
+        magg_idx, sagg_idx = self.magg_idx, self.sagg_idx
         window_kind, N = self.window_kind, max(self.window_n, 1)
         window_ms, time_key = self.window_ms, self.time_key
-        group_key = self.group_key
+        group_keys = list(self.group_keys)
         K = self.K
+        having_fn = self.having_fn
+        mdt = {i: self._mdtype(i) for i in magg_idx}
+        m_ident = {i: _ident(mdt[i], specs[i].kind == "min") for i in magg_idx}
+        m_ismin = {i: specs[i].kind == "min" for i in magg_idx}
 
         def step(state, cols, ts, valid):
             cols = dict(cols)
@@ -263,10 +382,10 @@ class CompiledStreamQuery:
             rank = jnp.cumsum(mask.astype(jnp.int32)) - 1
             pos = jnp.where(mask, rank, B - 1)
 
-            def compact(x):
-                out = jnp.zeros((B,), dtype=x.dtype)
-                return out.at[pos].set(jnp.where(mask, x, jnp.zeros((), x.dtype)),
-                                       mode="drop")
+            def compact(x, fill=None):
+                f = jnp.zeros((), x.dtype) if fill is None else fill
+                out = jnp.full((B,), f, dtype=x.dtype)
+                return out.at[pos].set(jnp.where(mask, x, f), mode="drop")
 
             cts = compact(ts)
             proj_c = {i: compact(specs[i].fn(cols)) for i in value_idx}
@@ -280,37 +399,102 @@ class CompiledStreamQuery:
 
             av_f = agg_stack(fagg_idx, FACC)
             av_i = agg_stack(iagg_idx, _IACC)
+            av_s = agg_stack(sagg_idx, FACC)          # raw values
+            av_m = {i: compact(specs[i].fn(cols).astype(mdt[i]),
+                               fill=m_ident[i]) for i in magg_idx}
             ones_c = compact(mask.astype(jnp.int32))
             out_valid = jnp.arange(B) < k
 
-            def finish(state, sums_f, sums_i, cnts, ovalid=out_valid, ots=cts,
-                       proj=proj_c, count=None):
-                out = _materialize(specs, value_idx, fagg_idx, iagg_idx, proj,
-                                   sums_f, sums_i, cnts)
+            def finish(state, sums_f, sums_i, cnts, mins, svars,
+                       ovalid=out_valid, ots=cts, proj=proj_c, count=None):
+                out = _materialize(specs, value_idx, fagg_idx, iagg_idx,
+                                   magg_idx, sagg_idx, proj, sums_f, sums_i,
+                                   cnts, mins, svars)
+                if having_fn is not None:
+                    ovalid = ovalid & jnp.broadcast_to(
+                        having_fn(out), ovalid.shape)
                 return state, {"out": out, "valid": ovalid, "ts": ots,
                                "count": k if count is None else count}
 
-            if window_kind == "length":
-                state, sums_f, sums_i, cnts = _length_window(
-                    state, av_f, av_i, ones_c, k, N, B)
-                return finish(state, sums_f, sums_i, cnts)
+            if window_kind in ("length", "time"):
+                if window_kind == "length":
+                    z_f, z_i, z_s, zo, zm = _length_concat(
+                        state, av_f, av_i, av_s, av_m, magg_idx, ones_c)
+                    j = jnp.arange(B) + N
+                    n_tail = jnp.sum(state["tail_ones"])
+                    lo = jnp.maximum(j - N + 1, N - n_tail)
+                    new_state = _slide_tails(state, z_f, z_i, z_s, zo, zm,
+                                             k, N)
+                else:
+                    wts = compact(cols[time_key].astype(jnp.int64),
+                                  fill=jnp.asarray(_TS_POS, jnp.int64)) \
+                        if time_key else compact(
+                            ts, fill=jnp.asarray(_TS_POS, jnp.int64))
+                    (z_f, z_i, z_s, zo, zm, j, lo, new_state) = \
+                        _time_window_bounds(state, av_f, av_i, av_s, av_m,
+                                            magg_idx, ones_c, wts, k, N, B,
+                                            window_ms)
+                sums_f = _range_sums(z_f, lo, j)
+                sums_i = _range_sums(z_i, lo, j)
+                cso = jnp.concatenate(
+                    [jnp.zeros((1,), jnp.int32), jnp.cumsum(zo)])
+                cnts = (cso[j + 1] - cso[lo]).astype(jnp.int64)
+                mins = {i: _range_reduce(zm[i], lo, j, m_ismin[i])
+                        for i in magg_idx}
+                svars = _window_svars(z_s, zo, lo, j, cnts, k, N, B)
+                return finish(new_state, sums_f, sums_i, cnts, mins, svars)
 
             if window_kind == "lengthBatch":
                 return _length_batch(state, specs, value_idx, fagg_idx,
-                                     iagg_idx, proj_c, av_f, av_i, ones_c,
-                                     cts, k, N, B)
+                                     iagg_idx, magg_idx, sagg_idx, m_ismin,
+                                     proj_c, av_f, av_i, av_s, av_m, ones_c,
+                                     cts, k, N, B, finish)
 
-            if window_kind == "time":
-                wts = compact(cols[time_key].astype(jnp.int64)) if time_key \
-                    else cts
-                state, sums_f, sums_i, cnts = _time_window(
-                    state, av_f, av_i, ones_c, wts, k, N, B, window_ms)
-                return finish(state, sums_f, sums_i, cnts)
-
-            if group_key is not None:
-                keys = compact(cols[group_key].astype(jnp.int32)) % K
+            if group_keys:
+                # exact packed key (for collision detection) + bucket id.
+                # Single keys: direct mod K is collision-free for dense
+                # dictionary codes / small ints. Two 32-bit keys pack exactly
+                # into int64; anything wider FNV64-mixes (detection then
+                # relies on 64-bit hash uniqueness). A bucket claimed by a
+                # different packed key is COUNTED (group_collisions) — loud,
+                # bounded-table overflow policy like window/slot drops.
+                k64 = [compact(cols[gk].astype(jnp.int64))
+                       for gk in group_keys]
+                narrow = all(t in (DataType.STRING, DataType.INT)
+                             for t in self.group_key_types)
+                if len(group_keys) == 1:
+                    packed = k64[0]
+                    if narrow:      # dense dictionary codes / small ints:
+                        # direct mod is collision-free while #groups <= K
+                        keys = ((packed & 0x7FFFFFFFFFFFFFFF) % K).astype(
+                            jnp.int32)
+                    else:           # LONG: arbitrary magnitudes, spread them
+                        keys = (_avalanche(packed) % K).astype(jnp.int32)
+                elif len(group_keys) == 2 and narrow:
+                    packed = (k64[0] << 32) | (k64[1] & 0xFFFFFFFF)
+                    keys = (_avalanche(packed) % K).astype(jnp.int32)
+                else:
+                    packed = k64[0]
+                    for kx in k64[1:]:
+                        packed = packed * jnp.int64(0x100000001B3) ^ kx
+                    keys = (_avalanche(packed) % K).astype(jnp.int32)
                 onehot = (jax.nn.one_hot(keys, K, dtype=jnp.int32)
                           * out_valid[:, None].astype(jnp.int32))     # [B,K]
+                first_occ = (jnp.cumsum(onehot, axis=0) == 1) & \
+                    onehot.astype(bool)                               # [B,K]
+
+                # collision accounting: the bucket's owner is its carried
+                # claimant or, if empty, the first claimant in this batch
+                # (ownership validity is a separate flag: any int64 is a
+                # legal packed key, so no value can serve as a sentinel)
+                batch_first = jnp.sum(
+                    jnp.where(first_occ, packed[:, None], 0), axis=0)  # [K]
+                has_batch = jnp.any(first_occ, axis=0)
+                owned = state["key_owned"]
+                claimed = jnp.where(owned, state["key_owner"], batch_first)
+                coll = out_valid & (packed != claimed[keys])
+                new_owner = claimed
+                new_owned = owned | has_batch
 
                 def per_key(av, base, dt):
                     contrib = onehot[None].astype(dt) * av[:, :, None]  # [A,B,K]
@@ -331,11 +515,76 @@ class CompiledStreamQuery:
                         .astype(jnp.int64) + state["key_counts"][keys])
                 nf, nc = _kahan_add(state["key_fsums"], state["key_fcomp"],
                                     add_f)
-                state = {**state, "key_fsums": nf, "key_fcomp": nc,
-                         "key_isums": state["key_isums"] + add_i,
-                         "key_counts": state["key_counts"]
-                         + onehot.sum(axis=0).astype(jnp.int64)}
-                return finish(state, sums_f, sums_i, cnts)
+                new_state = {**state, "key_fsums": nf, "key_fcomp": nc,
+                             "key_isums": state["key_isums"] + add_i,
+                             "key_counts": state["key_counts"]
+                             + onehot.sum(axis=0).astype(jnp.int64),
+                             "key_owner": new_owner,
+                             "key_owned": new_owned,
+                             "group_collisions": state["group_collisions"]
+                             + jnp.sum(coll.astype(jnp.int64))}
+
+                # min/max per key: cumulative reduction over one-hot grids
+                mins = {}
+                for i in magg_idx:
+                    ident = m_ident[i]
+                    grid = jnp.where(onehot.astype(bool),
+                                     av_m[i][:, None], ident)          # [B,K]
+                    red = jax.lax.cummin if m_ismin[i] else jax.lax.cummax
+                    g = red(grid, axis=0)
+                    per_ev = jnp.take_along_axis(g, keys[:, None], axis=1)[:, 0]
+                    carried = state[f"key_m{i}"][keys]
+                    mins[i] = jnp.minimum(per_ev, carried) if m_ismin[i] \
+                        else jnp.maximum(per_ev, carried)
+                    new_state[f"key_m{i}"] = (
+                        jnp.minimum(state[f"key_m{i}"], g[-1]) if m_ismin[i]
+                        else jnp.maximum(state[f"key_m{i}"], g[-1]))
+
+                # stdDev per key: shifted moments centered at the key's
+                # carried mean (Welford merged at batch granularity)
+                svars = jnp.zeros((len(sagg_idx), B), FACC)
+                for si in range(len(sagg_idx)):
+                    # center at the key's carried mean; for a never-seen key
+                    # use its first value in this batch — centering at 0 would
+                    # cancel catastrophically in f32 for near-equal values
+                    firstval = jnp.sum(
+                        jnp.where(first_occ, av_s[si][:, None], 0.0), axis=0)
+                    c_key = jnp.where(state["key_scnt"][si] > 0,
+                                      state["key_smean"][si], firstval)  # [K]
+                    c_ev = c_key[keys]                                # [B]
+                    d = (av_s[si] - c_ev) * onehot.sum(axis=1).astype(FACC)
+                    d2 = d * d
+                    grid1 = onehot.astype(FACC) * d[:, None]
+                    grid2 = onehot.astype(FACC) * d2[:, None]
+                    cs1 = jnp.cumsum(grid1, axis=0)
+                    cs2 = jnp.cumsum(grid2, axis=0)
+                    s1 = jnp.take_along_axis(cs1, keys[:, None], axis=1)[:, 0]
+                    s2 = jnp.take_along_axis(cs2, keys[:, None], axis=1)[:, 0]
+                    m2p = state["key_sm2"][si][keys]
+                    # per-key event count at this row (aggregates share the
+                    # accepted-event axis)
+                    nsc = state["key_scnt"][si][keys] + \
+                        jnp.take_along_axis(ocum, keys[:, None],
+                                            axis=1)[:, 0].astype(FACC)
+                    var = jnp.maximum(
+                        (m2p + s2) / jnp.maximum(nsc, 1.0)
+                        - ((s1) / jnp.maximum(nsc, 1.0)) ** 2, 0.0)
+                    svars = svars.at[si].set(jnp.sqrt(var))
+                    # state update: recenter to the new mean
+                    add1 = cs1[-1]                                     # [K]
+                    add2 = cs2[-1]
+                    addn = onehot.sum(axis=0).astype(FACC)
+                    n_new = state["key_scnt"][si] + addn
+                    mean_new = c_key + add1 / jnp.maximum(n_new, 1.0)
+                    m2_new = state["key_sm2"][si] + add2 - \
+                        jnp.maximum(n_new, 1.0) * (mean_new - c_key) ** 2
+                    new_state["key_smean"] = new_state["key_smean"].at[si].set(
+                        mean_new)
+                    new_state["key_sm2"] = new_state["key_sm2"].at[si].set(
+                        jnp.maximum(m2_new, 0.0))
+                    new_state["key_scnt"] = new_state["key_scnt"].at[si].set(
+                        n_new)
+                return finish(new_state, sums_f, sums_i, cnts, mins, svars)
 
             # running aggregates, no window/grouping
             cs_f = jnp.cumsum(av_f, axis=1)
@@ -346,13 +595,48 @@ class CompiledStreamQuery:
             cnts = cso + state["run_count"]
             nf, nc = _kahan_add(state["run_fsums"], state["run_fcomp"],
                                 av_f.sum(axis=1))
-            state = {**state, "run_fsums": nf, "run_fcomp": nc,
-                     "run_isums": state["run_isums"] + av_i.sum(axis=1),
-                     "run_count": state["run_count"]
-                     + ones_c.sum().astype(jnp.int64)}
-            return finish(state, sums_f, sums_i, cnts)
+            new_state = {**state, "run_fsums": nf, "run_fcomp": nc,
+                         "run_isums": state["run_isums"] + av_i.sum(axis=1),
+                         "run_count": state["run_count"]
+                         + ones_c.sum().astype(jnp.int64)}
+            mins = {}
+            for i in magg_idx:
+                red = jax.lax.cummin if m_ismin[i] else jax.lax.cummax
+                pre = red(av_m[i])
+                carried = state[f"run_m{i}"]
+                mins[i] = jnp.minimum(pre, carried) if m_ismin[i] \
+                    else jnp.maximum(pre, carried)
+                new_state[f"run_m{i}"] = mins[i][-1]
+            svars = jnp.zeros((len(sagg_idx), B), FACC)
+            for si in range(len(sagg_idx)):
+                # center at the carried mean; on the very first events use the
+                # first accepted value (0-centering cancels catastrophically)
+                c = jnp.where(state["run_scnt"][si] > 0,
+                              state["run_smean"][si], av_s[si][0])
+                occ = ones_c.astype(FACC)
+                d = (av_s[si] - c) * occ
+                d2 = d * d
+                s1 = jnp.cumsum(d)
+                s2 = jnp.cumsum(d2)
+                nsc = state["run_scnt"][si] + jnp.cumsum(occ)
+                var = jnp.maximum(
+                    (state["run_sm2"][si] + s2) / jnp.maximum(nsc, 1.0)
+                    - (s1 / jnp.maximum(nsc, 1.0)) ** 2, 0.0)
+                svars = svars.at[si].set(jnp.sqrt(var))
+                n_new = state["run_scnt"][si] + occ.sum()
+                mean_new = c + s1[-1] / jnp.maximum(n_new, 1.0)
+                m2_new = state["run_sm2"][si] + s2[-1] - \
+                    jnp.maximum(n_new, 1.0) * (mean_new - c) ** 2
+                new_state["run_smean"] = new_state["run_smean"].at[si].set(
+                    mean_new)
+                new_state["run_sm2"] = new_state["run_sm2"].at[si].set(
+                    jnp.maximum(m2_new, 0.0))
+                new_state["run_scnt"] = new_state["run_scnt"].at[si].set(n_new)
+            return finish(new_state, sums_f, sums_i, cnts, mins, svars)
 
         return step
+
+    # stdDev's event axis is the same accepted-event axis as counts
 
     # -------------------------------------------------------------- execution
     def step(self, state, batch: dict):
@@ -378,82 +662,83 @@ class CompiledStreamQuery:
 # window kernels
 # ---------------------------------------------------------------------------
 
-def _slide_tails(state, z_f, z_i, zo, k, N):
-    """Keep the last-N accepted entries (values + ones) as the new tails."""
+def _slide_tails(state, z_f, z_i, z_s, zo, zm, k, N):
     take = lambda row: jax.lax.dynamic_slice(row, (k,), (N,))
-    return {
+    new = {
         **state,
         "tail_fvals": jax.vmap(take)(z_f) if z_f.shape[0] else state["tail_fvals"],
         "tail_ivals": jax.vmap(take)(z_i) if z_i.shape[0] else state["tail_ivals"],
+        "tail_svals": jax.vmap(take)(z_s) if z_s.shape[0] else state["tail_svals"],
         "tail_ones": take(zo),
     }
+    for i, z in zm.items():
+        new[f"tail_m{i}"] = take(z)
+    return new
 
 
-def _window_sums(z, j, N):
-    """Trailing-N sums at positions ``j`` of the [A, N+B] value axis."""
+def _range_sums(z, lo, j):
+    """Sums of z over inclusive ranges [lo, j] (leading-zero cumsum diff)."""
     if not z.shape[0]:
         return jnp.zeros((0, j.shape[0]), z.dtype)
-    cs = jnp.cumsum(z, axis=1)
-    return cs[:, j] - cs[:, j - N]
+    cs = jnp.concatenate(
+        [jnp.zeros((z.shape[0], 1), z.dtype), jnp.cumsum(z, axis=1)], axis=1)
+    return cs[:, j + 1] - cs[:, lo]
 
 
-def _length_window(state, av_f, av_i, ones_c, k, N, B):
-    """Sliding window sums via tail-buffer + cumsum differences."""
-    z_f = jnp.concatenate([state["tail_fvals"], av_f], axis=1)     # [AF, N+B]
-    z_i = jnp.concatenate([state["tail_ivals"], av_i], axis=1)     # [AI, N+B]
-    zo = jnp.concatenate([state["tail_ones"], ones_c])             # [N+B]
-    j = jnp.arange(B) + N
-    sums_f = _window_sums(z_f, j, N)
-    sums_i = _window_sums(z_i, j, N)
-    cso = jnp.cumsum(zo)
-    cnts = (cso[j] - cso[j - N]).astype(jnp.int64)
-    return _slide_tails(state, z_f, z_i, zo, k, N), sums_f, sums_i, cnts
+def _window_svars(z_s, zo, lo, j, cnts, k, N, B):
+    """stdDev over inclusive ranges: shifted second moments, centered at the
+    current batch's mean (any shift is exact algebraically; centering keeps
+    f32 conditioning)."""
+    AS = z_s.shape[0]
+    if not AS:
+        return jnp.zeros((0, B), FACC)
+    occ = (zo > 0).astype(FACC)
+    out = jnp.zeros((AS, B), FACC)
+    n = jnp.maximum(cnts.astype(FACC), 1.0)
+    for si in range(AS):
+        raw = z_s[si]
+        c = jnp.sum(raw * occ) / jnp.maximum(jnp.sum(occ), 1.0)
+        d = (raw - c) * occ
+        cs1 = jnp.concatenate([jnp.zeros((1,), FACC), jnp.cumsum(d)])
+        cs2 = jnp.concatenate([jnp.zeros((1,), FACC), jnp.cumsum(d * d)])
+        s1 = cs1[j + 1] - cs1[lo]
+        s2 = cs2[j + 1] - cs2[lo]
+        var = jnp.maximum(s2 / n - (s1 / n) ** 2, 0.0)
+        out = out.at[si].set(jnp.sqrt(var))
+    return out
 
 
-def _time_window(state, av_f, av_i, ones_c, wts, k, N, B, D):
-    """Sliding event-time window: per-event aggregates over events with
-    ``ts > now - D`` via searchsorted on the (sorted) tail+batch timestamp
-    axis + leading-zero cumsum differences. Requires non-decreasing event
-    time (the watermark ingress guarantees it). Fixed tail capacity N; events
-    evicted while still alive are counted in ``window_drops`` (explicit
-    bounded-state overflow policy, SURVEY §7 hard part 1)."""
+def _length_concat(state, av_f, av_i, av_s, av_m, magg_idx, ones_c):
+    z_f = jnp.concatenate([state["tail_fvals"], av_f], axis=1)
+    z_i = jnp.concatenate([state["tail_ivals"], av_i], axis=1)
+    z_s = jnp.concatenate([state["tail_svals"], av_s], axis=1)
+    zo = jnp.concatenate([state["tail_ones"], ones_c])
+    zm = {i: jnp.concatenate([state[f"tail_m{i}"], av_m[i]])
+          for i in magg_idx}
+    return z_f, z_i, z_s, zo, zm
+
+
+def _time_window_bounds(state, av_f, av_i, av_s, av_m, magg_idx, ones_c,
+                        wts, k, N, B, D):
+    """Time-window variant: monotonicity clamp, searchsorted lower bounds,
+    overflow accounting. Returns concat lanes + (j, lo) ranges + new state."""
     valid = jnp.arange(B) < k
-    # searchsorted needs a sorted ts axis: clamp regressions to the running
-    # max (the event is treated as arriving "now") and count them — loud,
-    # not silently corrupting (externalTime columns carry no order guarantee)
     raw = jnp.where(valid, wts, _TS_POS)
     mono = jnp.maximum(jax.lax.cummax(raw), state["last_ts"])
     regressed = jnp.sum(jnp.where(valid & (raw < mono), 1, 0)).astype(jnp.int64)
-    # padding slots (>= k) get +sentinel ts so the concat stays sorted
     wts_s = jnp.where(valid, mono, _TS_POS)
-    z_f = jnp.concatenate([state["tail_fvals"], av_f], axis=1)     # [AF, N+B]
-    z_i = jnp.concatenate([state["tail_ivals"], av_i], axis=1)     # [AI, N+B]
-    zo = jnp.concatenate([state["tail_ones"], ones_c])             # [N+B]
+    z_f, z_i, z_s, zo, zm = _length_concat(
+        state, av_f, av_i, av_s, av_m, magg_idx, ones_c)
     zts = jnp.concatenate([state["tail_ts"], wts_s])               # [N+B]
-
     j = jnp.arange(B) + N
     lo = jnp.searchsorted(zts, wts_s - D, side="right")            # [B]
 
-    def lead_sums(z):
-        if not z.shape[0]:
-            return jnp.zeros((0, B), z.dtype)
-        cs = jnp.concatenate(
-            [jnp.zeros((z.shape[0], 1), z.dtype), jnp.cumsum(z, axis=1)], axis=1)
-        return cs[:, j + 1] - cs[:, lo]
-
-    sums_f = lead_sums(z_f)
-    sums_i = lead_sums(z_i)
-    cso = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(zo)])
-    cnts = (cso[j + 1] - cso[lo]).astype(jnp.int64)
-
-    # overflow: entries sliced off the front that were still alive w.r.t. the
-    # newest event's clock
     newest = zts[jnp.maximum(N + k - 1, 0)]
     sliced = jnp.arange(N + B) < k
     drops = jnp.sum(jnp.where(sliced & (zts > newest - D), zo, 0)
                     ).astype(jnp.int64)
 
-    new_state = _slide_tails(state, z_f, z_i, zo, k, N)
+    new_state = _slide_tails(state, z_f, z_i, z_s, zo, zm, k, N)
     new_state.update({
         "tail_ts": jax.lax.dynamic_slice(zts, (k,), (N,)),
         "window_drops": state["window_drops"] + drops,
@@ -462,49 +747,48 @@ def _time_window(state, av_f, av_i, ones_c, wts, k, N, B, D):
                                          state["last_ts"])),
         "ts_regressions": state["ts_regressions"] + regressed,
     })
-    return new_state, sums_f, sums_i, cnts
+    return z_f, z_i, z_s, zo, zm, j, lo, new_state
 
 
-def _length_batch(state, specs, value_idx, fagg_idx, iagg_idx, proj_c,
-                  av_f, av_i, ones_c, cts, k, N, B):
+def _length_batch(state, specs, value_idx, fagg_idx, iagg_idx, magg_idx,
+                  sagg_idx, m_ismin, proj_c, av_f, av_i, av_s, av_m, ones_c,
+                  cts, k, N, B, finish):
     """Tumbling window: carried remainder (projections + agg args), outputs over
     [N+B] slots covering remainder + current arrivals."""
     r = state["rem_count"]
     M = N + B
     total = r + k
     # contiguous accepted sequence: remainder (first r of N) then batch (first k)
-    zm = jnp.concatenate([jnp.arange(N) < r, jnp.arange(B) < k])
-    zrank = jnp.cumsum(zm.astype(jnp.int32)) - 1
-    zpos = jnp.where(zm, zrank, M - 1)
+    zm_mask = jnp.concatenate([jnp.arange(N) < r, jnp.arange(B) < k])
+    zrank = jnp.cumsum(zm_mask.astype(jnp.int32)) - 1
+    zpos = jnp.where(zm_mask, zrank, M - 1)
 
-    def zc(x_rem, x_batch):
+    def zc(x_rem, x_batch, fill=None):
         x = jnp.concatenate([x_rem, x_batch])
-        out = jnp.zeros((M,), dtype=x.dtype)
-        return out.at[zpos].set(jnp.where(zm, x, jnp.zeros((), x.dtype)),
-                                mode="drop")
+        f = jnp.zeros((), x.dtype) if fill is None else fill
+        out = jnp.full((M,), f, dtype=x.dtype)
+        return out.at[zpos].set(jnp.where(zm_mask, x, f), mode="drop")
 
     z_f = jax.vmap(zc)(state["tail_fvals"], av_f) if len(fagg_idx) \
         else jnp.zeros((0, M), FACC)
     z_i = jax.vmap(zc)(state["tail_ivals"], av_i) if len(iagg_idx) \
         else jnp.zeros((0, M), _IACC)
+    z_s = jax.vmap(zc)(state["tail_svals"], av_s) if len(sagg_idx) \
+        else jnp.zeros((0, M), FACC)
+    zm = {i: zc(state[f"tail_m{i}"], av_m[i],
+                fill=_ident(av_m[i].dtype, m_ismin[i])) for i in magg_idx}
     zts = zc(state["rem_ts"], cts)
     zproj = {i: zc(state[f"rem_proj_{i}"], proj_c[i]) for i in value_idx}
+    zo = zc(jnp.where(jnp.arange(N) < r, state["tail_ones"], 0), ones_c)
 
     j2 = jnp.arange(M)
     batch_start = (j2 // N) * N
-
-    def batch_sums(z):
-        if not z.shape[0]:
-            return jnp.zeros((0, M), z.dtype)
-        cs = jnp.cumsum(z, axis=1)
-        start_cs = jnp.where(batch_start > 0,
-                             cs[:, jnp.maximum(batch_start - 1, 0)],
-                             jnp.zeros((), z.dtype))
-        return cs - start_cs
-
-    sums_f = batch_sums(z_f)
-    sums_i = batch_sums(z_i)
+    sums_f = _range_sums(z_f, batch_start, j2)
+    sums_i = _range_sums(z_i, batch_start, j2)
     cnts = (j2 % N + 1).astype(jnp.int64)
+    mins = {i: _range_reduce(zm[i], batch_start, j2, m_ismin[i])
+            for i in magg_idx}
+    svars = _window_svars(z_s, zo, batch_start, j2, cnts, k, N, M)
 
     full_batches = total // N
     out_valid = (j2 < full_batches * N) & (j2 < total)
@@ -520,28 +804,32 @@ def _length_batch(state, specs, value_idx, fagg_idx, iagg_idx, proj_c,
     new_state["tail_ivals"] = jnp.where(
         keep[None, :], jax.vmap(rem_slice)(z_i), 0) if len(iagg_idx) \
         else state["tail_ivals"]
-    new_state["tail_ones"] = jnp.where(keep, rem_slice(
-        jnp.concatenate([jnp.where(jnp.arange(N) < r, state["tail_ones"], 0),
-                         ones_c])), 0)
+    new_state["tail_svals"] = jnp.where(
+        keep[None, :], jax.vmap(rem_slice)(z_s), 0.0) if len(sagg_idx) \
+        else state["tail_svals"]
+    for i in magg_idx:
+        ident = _ident(zm[i].dtype, m_ismin[i])
+        new_state[f"tail_m{i}"] = jnp.where(keep, rem_slice(zm[i]), ident)
+    new_state["tail_ones"] = jnp.where(keep, rem_slice(zo), 0)
     new_state["rem_ts"] = jnp.where(keep, rem_slice(zts), 0)
     for i in value_idx:
         z_p = zproj[i]
         new_state[f"rem_proj_{i}"] = jnp.where(
             keep, rem_slice(z_p), jnp.zeros((), z_p.dtype))
 
-    out = _materialize(specs, value_idx, fagg_idx, iagg_idx, zproj,
-                       sums_f, sums_i, cnts)
-    return new_state, {"out": out, "valid": out_valid, "ts": zts,
-                       "count": full_batches * N}
+    return finish(new_state, sums_f, sums_i, cnts, mins, svars,
+                  ovalid=out_valid, ots=zts, proj=zproj,
+                  count=full_batches * N)
 
 
-def _materialize(specs, value_idx, fagg_idx, iagg_idx, proj,
-                 sums_f, sums_i, cnts):
+def _materialize(specs, value_idx, fagg_idx, iagg_idx, magg_idx, sagg_idx,
+                 proj, sums_f, sums_i, cnts, mins, svars):
     outputs = {}
     for i in value_idx:
         outputs[specs[i].name] = proj[i]
     fpos = {i: p for p, i in enumerate(fagg_idx)}
     ipos = {i: p for p, i in enumerate(iagg_idx)}
+    spos = {i: p for p, i in enumerate(sagg_idx)}
     for i, s in enumerate(specs):
         if s.kind == "value":
             continue
@@ -549,6 +837,10 @@ def _materialize(specs, value_idx, fagg_idx, iagg_idx, proj,
             outputs[s.name] = cnts
         elif s.kind == "sum":
             outputs[s.name] = sums_i[ipos[i]] if s.acc_int else sums_f[fpos[i]]
+        elif s.kind in ("min", "max"):
+            outputs[s.name] = mins[i]
+        elif s.kind == "stdDev":
+            outputs[s.name] = svars[spos[i]]
         else:  # avg (always emitted as double → policy float)
             num = sums_i[ipos[i]].astype(FACC) if s.acc_int \
                 else sums_f[fpos[i]]

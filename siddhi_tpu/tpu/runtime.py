@@ -59,6 +59,15 @@ class DeviceStreamRuntime:
         else:
             self._pending_out.append(out)
 
+    @property
+    def group_collision_count(self) -> int:
+        """Events whose group landed in a bucket owned by a different key
+        (dense-table overflow: >K groups or a hash collision). Non-zero means
+        those events' group aggregates are unreliable — widen
+        ``group_capacity`` or keep the query on the host path."""
+        c = self.state.get("group_collisions")
+        return int(jax.device_get(c)) if c is not None else 0
+
     def block_until_ready(self) -> None:
         jax.tree_util.tree_map(
             lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x,

@@ -251,7 +251,7 @@ def test_unsupported_falls_back_cleanly():
     with pytest.raises(DeviceCompileError):
         DeviceStreamRuntime("""
         define stream S (v double);
-        from S select stdDev(v) as sd insert into O;
+        from S select distinctCount(v) as dc insert into O;
         """)
 
 
@@ -298,3 +298,149 @@ def test_argless_sum_rejected_on_device():
         define stream S (v long);
         from S select sum() as t insert into O;
         """)
+
+
+# ------------------------------------------------------ widened device coverage
+
+APP_MINMAX_LEN = """
+define stream S (sym string, price double, vol long);
+from S[price > 10.0]#window.length(7)
+select sym, min(price) as lo, max(price) as hi, max(vol) as mv
+insert into O;
+"""
+
+
+def test_parity_minmax_length_window():
+    assert_parity(APP_MINMAX_LEN, random_rows(400, 41), batch_capacity=32)
+
+
+def test_parity_minmax_time_window():
+    app = """
+    define stream S (sym string, price double, vol long);
+    from S#window.time(50)
+    select min(price) as lo, max(vol) as hi insert into O;
+    """
+    assert_parity(app, random_rows(300, 42), batch_capacity=64)
+
+
+def test_parity_minmax_length_batch():
+    app = """
+    define stream S (sym string, price double, vol long);
+    from S#window.lengthBatch(5)
+    select min(price) as lo, max(price) as hi insert into O;
+    """
+    assert_parity(app, random_rows(200, 43), batch_capacity=16)
+
+
+def test_parity_stddev_window():
+    app = """
+    define stream S (sym string, price double, vol long);
+    from S#window.length(10)
+    select stdDev(price) as sd, avg(price) as ap insert into O;
+    """
+    assert_parity(app, random_rows(300, 44), batch_capacity=32)
+
+
+def test_parity_stddev_running():
+    app = """
+    define stream S (sym string, price double, vol long);
+    from S select stdDev(price) as sd insert into O;
+    """
+    assert_parity(app, random_rows(400, 45), batch_capacity=64)
+
+
+def test_parity_stddev_group_by():
+    app = """
+    define stream S (sym string, price double, vol long);
+    from S select sym, stdDev(price) as sd group by sym insert into O;
+    """
+    assert_parity(app, random_rows(300, 46), batch_capacity=32)
+
+
+def test_parity_minmax_group_by_and_running():
+    app = """
+    define stream S (sym string, price double, vol long);
+    from S select sym, min(price) as lo, max(vol) as hi group by sym
+    insert into O;
+    """
+    assert_parity(app, random_rows(300, 47), batch_capacity=32)
+    app2 = """
+    define stream S (sym string, price double, vol long);
+    from S select min(price) as lo, max(vol) as hi insert into O;
+    """
+    assert_parity(app2, random_rows(300, 48), batch_capacity=64)
+
+
+def test_parity_multi_key_group_by():
+    app = """
+    define stream S (sym string, price double, vol long);
+    from S select sym, vol, sum(price) as t, count() as c
+    group by sym, vol insert into O;
+    """
+    # bounded group domain: the device group table is a dense K-bucket map —
+    # distinct (sym, vol) pairs must fit (collisions are counted, asserted 0)
+    import random as _r
+    rng = _r.Random(49)
+    rows = [[rng.choice("abcdef"), round(rng.uniform(0, 100), 2),
+             rng.randrange(5)] for _ in range(250)]
+    expected = interpreter_run(app, rows)
+    rt = DeviceStreamRuntime(app, batch_capacity=32, group_capacity=4096)
+    actual = []
+    rt.add_callback(actual.extend)
+    for i, r in enumerate(rows):
+        rt.send(r, timestamp=1000 + i)
+    rt.flush()
+    assert rt.group_collision_count == 0
+    assert len(expected) == len(actual), (len(expected), len(actual))
+    for e, a in zip(expected, actual):
+        assert rows_equal(e, a), (e, a)
+
+
+def test_group_collisions_are_counted():
+    """More distinct groups than buckets: the device path must say so loudly
+    instead of silently conflating groups."""
+    app = """
+    define stream S (k long, v long);
+    from S select k, sum(v) as t group by k insert into O;
+    """
+    rt = DeviceStreamRuntime(app, batch_capacity=64, group_capacity=8)
+    for i in range(64):
+        rt.send([i, 1], timestamp=1000 + i)     # 64 groups, 8 buckets
+    rt.flush()
+    assert rt.group_collision_count > 0
+
+
+def test_parity_having():
+    app = """
+    define stream S (sym string, price double, vol long);
+    from S#window.length(5)
+    select sym, sum(price) as t having t > 150.0 insert into O;
+    """
+    assert_parity(app, random_rows(300, 50), batch_capacity=32)
+
+
+def test_parity_having_group_by():
+    app = """
+    define stream S (sym string, price double, vol long);
+    from S select sym, count() as c group by sym having c > 10 insert into O;
+    """
+    assert_parity(app, random_rows(200, 51), batch_capacity=32)
+
+
+def test_long_group_keys_not_truncated():
+    """LONG group keys beyond int32 must stay distinct groups."""
+    app = """
+    define stream S (k long, v long);
+    from S select k, sum(v) as t group by k insert into O;
+    """
+    big = 4294967297          # 2^32 + 1: truncating to int32 would alias 1
+    rows = [[1, 10], [big, 5], [1, 10], [big, 5]]
+    expected = interpreter_run(app, rows)
+    rt = DeviceStreamRuntime(app, batch_capacity=8)
+    actual = []
+    rt.add_callback(actual.extend)
+    for i, r in enumerate(rows):
+        rt.send(r, timestamp=1000 + i)
+    rt.flush()
+    assert rt.group_collision_count == 0
+    assert actual == expected == [[1, 10], [big, 5], [1, 20], [big, 10]]
