@@ -41,7 +41,9 @@ BASELINE_EVENTS = int(os.environ.get("BENCH_BASELINE_EVENTS", 20_000))
 OFFERED_EVPS = int(os.environ.get("BENCH_OFFERED_EVPS", 1_000_000))
 DEVICE_DEADLINE_S = int(os.environ.get("BENCH_DEVICE_DEADLINE_S", 1500))
 HOST_DEADLINE_S = int(os.environ.get("BENCH_HOST_DEADLINE_S", 600))
-PROBE_DEADLINE_S = int(os.environ.get("BENCH_PROBE_DEADLINE_S", 420))
+PROBE_DEADLINE_S = int(os.environ.get("BENCH_PROBE_DEADLINE_S", 180))
+SMOKE_DEADLINE_S = int(os.environ.get("BENCH_SMOKE_DEADLINE_S", 60))
+DEBUG_LOG = os.path.join(REPO, "BENCH_DEBUG.log")
 
 
 def make_app() -> str:
@@ -103,6 +105,26 @@ def _envelope_percentile(envelopes, q: float) -> float:
 # ---------------------------------------------------------------------------
 # child: device benchmark (runs under the axon/TPU backend)
 # ---------------------------------------------------------------------------
+
+def child_smoke() -> None:
+    """Minimal liveness check: backend init + ONE tiny jitted op. Separates a
+    live-but-slow tunnel (probe timeout, smoke ok) from a dead one."""
+    import time as _t
+    t0 = _t.perf_counter()
+    import jax
+    t_import = _t.perf_counter() - t0
+    t0 = _t.perf_counter()
+    dev = jax.devices()[0]
+    t_init = _t.perf_counter() - t0
+    import jax.numpy as jnp
+    t0 = _t.perf_counter()
+    y = (jnp.ones((8, 8), jnp.float32) + 1.0)
+    y.block_until_ready()
+    t_op = _t.perf_counter() - t0
+    print(json.dumps({"platform": jax.default_backend(), "device": str(dev),
+                      "import_s": round(t_import, 2),
+                      "init_s": round(t_init, 2), "op_s": round(t_op, 2)}))
+
 
 def child_probe() -> None:
     import jax
@@ -298,21 +320,35 @@ def child_host() -> None:
 # parent: orchestration (no jax import — immune to backend-init hangs)
 # ---------------------------------------------------------------------------
 
-def _run_child(mode: str, deadline_s: int, env=None):
+def _debug_log(label: str, text: str) -> None:
+    """Append a child's full stderr to BENCH_DEBUG.log (round-3 policy: every
+    device attempt leaves a diagnosable artifact)."""
+    try:
+        with open(DEBUG_LOG, "a") as f:
+            f.write(f"\n===== {label} @ {time.strftime('%Y-%m-%d %H:%M:%S')} "
+                    f"=====\n{text or '(no stderr)'}\n")
+    except OSError:
+        pass
+
+
+def _run_child(mode: str, deadline_s: int, env=None, label=None):
     """Returns (parsed-json | None, error-string | None)."""
+    label = label or mode
     try:
         p = subprocess.run(
             [sys.executable, os.path.abspath(__file__), mode],
             capture_output=True, text=True, timeout=deadline_s,
             env={**os.environ, **(env or {})}, cwd=REPO)
     except subprocess.TimeoutExpired as e:
-        tail = ""
+        err = ""
         if e.stderr:
             err = e.stderr if isinstance(e.stderr, str) else e.stderr.decode(
                 errors="replace")
-            tail = " | " + " | ".join(err.strip().splitlines()[-4:])
+        _debug_log(f"{label} TIMEOUT({deadline_s}s)", err)
+        tail = (" | " + " | ".join(err.strip().splitlines()[-4:])) if err else ""
         return None, (f"{mode}: deadline {deadline_s}s exceeded "
                       f"(backend hang?){tail}")
+    _debug_log(f"{label} rc={p.returncode}", p.stderr)
     sys.stderr.write(p.stderr[-2000:])
     if p.returncode != 0:
         tail = (p.stderr or "").strip().splitlines()[-6:]
@@ -327,16 +363,33 @@ def _run_child(mode: str, deadline_s: int, env=None):
 
 def main() -> None:
     notes = []
-    # 1) cheap backend probe with its own deadline: a dead tunnel must not
-    #    burn the whole device deadline
-    probe, err = _run_child("--probe-child", PROBE_DEADLINE_S)
-    device = None
-    if probe is None:
-        notes.append(f"device probe failed: {err}")
-    else:
-        device, err = _run_child("--device-child", DEVICE_DEADLINE_S)
-        if device is None:
-            notes.append(f"device bench failed: {err}")
+    try:        # fresh debug log per run
+        open(DEBUG_LOG, "w").close()
+    except OSError:
+        pass
+
+    # 1) smoke: backend init + one tiny op under a short deadline — records
+    #    whether the tunnel is alive at all, independent of the full bench
+    smoke, serr = _run_child("--smoke-child", SMOKE_DEADLINE_S)
+    if smoke is None:
+        notes.append(f"smoke failed: {serr}")
+
+    # 2) probes with escalating deadlines (a slow-to-init tunnel gets three
+    #    chances; each failure is logged to BENCH_DEBUG.log)
+    probe = None
+    for i, dl in enumerate(
+            (PROBE_DEADLINE_S, PROBE_DEADLINE_S * 2, PROBE_DEADLINE_S * 3)):
+        probe, err = _run_child("--probe-child", dl, label=f"probe#{i+1}")
+        if probe is not None:
+            break
+        notes.append(f"device probe attempt {i+1} failed: {err}")
+
+    # 3) the device bench runs EVEN IF every probe failed — the parent is
+    #    hang-proof, so a skip saves nothing and forfeits the round
+    #    (VERDICT r2 item 1). A successful smoke/probe just raises confidence.
+    device, err = _run_child("--device-child", DEVICE_DEADLINE_S)
+    if device is None:
+        notes.append(f"device bench failed: {err}")
 
     host, herr = _run_child("--host-child", HOST_DEADLINE_S,
                             env={"JAX_PLATFORMS": "cpu"})
@@ -344,6 +397,7 @@ def main() -> None:
         notes.append(f"host baseline failed: {herr}")
 
     metric = f"{N_STATES}-state partitioned pattern throughput"
+    smoke_field = smoke if smoke else {"ok": False, "error": serr}
     if device and host:
         out = {
             "metric": metric,
@@ -370,13 +424,16 @@ def main() -> None:
     else:
         out = {"metric": metric, "value": 0, "unit": "events/sec",
                "vs_baseline": 0.0, "device_ok": False}
+    out["smoke"] = smoke_field
     if notes:
         out["notes"] = notes
     print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] == "--probe-child":
+    if len(sys.argv) > 1 and sys.argv[1] == "--smoke-child":
+        child_smoke()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--probe-child":
         child_probe()
     elif len(sys.argv) > 1 and sys.argv[1] == "--device-child":
         child_device()

@@ -19,7 +19,13 @@ from __future__ import annotations
 import logging
 from typing import Optional
 
-from ..query_api import InsertIntoStream, Query, SingleInputStream, StateInputStream
+from ..query_api import (
+    InsertIntoStream,
+    JoinInputStream,
+    Query,
+    SingleInputStream,
+    StateInputStream,
+)
 from ..query_api.annotation import find_annotation
 from .event import EventType, StreamEvent
 
@@ -58,7 +64,7 @@ class DeviceQueryBridge:
         self._out_ts = event.timestamp
         if self.kind == "stream":
             self.runtime.send(event.data, timestamp=event.timestamp)
-        else:
+        else:                       # 'nfa' | 'join': merged multi-stream batch
             self.runtime.send(stream_id, event.data, event.timestamp)
 
     def flush(self) -> None:
@@ -154,11 +160,17 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
 
                 def snapshot_state(self):
                     import jax
-                    return jax.device_get(self.state)
+                    return {"device": jax.device_get(self.state),
+                            "dict": self.compiled.schema.snapshot_dictionaries()}
 
                 def restore_state(self, st):
                     import jax
-                    self.state = jax.device_put(st)
+                    if isinstance(st, dict) and "device" in st:
+                        self.compiled.schema.restore_dictionaries(
+                            st.get("dict", {}))
+                        self.state = jax.device_put(st["device"])
+                    else:       # pre-round-3 snapshot shape
+                        self.state = jax.device_put(st)
 
             rt = _StreamRT()
             bridge = DeviceQueryBridge("stream", rt, app_context,
@@ -183,8 +195,72 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                                        compiler.compiled.stream_ids, target, name)
             bridge.output_schema = ([n for n, _, _ in compiler.out_specs],
                                     [t for _, _, t in compiler.out_specs])
+        elif isinstance(ist, JoinInputStream):
+            from ..tpu.join_compile import CompiledJoinQuery
+            from ..tpu.nfa import MergedBatchBuilder
+
+            ring = int(ann.get("ring") or 1024)
+            joined = int(ann.get("joined") or 2048)
+            compiled = CompiledJoinQuery(
+                query, dict(stream_defs), batch_capacity=batch,
+                ring_capacity=ring, joined_capacity=joined)
+
+            class _JoinRT:
+                def __init__(self):
+                    self.compiled = compiled
+                    self.builder = MergedBatchBuilder(
+                        compiled.merged, batch, dict(stream_defs))
+                    self.state = compiled.init_state()
+                    self.callback = None
+                    self._warned_drops = 0
+
+                def add_callback(self, fn):
+                    self.callback = fn
+
+                def send(self, stream_id, row, timestamp=0):
+                    self.builder.append(stream_id, row, timestamp)
+                    if self.builder.full:
+                        self.flush()
+
+                def flush(self):
+                    if len(self.builder) == 0:
+                        return
+                    b = self.builder.emit()
+                    self.state, out = self.compiled.step(self.state, b)
+                    rows = self.compiled.decode_outputs(out)
+                    drops = int(self.state["join_drops"]) + \
+                        int(self.state["ring_drops"])
+                    if drops > self._warned_drops:
+                        log.warning(
+                            "query '%s': %d joined rows/ring entries dropped "
+                            "(raise @device(joined=/ring=))", name, drops)
+                        self._warned_drops = drops
+                    if self.callback and rows:
+                        self.callback(rows)
+
+                def snapshot_state(self):
+                    import jax
+                    return {"device": jax.device_get(self.state),
+                            "dict": self.compiled.merged.snapshot_dictionaries()}
+
+                def restore_state(self, st):
+                    import jax
+                    if isinstance(st, dict) and "device" in st:
+                        self.compiled.merged.restore_dictionaries(st["dict"])
+                        self.state = jax.device_put(st["device"])
+                    else:       # pre-round-3 snapshot shape
+                        self.state = jax.device_put(st)
+
+            rt = _JoinRT()
+            bridge = DeviceQueryBridge(
+                "join", rt, app_context,
+                [compiled.left_id, compiled.right_id], target, name)
+            bridge.output_schema = ([n for (n, _, t, _) in compiled.out_specs],
+                                    [t for (n, _, t, _) in compiled.out_specs])
         else:
-            raise DeviceCompileError("joins not on device path yet")
+            raise DeviceCompileError(
+                "device path covers single-stream, pattern/sequence, and "
+                "windowed stream-join inputs")
     except DeviceCompileError as e:
         if strict:
             raise

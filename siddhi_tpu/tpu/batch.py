@@ -56,6 +56,36 @@ class StringDictionary:
     def __len__(self) -> int:
         return len(self._values)
 
+    def snapshot(self) -> list:
+        """Code-ordered value table (code 0 = None elided)."""
+        return list(self._values[1:])
+
+    def restore(self, values: list) -> None:
+        self._values = [None] + list(values)
+        self._codes = {v: i + 1 for i, v in enumerate(values)}
+
+
+def snapshot_dictionaries(dictionaries: dict) -> dict:
+    """Serializes a column→dictionary map, emitting each shared dictionary
+    object once (under its first column name)."""
+    out, seen = {}, set()
+    for name, d in dictionaries.items():
+        if id(d) in seen:
+            continue
+        seen.add(id(d))
+        out[name] = d.snapshot()
+    return out
+
+
+def restore_dictionaries(dictionaries: dict, snap: dict) -> None:
+    """Restores in-place; sharing structure comes from the live schema, so
+    each snapshotted table lands in (and via aliasing, propagates to) every
+    column that shares it."""
+    for name, values in snap.items():
+        d = dictionaries.get(name)
+        if d is not None:
+            d.restore(values)
+
 
 @dataclass
 class BatchSchema:
@@ -93,6 +123,12 @@ class BatchSchema:
         if v is None:
             return 0
         return v
+
+    def snapshot_dictionaries(self) -> dict:
+        return snapshot_dictionaries(self.dictionaries)
+
+    def restore_dictionaries(self, snap: dict) -> None:
+        restore_dictionaries(self.dictionaries, snap)
 
 
 class BatchBuilder:

@@ -166,6 +166,16 @@ def _fold_int_vs_float_const(col_fn, op: CompareOp, c: float):
         return lambda cols: jnp.broadcast_to(
             jnp.asarray(v), jnp.shape(col_fn(cols)))
 
+    # non-finite constants (inf from an overflowing literal, NaN) never reach
+    # floor/ceil — fold to the constant truth value (advisor r2 finding)
+    if not math.isfinite(c):
+        if math.isnan(c):
+            return const_bool(op == CompareOp.NEQ)
+        if c > 0:       # +inf: only <, <=, != hold for any finite int
+            return const_bool(
+                op in (CompareOp.LT, CompareOp.LE, CompareOp.NEQ))
+        return const_bool(op in (CompareOp.GT, CompareOp.GE, CompareOp.NEQ))
+
     def ge(bound: int):
         if bound > I64_MAX:
             return const_bool(False)

@@ -524,7 +524,12 @@ class DeviceJoinRuntime:
         return int(jax.device_get(self.state["ring_drops"]))
 
     def snapshot_state(self):
-        return jax.device_get(self.state)
+        return {"device": jax.device_get(self.state),
+                "dict": self.compiler.merged.snapshot_dictionaries()}
 
     def restore_state(self, state) -> None:
-        self.state = jax.device_put(state)
+        if isinstance(state, dict) and "device" in state:
+            self.compiler.merged.restore_dictionaries(state.get("dict", {}))
+            self.state = jax.device_put(state["device"])
+        else:       # pre-round-3 snapshot shape
+            self.state = jax.device_put(state)

@@ -78,6 +78,14 @@ class MergedBatchSchema:
     def col_key(self, stream_id: str, attr: str) -> str:
         return f"s{self.stream_index[stream_id]}_{attr}"
 
+    def snapshot_dictionaries(self) -> dict:
+        from .batch import snapshot_dictionaries
+        return snapshot_dictionaries(self.dictionaries)
+
+    def restore_dictionaries(self, snap: dict) -> None:
+        from .batch import restore_dictionaries
+        restore_dictionaries(self.dictionaries, snap)
+
 
 class MergedBatchBuilder:
     def __init__(self, schema: MergedBatchSchema, capacity: int,
@@ -1033,7 +1041,14 @@ class DeviceNFARuntime:
         return int(jax.device_get(self.state["drops"]))
 
     def snapshot_state(self):
-        return jax.device_get(self.state)
+        # string codes in rings/match tables decode against the dictionary —
+        # it must travel with the device pytree (advisor r2 finding)
+        return {"device": jax.device_get(self.state),
+                "dict": self.compiler.merged.snapshot_dictionaries()}
 
     def restore_state(self, state) -> None:
-        self.state = jax.device_put(state)
+        if isinstance(state, dict) and "device" in state:
+            self.compiler.merged.restore_dictionaries(state.get("dict", {}))
+            self.state = jax.device_put(state["device"])
+        else:       # pre-round-3 snapshot shape
+            self.state = jax.device_put(state)

@@ -218,9 +218,9 @@ class CompiledStreamQuery:
                 raise DeviceCompileError("group key must be string/int")
             self.group_keys.append(key)
             self.group_key_types.append(kt)
-        if self.group_keys and self.window_kind is not None:
+        if self.group_keys and self.window_kind == "lengthBatch":
             raise DeviceCompileError(
-                "group-by with windows not on device path yet")
+                "group-by with lengthBatch windows takes the host path")
 
         # select list
         self.specs: list[_Spec] = []
@@ -282,6 +282,12 @@ class CompiledStreamQuery:
         self.sagg_idx = [i for i, s in enumerate(self.specs)
                          if s.kind == "stdDev"]
         self.agg_idx = [i for i, s in enumerate(self.specs) if s.kind != "value"]
+        if self.group_keys and self.window_kind is not None and \
+                (self.magg_idx or self.sagg_idx):
+            # per-key windowed min/max/stdDev would need a [M,K] sparse table
+            # per lane — not worth the HBM; host path covers it
+            raise DeviceCompileError(
+                "group-by with windowed min/max/stdDev takes the host path")
 
         # having: post-filter over materialized output columns (reference
         # ``QuerySelector``'s havingConditionExecutor)
@@ -322,7 +328,15 @@ class CompiledStreamQuery:
             for i in self.value_idx:
                 state[f"rem_proj_{i}"] = jnp.zeros(
                     (N,), dtype=_JNP_DTYPES[self.specs[i].dtype])
-        if self.group_keys:
+        if self.group_keys and windowed:
+            # windowed group-by carries no per-key sums — aggregates are
+            # recomputed from window contents; only the bucket id per tail
+            # slot and the collision-ownership map persist
+            state["tail_gkey"] = jnp.zeros((N,), dtype=jnp.int32)
+            state["key_owner"] = jnp.zeros((self.K,), dtype=jnp.int64)
+            state["key_owned"] = jnp.zeros((self.K,), dtype=jnp.bool_)
+            state["group_collisions"] = jnp.zeros((), dtype=jnp.int64)
+        elif self.group_keys:
             K = self.K
             state["key_fsums"] = jnp.zeros((AF, K), dtype=FACC)
             state["key_fcomp"] = jnp.zeros((AF, K), dtype=FACC)
@@ -362,6 +376,7 @@ class CompiledStreamQuery:
         window_kind, N = self.window_kind, max(self.window_n, 1)
         window_ms, time_key = self.window_ms, self.time_key
         group_keys = list(self.group_keys)
+        group_key_types = list(self.group_key_types)
         K = self.K
         having_fn = self.having_fn
         mdt = {i: self._mdtype(i) for i in magg_idx}
@@ -389,6 +404,32 @@ class CompiledStreamQuery:
 
             cts = compact(ts)
             proj_c = {i: compact(specs[i].fn(cols)) for i in value_idx}
+
+            def make_keys():
+                """Bucket id [B] + exact packed key [B] for the group-by
+                columns (compacted). Single narrow keys (dictionary codes /
+                small ints) mod K directly — collision-free while #groups<=K;
+                wider combinations avalanche-mix."""
+                k64 = [compact(cols[gk].astype(jnp.int64))
+                       for gk in group_keys]
+                narrow = all(t in (DataType.STRING, DataType.INT)
+                             for t in group_key_types)
+                if len(group_keys) == 1:
+                    packed = k64[0]
+                    if narrow:
+                        keys = ((packed & 0x7FFFFFFFFFFFFFFF) % K).astype(
+                            jnp.int32)
+                    else:
+                        keys = (_avalanche(packed) % K).astype(jnp.int32)
+                elif len(group_keys) == 2 and narrow:
+                    packed = (k64[0] << 32) | (k64[1] & 0xFFFFFFFF)
+                    keys = (_avalanche(packed) % K).astype(jnp.int32)
+                else:
+                    packed = k64[0]
+                    for kx in k64[1:]:
+                        packed = packed * jnp.int64(0x100000001B3) ^ kx
+                    keys = (_avalanche(packed) % K).astype(jnp.int32)
+                return keys, packed
 
             def agg_stack(idx, dt):
                 rows = []
@@ -434,6 +475,45 @@ class CompiledStreamQuery:
                         _time_window_bounds(state, av_f, av_i, av_s, av_m,
                                             magg_idx, ones_c, wts, k, N, B,
                                             window_ms)
+                if group_keys:
+                    # per-key aggregates over the live window range: one-hot
+                    # [M,K] cumulative grids; output j reads its own bucket at
+                    # the range bounds (reference: per-group aggregator map
+                    # fed by CURRENT+EXPIRED window events — here expiry is
+                    # the range lower bound, no retraction needed)
+                    keys_b, packed = make_keys()
+                    zk = jnp.concatenate([state["tail_gkey"], keys_b])
+                    sums_f = _keyed_range_sums(z_f, zk, K, lo, j, keys_b)
+                    sums_i = _keyed_range_sums(z_i, zk, K, lo, j, keys_b)
+                    ohz = jax.nn.one_hot(zk, K, dtype=jnp.int32) \
+                        * zo[:, None]
+                    csk = jnp.concatenate(
+                        [jnp.zeros((1, K), jnp.int32),
+                         jnp.cumsum(ohz, axis=0)])
+                    cnts = (csk[j + 1, keys_b] - csk[lo, keys_b]).astype(
+                        jnp.int64)
+                    new_state["tail_gkey"] = jax.lax.dynamic_slice(
+                        zk, (k,), (N,))
+                    # collision accounting (carried ownership, same policy as
+                    # the unwindowed dense table)
+                    onehot_b = (jax.nn.one_hot(keys_b, K, dtype=jnp.int32)
+                                * out_valid[:, None].astype(jnp.int32))
+                    first_occ = (jnp.cumsum(onehot_b, axis=0) == 1) & \
+                        onehot_b.astype(bool)
+                    batch_first = jnp.sum(
+                        jnp.where(first_occ, packed[:, None], 0), axis=0)
+                    owned = state["key_owned"]
+                    claimed = jnp.where(owned, state["key_owner"],
+                                        batch_first)
+                    coll = out_valid & (packed != claimed[keys_b])
+                    new_state["key_owner"] = claimed
+                    new_state["key_owned"] = owned | jnp.any(
+                        first_occ, axis=0)
+                    new_state["group_collisions"] = \
+                        state["group_collisions"] + jnp.sum(
+                            coll.astype(jnp.int64))
+                    return finish(new_state, sums_f, sums_i, cnts, {},
+                                  jnp.zeros((0, B), FACC))
                 sums_f = _range_sums(z_f, lo, j)
                 sums_i = _range_sums(z_i, lo, j)
                 cso = jnp.concatenate(
@@ -451,33 +531,11 @@ class CompiledStreamQuery:
                                      cts, k, N, B, finish)
 
             if group_keys:
-                # exact packed key (for collision detection) + bucket id.
-                # Single keys: direct mod K is collision-free for dense
-                # dictionary codes / small ints. Two 32-bit keys pack exactly
-                # into int64; anything wider FNV64-mixes (detection then
-                # relies on 64-bit hash uniqueness). A bucket claimed by a
-                # different packed key is COUNTED (group_collisions) — loud,
-                # bounded-table overflow policy like window/slot drops.
-                k64 = [compact(cols[gk].astype(jnp.int64))
-                       for gk in group_keys]
-                narrow = all(t in (DataType.STRING, DataType.INT)
-                             for t in self.group_key_types)
-                if len(group_keys) == 1:
-                    packed = k64[0]
-                    if narrow:      # dense dictionary codes / small ints:
-                        # direct mod is collision-free while #groups <= K
-                        keys = ((packed & 0x7FFFFFFFFFFFFFFF) % K).astype(
-                            jnp.int32)
-                    else:           # LONG: arbitrary magnitudes, spread them
-                        keys = (_avalanche(packed) % K).astype(jnp.int32)
-                elif len(group_keys) == 2 and narrow:
-                    packed = (k64[0] << 32) | (k64[1] & 0xFFFFFFFF)
-                    keys = (_avalanche(packed) % K).astype(jnp.int32)
-                else:
-                    packed = k64[0]
-                    for kx in k64[1:]:
-                        packed = packed * jnp.int64(0x100000001B3) ^ kx
-                    keys = (_avalanche(packed) % K).astype(jnp.int32)
+                # exact packed key (for collision detection) + bucket id —
+                # see make_keys(). A bucket claimed by a different packed key
+                # is COUNTED (group_collisions) — loud, bounded-table
+                # overflow policy like window/slot drops.
+                keys, packed = make_keys()
                 onehot = (jax.nn.one_hot(keys, K, dtype=jnp.int32)
                           * out_valid[:, None].astype(jnp.int32))     # [B,K]
                 first_occ = (jnp.cumsum(onehot, axis=0) == 1) & \
@@ -683,6 +741,23 @@ def _range_sums(z, lo, j):
     cs = jnp.concatenate(
         [jnp.zeros((z.shape[0], 1), z.dtype), jnp.cumsum(z, axis=1)], axis=1)
     return cs[:, j + 1] - cs[:, lo]
+
+
+def _keyed_range_sums(z, zk, K, lo, j, keys_b):
+    """Per-key sums over inclusive ranges [lo, j]: one-hot [M,K] cumulative
+    grid per lane; output event b reads its own bucket column at both range
+    bounds. O(M·K) HBM per lane — the windowed-group-by trade for zero
+    retraction bookkeeping."""
+    if not z.shape[0]:
+        return jnp.zeros((0, j.shape[0]), z.dtype)
+    oh = jax.nn.one_hot(zk, K, dtype=z.dtype)                  # [M, K]
+    outs = []
+    for a in range(z.shape[0]):
+        cs = jnp.concatenate(
+            [jnp.zeros((1, K), z.dtype),
+             jnp.cumsum(oh * z[a][:, None], axis=0)])
+        outs.append(cs[j + 1, keys_b] - cs[lo, keys_b])
+    return jnp.stack(outs)
 
 
 def _window_svars(z_s, zo, lo, j, cnts, k, N, B):

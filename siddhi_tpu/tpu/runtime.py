@@ -73,9 +73,14 @@ class DeviceStreamRuntime:
             lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x,
             self.state)
 
-    # -- checkpointing: state is a pytree --------------------------------------
+    # -- checkpointing: state is a pytree + the string dictionary ------------
     def snapshot_state(self) -> dict:
-        return jax.device_get(self.state)
+        return {"device": jax.device_get(self.state),
+                "dict": self.compiled.schema.snapshot_dictionaries()}
 
     def restore_state(self, state) -> None:
-        self.state = jax.device_put(state)
+        if isinstance(state, dict) and "device" in state:
+            self.compiled.schema.restore_dictionaries(state.get("dict", {}))
+            self.state = jax.device_put(state["device"])
+        else:       # pre-round-3 snapshot shape
+            self.state = jax.device_put(state)

@@ -186,7 +186,7 @@ def test_external_time_out_of_order_clamped_and_counted():
     for v, ets in [(1, 1000), (1, 1100), (2, 1050), (3, 1120)]:
         drt.send([v, ets], timestamp=ets)
     drt.flush()
-    st = drt.snapshot_state()
+    st = drt.snapshot_state()["device"]
     assert int(st["ts_regressions"]) == 1
     # clamped semantics: 1000 expires at 1100; the 1050 event is treated as
     # arriving at the running max (1100) so it joins that window; at 1120
@@ -214,7 +214,7 @@ def test_time_window_drop_counter():
     for i in range(64):
         drt.send([1], timestamp=1000 + i)
     drt.flush()
-    drops = int(drt.snapshot_state()["window_drops"])
+    drops = int(drt.snapshot_state()["device"]["window_drops"])
     assert drops > 0
 
 
@@ -444,3 +444,81 @@ def test_long_group_keys_not_truncated():
     rt.flush()
     assert rt.group_collision_count == 0
     assert actual == expected == [[1, 10], [big, 5], [1, 20], [big, 10]]
+
+
+# ---------------------------------------------------------------------------
+# windowed group-by (VERDICT r2 item 3: BASELINE config #4 aggregation shape)
+# ---------------------------------------------------------------------------
+
+APP_GB_LENGTH = """
+define stream S (k string, v long);
+from S#window.length(10) select k, sum(v) as t, count() as c, avg(v) as a
+group by k insert into O;
+"""
+
+
+def test_parity_group_by_length_window():
+    rng = random.Random(60)
+    rows = [[rng.choice("abc"), rng.randrange(100)] for _ in range(300)]
+    assert_parity(APP_GB_LENGTH, rows, batch_capacity=16)
+
+
+def test_parity_group_by_length_window_small_batches():
+    rng = random.Random(61)
+    rows = [[rng.choice("abcde"), rng.randrange(100)] for _ in range(150)]
+    assert_parity(APP_GB_LENGTH, rows, batch_capacity=3)
+
+
+def test_parity_group_by_time_window():
+    app = """
+    define stream S (k string, v long);
+    from S#window.time(25) select k, sum(v) as t, count() as c
+    group by k insert into O;
+    """
+    rng = random.Random(62)
+    rows = [[rng.choice("ab"), rng.randrange(50)] for _ in range(200)]
+    assert_parity(app, rows, batch_capacity=16)
+
+
+def test_parity_group_by_window_filter_and_having():
+    app = """
+    define stream S (k string, v long);
+    from S[v > 20]#window.length(8)
+    select k, sum(v) as t group by k having t > 300 insert into O;
+    """
+    rng = random.Random(63)
+    rows = [[rng.choice("abcd"), rng.randrange(100)] for _ in range(250)]
+    assert_parity(app, rows, batch_capacity=16)
+
+
+def test_parity_group_by_window_double_sum():
+    app = """
+    define stream S (k string, v double);
+    from S#window.length(6) select k, sum(v) as t, avg(v) as a
+    group by k insert into O;
+    """
+    rng = random.Random(64)
+    rows = [[rng.choice("ab"), round(rng.uniform(0, 10), 2)]
+            for _ in range(120)]
+    assert_parity(app, rows, batch_capacity=8)
+
+
+def test_parity_multi_key_group_by_window():
+    app = """
+    define stream S (k string, g string, v long);
+    from S#window.length(12) select k, g, sum(v) as t
+    group by k, g insert into O;
+    """
+    rng = random.Random(65)
+    rows = [[rng.choice("ab"), rng.choice("xy"), rng.randrange(100)]
+            for _ in range(200)]
+    assert_parity(app, rows, batch_capacity=16)
+
+
+def test_group_by_windowed_minmax_falls_back():
+    with pytest.raises(DeviceCompileError):
+        DeviceStreamRuntime("""
+        define stream S (k string, v long);
+        from S#window.length(5) select k, min(v) as m
+        group by k insert into O;
+        """)
