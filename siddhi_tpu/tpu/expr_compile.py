@@ -109,6 +109,7 @@ def compile_expression(expr: Expression, resolver: ColumnResolver
     if isinstance(expr, MathExpr):
         lf, lt = compile_expression(expr.left, resolver)
         rf, rt = compile_expression(expr.right, resolver)
+        _check_long_float_mix(lt, rt, expr.left, expr.right)
         rtype = promote(lt, rt)
         op = expr.op
         int_result = rtype in (DataType.INT, DataType.LONG)
@@ -151,6 +152,24 @@ def compile_expression(expr: Expression, resolver: ColumnResolver
 _FLIP = {CompareOp.LT: CompareOp.GT, CompareOp.GT: CompareOp.LT,
          CompareOp.LE: CompareOp.GE, CompareOp.GE: CompareOp.LE,
          CompareOp.EQ: CompareOp.EQ, CompareOp.NEQ: CompareOp.NEQ}
+
+_F32_EXACT_INT = 2 ** 24      # |v| ≤ 2^24 round-trips int↔float32 exactly
+
+
+def _check_long_float_mix(lt: DataType, rt: DataType, left: Expression,
+                          right: Expression) -> None:
+    """LONG mixed with a non-constant FLOAT/DOUBLE casts the int64 side to
+    f32, which misfires above 2^24 — the reference promotes to double (exact
+    to 2^53). Fall back to the host path unless the LONG side is a constant
+    small enough to be exact in f32 (advisor r2 finding)."""
+    floats = (DataType.FLOAT, DataType.DOUBLE)
+    for t, other_t, e in ((lt, rt, left), (rt, lt, right)):
+        if t == DataType.LONG and other_t in floats:
+            if isinstance(e, Constant) and abs(int(e.value)) <= _F32_EXACT_INT:
+                continue
+            raise DeviceCompileError(
+                "long vs non-constant float loses exactness above 2^24 on "
+                "device (f64 banned) — host path")
 
 
 def _fold_int_vs_float_const(col_fn, op: CompareOp, c: float):
@@ -244,6 +263,7 @@ def _compile_compare(expr: Compare, resolver: ColumnResolver):
     # numeric compares: pin both sides to the promoted policy dtype so mixed
     # int64/float32 operands never promote to float64 (string codes and bools
     # already share one dtype per side)
+    _check_long_float_mix(lt, rt, expr.left, expr.right)
     cmp_dt = _policy_dtype(promote(lt, rt)) \
         if lt in _NUM_ORDER and rt in _NUM_ORDER and lt != rt else None
 

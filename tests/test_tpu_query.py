@@ -290,6 +290,31 @@ def test_long_vs_float_constant_compare_exact():
     assert [r[0] for r in actual] == [big, 16777218]
 
 
+def test_long_vs_nonconstant_float_falls_back():
+    """LONG mixed with a non-constant float column would cast int64→f32 and
+    misfire above 2^24 — must take the host path (advisor r2 finding)."""
+    with pytest.raises(DeviceCompileError):
+        DeviceStreamRuntime("""
+        define stream S (v long, f double);
+        from S[v > f] select v insert into O;
+        """)
+    with pytest.raises(DeviceCompileError):
+        DeviceStreamRuntime("""
+        define stream S (v long, f double);
+        from S select v + f as t insert into O;
+        """)
+    # a LONG constant exact in f32 stays on device; one above 2^24 falls back
+    DeviceStreamRuntime("""
+    define stream S (f double);
+    from S[f > 100L] select f insert into O;
+    """)
+    with pytest.raises(DeviceCompileError):
+        DeviceStreamRuntime("""
+        define stream S (f double);
+        from S[f > 16777218L] select f insert into O;
+        """)
+
+
 def test_argless_sum_rejected_on_device():
     import pytest as _pytest
     from siddhi_tpu.tpu import DeviceCompileError as _DCE
