@@ -33,7 +33,14 @@ sys.path.insert(0, REPO)
 
 N_STATES = int(os.environ.get("BENCH_STATES", 8))
 N_PARTITIONS = int(os.environ.get("BENCH_PARTITIONS", 64))
-LANE_BATCH = int(os.environ.get("BENCH_LANE_BATCH", 512))
+LANE_BATCH = int(os.environ.get("BENCH_LANE_BATCH", 1024))
+# latency mode runs deadline-flush windows (~WINDOW events per step spread
+# over partially-filled lanes); a right-sized lane batch keeps the static
+# step cost proportional to the window instead of paying full-throughput
+# shapes for quarter-filled lanes
+LAT_WINDOW = int(os.environ.get("BENCH_LAT_WINDOW", 8192))
+LAT_LANE_BATCH = int(os.environ.get(
+    "BENCH_LAT_LANE_BATCH", max(64, 2 * LAT_WINDOW // N_PARTITIONS)))
 SLOT_CAP = int(os.environ.get("BENCH_SLOT_CAP", 64))
 N_DEVICES_KEYS = 256          # distinct device ids in the synthetic stream
 DEVICE_EVENTS = int(os.environ.get("BENCH_EVENTS", 1_000_000))
@@ -148,6 +155,23 @@ def child_device() -> None:
         make_app(), num_partitions=N_PARTITIONS, key_attr="dev",
         slot_capacity=SLOT_CAP, lane_batch=LANE_BATCH, mesh=None)
 
+    def _stack_lanes(batches, first_idx, last_idx, count=None):
+        """Lane batches (wire format) → one [P, ...] device feed."""
+        return {
+            "cols": {k: np.stack([bt["cols"][k] for bt in batches])
+                     for k in batches[0]["cols"]},
+            "tag": np.stack([bt["tag"] for bt in batches]),
+            "ts": np.stack([bt["ts"] for bt in batches]),
+            "ts_base": np.array([bt["ts_base"] for bt in batches],
+                                dtype=np.int64),
+            "counts": np.array([bt["count"] for bt in batches],
+                               dtype=np.int32),
+            "count": count if count is not None
+                     else sum(int(bt["count"]) for bt in batches),
+            "first_idx": first_idx,     # oldest event in the batch
+            "last_idx": last_idx,       # newest event in the batch
+        }
+
     # pre-pack all batches host-side (steady state: the async ingress overlaps
     # packing with device compute; here we time the device path itself)
     lane_rows: dict = {i: [] for i in range(N_PARTITIONS)}
@@ -174,19 +198,21 @@ def child_device() -> None:
             pos[lane] = p + take
             done += take
             batches.append(b.emit())
-        packed.append({
-            "cols": {k: np.stack([bt["cols"][k] for bt in batches])
-                     for k in batches[0]["cols"]},
-            "tag": np.stack([bt["tag"] for bt in batches]),
-            "ts": np.stack([bt["ts"] for bt in batches]),
-            "valid": np.stack([bt["valid"] for bt in batches]),
-            "count": sum(int(bt["count"]) for bt in batches),
-            "first_idx": first_idx,     # oldest event in the batch
-            "last_idx": last_idx,       # newest event in the batch
-        })
+        packed.append(_stack_lanes(batches, first_idx, last_idx))
+
+    def _run_once(rt_, state, b):
+        return rt_.vstep(state, b["cols"], b["tag"], b["ts"], b["ts_base"],
+                         b["counts"])
 
     def run_once(state, b):
-        return rt.vstep(state, b["cols"], b["tag"], b["ts"], b["valid"])
+        return _run_once(rt, state, b)
+
+    def fence(state) -> int:
+        """Forces real completion. ``block_until_ready`` does NOT reliably
+        wait under the axon tunnel (measured round 3: a 30-matmul chain
+        "blocked" in 0.1ms but device_get took 2.7s) — every timing boundary
+        must fetch device data instead."""
+        return int(np.sum(jax.device_get(state["matches"])))
 
     def _pack_windowed(rt, evs, window):
         """Contiguous-arrival windows → padded lane batches (deadline-flush
@@ -202,22 +228,26 @@ def child_device() -> None:
                 b.append("S", [dev, v], ts)
                 n += 1
             batches = [b.emit() for b in rt.builders]
-            out.append({
-                "cols": {k: np.stack([bt["cols"][k] for bt in batches])
-                         for k in batches[0]["cols"]},
-                "tag": np.stack([bt["tag"] for bt in batches]),
-                "ts": np.stack([bt["ts"] for bt in batches]),
-                "valid": np.stack([bt["valid"] for bt in batches]),
-                "count": n,
-                "first_idx": s,
-                "last_idx": s + n - 1,
-            })
+            out.append(_stack_lanes(batches, s, s + n - 1, count=n))
             s += n
         return out
 
     # warmup / compile
     state, ys = run_once(rt.state, packed[0])
-    jax.block_until_ready(state)
+    fence(state)
+
+    # tunnel round-trip cost (d2h of one scalar): reported so step-time can be
+    # read net of transport latency
+    t0 = time.perf_counter()
+    fence(state)
+    roundtrip_s = time.perf_counter() - t0
+
+    # steady-state single-step time, fenced (VERDICT r2 item 2: record the
+    # measured step time)
+    t0 = time.perf_counter()
+    state, ys = run_once(state, packed[0])
+    fence(state)
+    step_s = time.perf_counter() - t0
 
     # ---- throughput: unthrottled steady-state rate (fresh state: the warmup
     # replayed batch 0, which must not double-count into matches/drops)
@@ -227,13 +257,14 @@ def child_device() -> None:
     for b in packed:
         state, ys = run_once(state, b)
         n_ev += b["count"]
-    jax.block_until_ready(state)
+    matches = fence(state)              # real completion, not block_until_ready
     dt = time.perf_counter() - t0
     rate = n_ev / dt
-    matches = int(np.sum(jax.device_get(state["matches"])))
     drops = int(np.sum(jax.device_get(state["drops"])))
     print(f"# device: {n_ev} events in {dt:.3f}s -> {rate:,.0f} ev/s, "
-          f"{matches} matches, {drops} dropped partials", file=sys.stderr)
+          f"{matches} matches, {drops} dropped partials "
+          f"(step={step_s*1e3:.1f}ms roundtrip={roundtrip_s*1e3:.1f}ms)",
+          file=sys.stderr)
 
     # ---- p99 detection latency at the offered rate (BASELINE.json metric:
     # events/sec/chip + p99 detection latency @ 1M ev/s).
@@ -243,29 +274,40 @@ def child_device() -> None:
     # ingress flushes on deadline — holding lanes until full would make tail
     # latency depend on key skew, not on the engine. Event i "arrives" at
     # base + i/λ; a window is released when its newest event has arrived;
-    # per-event latency = batch completion − its own arrival.
-    window = max(256, N_PARTITIONS * LANE_BATCH // 4)
-    lat_events = events[: min(len(events), window * 64)]
-    wpacked = _pack_windowed(rt, lat_events, window)
+    # per-event latency = batch completion − its own arrival. A separate
+    # runtime with latency-sized lane batches keeps the static step shapes
+    # proportional to the window.
+    window = LAT_WINDOW
+    lrt = PartitionedNFARuntime(
+        make_app(), num_partitions=N_PARTITIONS, key_attr="dev",
+        slot_capacity=SLOT_CAP, lane_batch=LAT_LANE_BATCH, mesh=None)
 
-    # capacity in this mode (partial fill costs the full-batch step time)
-    state2 = rt.init_state()
+    def lrun_once(state, b):
+        return _run_once(lrt, state, b)
+
+    lat_events = events[: min(len(events), window * 64)]
+    wpacked = _pack_windowed(lrt, lat_events, window)
+
+    # warmup/compile the latency shapes, then measure capacity in this mode
+    lstate, ys = lrun_once(lrt.state, wpacked[0])
+    fence(lstate)
+    state2 = lrt.init_state()
     t0 = time.perf_counter()
     for b in wpacked[:8]:
-        state2, ys = run_once(state2, b)
-    jax.block_until_ready(state2)
+        state2, ys = lrun_once(state2, b)
+    fence(state2)
     wrate = sum(b["count"] for b in wpacked[:8]) / (time.perf_counter() - t0)
 
     lam = min(OFFERED_EVPS, wrate * 0.8)    # don't model an overloaded queue
-    state2 = rt.init_state()
+    state2 = lrt.init_state()
     base = time.perf_counter()
     envelopes = []      # (lo_latency, hi_latency, n_events) per batch
     for b in wpacked:
         release = base + (b["last_idx"] + 1) / lam
         while time.perf_counter() < release:
             pass
-        state2, ys = run_once(state2, b)
-        jax.block_until_ready(ys["mask"])
+        state2, ys = lrun_once(state2, b)
+        jax.device_get(ys["mask"])      # serving path: outputs ON HOST
         fin = time.perf_counter()
         # arrivals are linear in index and the window is contiguous, so the
         # batch's event latencies span [fin − arr(newest), fin − arr(oldest)]
@@ -284,6 +326,9 @@ def child_device() -> None:
         "rate": rate, "matches": matches, "drops": drops,
         "p50_ms": round(p50, 3), "p99_ms": round(p99, 3),
         "offered_evps": round(lam),
+        "step_ms": round(step_s * 1e3, 3),
+        "roundtrip_ms": round(roundtrip_s * 1e3, 3),
+        "fence": "device_get",
         "platform": jax.default_backend(),
     }))
 
@@ -407,6 +452,9 @@ def main() -> None:
             "p99_detection_latency_ms": device["p99_ms"],
             "p50_detection_latency_ms": device["p50_ms"],
             "offered_evps": device["offered_evps"],
+            "device_step_ms": device.get("step_ms"),
+            "tunnel_roundtrip_ms": device.get("roundtrip_ms"),
+            "timing_fence": device.get("fence"),
             "platform": device.get("platform"),
             "device_ok": True,
             "baseline": "repo host interpreter (single-threaded Python; "

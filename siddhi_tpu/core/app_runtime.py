@@ -137,6 +137,14 @@ class SiddhiAppRuntime:
         # streams + junctions (+ fault streams)
         for sd in app.stream_definitions.values():
             self._get_junction(sd.id, define=sd)
+            async_ann = find_annotation(sd.annotations, "async")
+            if async_ann is not None:
+                # Disruptor-mode analog (StreamJunction.java:279-316):
+                # producers enqueue, workers deliver under the engine lock
+                self.ctx.stream_junctions[sd.id].enable_async(
+                    buffer_size=int(async_ann.get("buffer.size") or 1024),
+                    workers=int(async_ann.get("workers") or 1),
+                    batch_size_max=int(async_ann.get("batch.size.max") or 64))
             onerror = find_annotation(sd.annotations, "OnError")
             if onerror is not None:
                 action = (onerror.get("action") or "log").lower()
@@ -360,6 +368,9 @@ class SiddhiAppRuntime:
         if self._started:
             return
         self._started = True
+        for j in self.ctx.stream_junctions.values():
+            if j.dispatcher is not None:
+                j.dispatcher.start()
         for rt in self.query_runtimes.values():
             rt.start()
         for tr in self.trigger_runtimes:
@@ -371,7 +382,14 @@ class SiddhiAppRuntime:
             self.ctx.ticker.start()
 
     def shutdown(self) -> None:
+        self.drain_async()           # deliver queued async events
         self.flush_device()          # drain partially-filled device batches
+        for j in self.ctx.stream_junctions.values():
+            if j.dispatcher is not None:
+                j.dispatcher.stop()
+        for b in self.device_bridges:
+            if b.driver is not None:
+                b.driver.stop()
         for src in self.sources:
             src.disconnect()
         for sink in self.sinks:
@@ -379,6 +397,13 @@ class SiddhiAppRuntime:
         if self.ctx.ticker is not None:
             self.ctx.ticker.stop()
         self._started = False
+
+    def drain_async(self) -> None:
+        """Quiesce async junction dispatchers (ThreadBarrier analog). Must be
+        called WITHOUT holding root_lock."""
+        for j in self.ctx.stream_junctions.values():
+            if j.dispatcher is not None:
+                j.dispatcher.quiesce()
 
     # -- time (playback) ------------------------------------------------------
     def advance_time(self, ts: int) -> None:
@@ -392,21 +417,57 @@ class SiddhiAppRuntime:
             b.flush()
 
     # -- snapshots ------------------------------------------------------------
+    def _pre_snapshot(self) -> None:
+        """Quiesce async machinery so state walks see a stable engine (the
+        reference locks ThreadBarrier). Runs WITHOUT root_lock."""
+        self.drain_async()
+        for b in self.device_bridges:
+            if b.driver is not None:
+                b.driver.flush_sync()
+                b.driver.pause()
+
+    def _post_snapshot(self) -> None:
+        for b in self.device_bridges:
+            if b.driver is not None:
+                b.driver.resume()
+
     def snapshot(self) -> bytes:
-        return self.snapshot_service.full_snapshot()
+        self._pre_snapshot()
+        try:
+            return self.snapshot_service.full_snapshot()
+        finally:
+            self._post_snapshot()
 
     def restore(self, blob: bytes) -> None:
-        self.snapshot_service.restore(blob)
-        self.persistence.invalidate_chain()
+        # quiesce + pause async machinery: a device worker step in flight
+        # would otherwise overwrite the freshly restored device state
+        self._pre_snapshot()
+        try:
+            self.snapshot_service.restore(blob)
+            self.persistence.invalidate_chain()
+        finally:
+            self._post_snapshot()
 
     def persist(self) -> str:
-        return self.persistence.persist()
+        self._pre_snapshot()
+        try:
+            return self.persistence.persist()
+        finally:
+            self._post_snapshot()
 
     def restore_revision(self, revision: str) -> None:
-        self.persistence.restore_revision(revision)
+        self._pre_snapshot()
+        try:
+            self.persistence.restore_revision(revision)
+        finally:
+            self._post_snapshot()
 
     def restore_last_revision(self) -> Optional[str]:
-        return self.persistence.restore_last_revision()
+        self._pre_snapshot()
+        try:
+            return self.persistence.restore_last_revision()
+        finally:
+            self._post_snapshot()
 
     def clear_all_revisions(self) -> None:
         self.persistence.clear_all_revisions()

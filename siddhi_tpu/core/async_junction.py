@@ -1,0 +1,169 @@
+"""Async event dispatch: the Disruptor-mode analog for stream junctions.
+
+Reference: ``StreamJunction.startProcessing`` (``stream/StreamJunction.java:279-316``)
+spins up an LMAX Disruptor ring buffer when a stream is annotated
+``@async(buffer.size='..', workers='..', batch.size.max='..')``; producers
+publish into the ring and worker threads drain it into the receiver chain.
+
+TPU-native redesign: the engine is batch-synchronous — processors are not
+locked individually; instead ONE app-level lock (``SiddhiAppContext.root_lock``)
+guards all host engine state, and the async dispatcher decouples *producers*
+from *delivery*:
+
+- ``send()`` enqueues into a bounded buffer and returns (multi-threaded
+  producers are safe — enqueue is under a queue mutex, not the engine lock);
+- worker threads drain events in ``batch.size.max`` chunks and deliver them
+  under ``root_lock`` (single-writer engine semantics preserved);
+- backpressure: a full buffer blocks the producer briefly; if the buffer stays
+  full (e.g. the producer itself holds ``root_lock``, so draining can't
+  progress) the put *grows the queue* instead of deadlocking and counts the
+  overflow — the gauge surfaces sizing problems, the engine never wedges;
+- ``quiesce()`` waits for empty-queue + idle-workers: the ``ThreadBarrier``
+  analog used by snapshot/persist and shutdown.
+
+Delivery holds the engine lock, so with ``workers > 1`` host-side processing
+is still serialized (the win is producer decoupling); device-offloaded queries
+additionally overlap packing with device compute via ``AsyncDeviceDriver``
+(``device_bridge.py``), where the expensive step runs *outside* the lock.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+from typing import Optional
+
+log = logging.getLogger("siddhi_tpu.async")
+
+# how long a producer waits on a full buffer before growing it instead
+# (deadlock-proof backpressure: the producer may hold root_lock, which the
+# drain path needs)
+_FULL_WAIT_S = 0.2
+
+
+class AsyncDispatcher:
+    """Bounded multi-producer buffer + worker threads for one junction."""
+
+    def __init__(self, junction, app_context, buffer_size: int = 1024,
+                 workers: int = 1, batch_size_max: int = 64):
+        self.junction = junction
+        self.app_context = app_context
+        self.buffer_size = max(1, buffer_size)
+        self.workers = max(1, workers)
+        self.batch_size_max = max(1, batch_size_max)
+
+        self._q: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._busy = 0                      # workers currently delivering
+        self._stopped = False
+        self._started = False
+        self._threads: list[threading.Thread] = []
+
+        # observability (BufferedEventsTracker analog,
+        # ``StreamJunction.getBufferedEvents:359``)
+        self.total_enqueued = 0
+        self.high_water = 0
+        self.soft_overflows = 0             # puts that grew past buffer_size
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        with self._cv:          # idempotent under concurrent first sends
+            if self._started:
+                return
+            self._started = True
+        for i in range(self.workers):
+            t = threading.Thread(
+                target=self._run, name=f"async-{self.junction.definition.id}-{i}",
+                daemon=True)
+            self._threads.append(t)
+            t.start()
+
+    def stop(self) -> None:
+        """Drain, then stop workers (reference shuts the disruptor down after
+        a final drain)."""
+        if not self._started:
+            return
+        self.quiesce()
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads.clear()
+        self._started = False
+        self._stopped = False
+
+    # -- producer side -------------------------------------------------------
+    @property
+    def buffered_events(self) -> int:
+        return len(self._q)
+
+    def enqueue(self, item) -> None:
+        """item: ('event', StreamEvent) | ('chunk', list[StreamEvent])."""
+        if not self._started:
+            self.start()
+        with self._cv:
+            if len(self._q) >= self.buffer_size:
+                self._cv.wait(timeout=_FULL_WAIT_S)
+                if len(self._q) >= self.buffer_size:
+                    self.soft_overflows += 1
+            self._q.append(item)
+            self.total_enqueued += 1
+            if len(self._q) > self.high_water:
+                self.high_water = len(self._q)
+            self._cv.notify()
+
+    # -- worker side ---------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._stopped:
+                    self._cv.wait(timeout=0.5)
+                if self._stopped and not self._q:
+                    return
+                batch = []
+                while self._q and len(batch) < self.batch_size_max:
+                    batch.append(self._q.popleft())
+                self._busy += 1
+                self._cv.notify_all()       # wake producers blocked on full
+            try:
+                self._deliver(batch)
+            except Exception:  # noqa: BLE001 — junction isolates per-receiver;
+                # anything escaping here is a bug, but a worker must survive
+                log.exception("async delivery failed on stream '%s'",
+                              self.junction.definition.id)
+            finally:
+                with self._cv:
+                    self._busy -= 1
+                    self._cv.notify_all()   # wake quiesce() waiters
+
+    def _deliver(self, batch: list) -> None:
+        with self.app_context.root_lock:
+            for kind, payload in batch:
+                if kind == "chunk":
+                    # watermark to the chunk's first timestamp before delivery,
+                    # the rest after (InputHandler chunk-send semantics)
+                    self.app_context.advance_time(
+                        min(ev.timestamp for ev in payload))
+                    self.junction.deliver_events(payload)
+                    self.app_context.advance_time(
+                        max(ev.timestamp for ev in payload))
+                else:
+                    self.app_context.advance_time(payload.timestamp)
+                    self.junction.deliver_event(payload)
+
+    # -- barrier (ThreadBarrier analog) --------------------------------------
+    def quiesce(self, timeout: float = 30.0) -> bool:
+        """Block until the buffer is empty and all workers are idle. Called by
+        snapshot/persist (the reference quiesces ingress with ThreadBarrier
+        before walking state) and by shutdown."""
+        import time
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._q or self._busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(timeout=min(remaining, 0.5))
+        return True

@@ -32,21 +32,199 @@ from .event import EventType, StreamEvent
 log = logging.getLogger("siddhi_tpu.device")
 
 
+class AsyncDeviceDriver:
+    """Overlaps host-side micro-batch packing with device compute.
+
+    The VERDICT-named analog of the reference's ``@async`` Disruptor mode for
+    the device path (``StreamJunction.java:279-316``): the producer (junction
+    thread, under the engine lock) packs events into the runtime's builder;
+    full batches are handed to this driver's queue; ONE device worker steps
+    them (``rt.process`` — device state is owned by the worker, no engine lock
+    needed) and then delivers decoded rows back into the engine under the
+    lock. Steady state: the device computes batch N while the engine packs
+    batch N+1.
+    """
+
+    def __init__(self, rt, app_context, depth: int = 4):
+        import collections
+        import threading
+        self.rt = rt
+        self.app_context = app_context
+        self.depth = max(1, depth)
+        self._q = collections.deque()
+        self._cv = threading.Condition()
+        self._stepping = False           # device state mutation in flight
+        self._busy = False               # step OR delivery in flight
+        self._paused = False
+        self._stopped = False
+        self.batches_stepped = 0
+        self.step_seconds = 0.0          # cumulative device busy time
+        self._thread = threading.Thread(
+            target=self._run, name="device-driver", daemon=True)
+        self._thread.start()
+
+    # -- producer side (engine lock held) ------------------------------------
+    def submit(self, batch) -> None:
+        with self._cv:
+            # backpressure without deadlock: the producer usually holds the
+            # engine lock the delivery path needs, so a full queue waits
+            # briefly then grows (bounded in practice by the wait)
+            if len(self._q) >= self.depth:
+                self._cv.wait(timeout=0.2)
+            self._q.append(batch)
+            self._cv.notify_all()
+
+    # -- worker ---------------------------------------------------------------
+    def _run(self) -> None:
+        import time
+        while True:
+            with self._cv:
+                while (not self._q or self._paused) and not self._stopped:
+                    self._cv.wait(timeout=0.5)
+                if self._stopped and not self._q:
+                    return
+                if self._paused:
+                    continue
+                batch = self._q.popleft()
+                self._stepping = True
+                self._busy = True
+                self._cv.notify_all()
+            try:
+                try:
+                    t0 = time.perf_counter()
+                    rows = self.rt.process(batch)
+                    self.step_seconds += time.perf_counter() - t0
+                    self.batches_stepped += 1
+                except Exception:   # noqa: BLE001 — keep the worker alive;
+                    # the error surfaces through the exception listener path
+                    log.exception("device step failed")
+                    rows = []
+                finally:
+                    with self._cv:
+                        self._stepping = False
+                        self._cv.notify_all()
+                if rows:
+                    with self.app_context.root_lock:
+                        # stamp outputs with the batch's own last event time —
+                        # the producer-side _out_ts has already advanced to
+                        # newer events by delivery time
+                        self.rt.deliver(rows, batch.get("last_ts"))
+            finally:
+                # busy covers step AND delivery: quiesce() returning with an
+                # undelivered output row would let a snapshot capture device
+                # state advanced past rows downstream never saw
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    # -- barriers --------------------------------------------------------------
+    def quiesce(self, timeout: float = 60.0) -> bool:
+        """Wait until the queue is empty and no step OR delivery is in
+        flight. Must NOT be called while holding the engine lock (the
+        worker's delivery phase needs it)."""
+        import time
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._q or self._busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(timeout=min(remaining, 0.5))
+        return True
+
+    def snapshot_staged(self) -> list:
+        """Queued-but-unstepped batches (numpy dicts) for checkpointing the
+        receive-but-not-process gap. Call with the driver paused."""
+        with self._cv:
+            return list(self._q)
+
+    def restore_staged(self, batches: list) -> None:
+        with self._cv:
+            self._q.clear()
+            self._q.extend(batches)
+            self._cv.notify_all()
+
+    def flush_sync(self) -> None:
+        """Submit any partial batch and drain: device state reflects every
+        event sent so far. Call without the engine lock."""
+        with self.app_context.root_lock:
+            if len(self.rt.builder):
+                self.submit(self.rt.builder.emit())
+        self.quiesce()
+
+    def pause(self) -> None:
+        """Freeze device-state mutation (snapshot walks read ``rt.state``).
+        Waits for the whole in-flight cycle — step AND delivery — so a
+        snapshot can't capture device state advanced past rows downstream
+        hasn't seen. Must not be called holding the engine lock."""
+        with self._cv:
+            self._paused = True
+            while self._busy:
+                self._cv.wait(timeout=0.5)
+
+    def resume(self) -> None:
+        with self._cv:
+            self._paused = False
+            self._cv.notify_all()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._paused = False
+            self._cv.notify_all()
+        self._thread.join(timeout=10.0)
+
+
+class _DeviceRTBase:
+    """Shared packing→step dispatch for bridge runtimes: a full builder is
+    either handed to the async driver (packing overlaps compute) or stepped
+    synchronously. Subclasses define ``process(batch) -> rows``."""
+
+    driver = None
+    callback = None
+
+    def add_callback(self, fn):
+        self.callback = fn
+
+    def deliver(self, rows, emit_ts=None):
+        fn = self.callback
+        if fn and rows:
+            if getattr(getattr(fn, "__self__", None),
+                       "_on_rows_accepts_ts", False):
+                fn(rows, emit_ts)
+            else:           # plain user callback: rows only
+                fn(rows)
+
+    def flush(self):
+        if len(self.builder) == 0:
+            return
+        b = self.builder.emit()
+        if self.driver is not None:
+            self.driver.submit(b)
+            return
+        self.deliver(self.process(b), b.get("last_ts"))
+
+
 class DeviceQueryBridge:
     """Junction subscriber feeding a compiled device query; outputs re-enter the
     engine through the query's output junction."""
 
     def __init__(self, kind: str, runtime, app_context, stream_ids: list[str],
-                 output_junction, query_name: str):
-        self.kind = kind                  # 'stream' | 'nfa'
+                 output_junction, query_name: str, async_mode: bool = False):
+        self.kind = kind                  # 'stream' | 'nfa' | 'join'
         self.runtime = runtime            # DeviceStreamRuntime | DeviceNFARuntime
         self.app_context = app_context
         self.stream_ids = stream_ids
         self.output_junction = output_junction
         self.query_name = query_name
         self.query_callbacks: list = []
+        self._on_rows_accepts_ts = True     # deliver() passes the batch ts
         runtime.add_callback(self._on_rows)
         self._out_ts = 0
+        self.driver = None
+        if async_mode:
+            self.driver = AsyncDeviceDriver(runtime, app_context)
+            runtime.driver = self.driver
 
     # -- junction receiver(s) -------------------------------------------------
     def receiver_for(self, stream_id: str):
@@ -68,19 +246,27 @@ class DeviceQueryBridge:
             self.runtime.send(stream_id, event.data, event.timestamp)
 
     def flush(self) -> None:
-        self.runtime.flush()
+        if self.driver is not None:
+            # async: submit the partial batch and drain the device queue.
+            # Must not hold the engine lock (the worker's delivery needs it).
+            self.driver.flush_sync()
+        else:
+            self.runtime.flush()
 
-    def _on_rows(self, rows: list[list]) -> None:
+    def _on_rows(self, rows: list[list], emit_ts=None) -> None:
+        # async delivery passes the source batch's last event time; the
+        # producer-side _out_ts may already have advanced past it
+        ts = self._out_ts if emit_ts is None else emit_ts
         if self.query_callbacks:
             from .event import Event
-            evs = [Event(self._out_ts, row) for row in rows]
+            evs = [Event(ts, row) for row in rows]
             for cb in self.query_callbacks:
-                cb.receive(self._out_ts, evs, None)
+                cb.receive(ts, evs, None)
         if self.output_junction is None:
             return
         for row in rows:
             self.output_junction.send_event(
-                StreamEvent(self._out_ts, row, EventType.CURRENT))
+                StreamEvent(ts, row, EventType.CURRENT))
 
 
 def try_build_device_query(query: Query, app_context, stream_defs: dict,
@@ -94,6 +280,31 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
     batch = int(ann.get("batch") or 1024)
     slots = int(ann.get("slots") or 64)
     window_cap = int(ann.get("window") or 4096)
+
+    def _input_stream_ids(ist) -> list[str]:
+        if isinstance(ist, SingleInputStream):
+            return [ist.stream_id]
+        if isinstance(ist, StateInputStream):
+            return ist.stream_ids()
+        if isinstance(ist, JoinInputStream):
+            out = []
+            for side in (ist.left, ist.right):
+                sid = getattr(side, "stream_id", None)
+                if sid is not None:
+                    out.append(sid)
+            return out
+        return []
+
+    # async packing/compute overlap: explicit @device(async='true'), or any
+    # input stream annotated @async (the reference's Disruptor opt-in)
+    async_mode = (ann.get("async") or "false").lower() == "true"
+    if not async_mode:
+        for sid in _input_stream_ids(query.input_stream):
+            d = stream_defs.get(sid)
+            if d is not None and \
+                    find_annotation(d.annotations, "async") is not None:
+                async_mode = True
+                break
 
     from ..tpu.expr_compile import DeviceCompileError
 
@@ -118,30 +329,24 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
             compiled = CompiledStreamQuery(query, d, batch_capacity=batch,
                                            window_capacity=window_cap)
 
-            class _StreamRT:
+            class _StreamRT(_DeviceRTBase):
                 def __init__(self):
                     self.compiled = compiled
                     self.builder = BatchBuilder(compiled.schema, batch)
                     self.state = compiled.init_state()
-                    self.callback = None
-
-                def add_callback(self, fn):
-                    self.callback = fn
 
                 def send(self, row, timestamp=0):
                     self.builder.append(row, timestamp)
                     if self.builder.full:
                         self.flush()
 
-                def flush(self):
-                    if len(self.builder) == 0:
-                        return
-                    b = self.builder.emit()
+                def process(self, b):
+                    """Device step + decode (async: worker thread, no engine
+                    lock — device state is worker-owned)."""
                     self.state, out = self.compiled.step(self.state, b)
                     rows = self.compiled.decode_outputs(out)
                     self._check_counters()
-                    if self.callback and rows:
-                        self.callback(rows)
+                    return rows
 
                 def _check_counters(self):
                     # surface bounded-state overflow instead of silently
@@ -149,7 +354,10 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                     for key, what in (("window_drops", "alive events evicted "
                                        "(raise @device(window='N'))"),
                                       ("ts_regressions", "out-of-order "
-                                       "timestamps clamped")):
+                                       "timestamps clamped"),
+                                      ("group_collisions", "group-by keys "
+                                       "collided in the dense table (raise "
+                                       "@device key capacity)")):
                         c = self.state.get(key)
                         if c is None:
                             continue
@@ -159,22 +367,19 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                             setattr(self, f"_warned_{key}", c)
 
                 def snapshot_state(self):
-                    import jax
-                    return {"device": jax.device_get(self.state),
-                            "dict": self.compiled.schema.snapshot_dictionaries()}
+                    from ..tpu.batch import device_state_snapshot
+                    return device_state_snapshot(self.state,
+                                                 self.compiled.schema)
 
                 def restore_state(self, st):
-                    import jax
-                    if isinstance(st, dict) and "device" in st:
-                        self.compiled.schema.restore_dictionaries(
-                            st.get("dict", {}))
-                        self.state = jax.device_put(st["device"])
-                    else:       # pre-round-3 snapshot shape
-                        self.state = jax.device_put(st)
+                    from ..tpu.batch import device_state_restore
+                    self.state = device_state_restore(
+                        st, self.compiled.schema)
 
             rt = _StreamRT()
             bridge = DeviceQueryBridge("stream", rt, app_context,
-                                       [ist.stream_id], target, name)
+                                       [ist.stream_id], target, name,
+                                       async_mode=async_mode)
             bridge.output_schema = ([s.name for s in compiled.specs],
                                     [s.dtype for s in compiled.specs])
         elif isinstance(ist, StateInputStream):
@@ -186,13 +391,16 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                 def __init__(self):
                     self.compiler = compiler
                     self.builder = MergedBatchBuilder(
-                        compiler.merged, batch, stream_defs)
+                        compiler.merged, batch, stream_defs,
+                        used_cols=compiler.used_cols)
                     self.state = compiler.init_state()
                     self.callback = None
+                    self.driver = None
 
             rt = _NFART()
             bridge = DeviceQueryBridge("nfa", rt, app_context,
-                                       compiler.compiled.stream_ids, target, name)
+                                       compiler.compiled.stream_ids, target,
+                                       name, async_mode=async_mode)
             bridge.output_schema = ([n for n, _, _ in compiler.out_specs],
                                     [t for _, _, t in compiler.out_specs])
         elif isinstance(ist, JoinInputStream):
@@ -205,27 +413,20 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                 query, dict(stream_defs), batch_capacity=batch,
                 ring_capacity=ring, joined_capacity=joined)
 
-            class _JoinRT:
+            class _JoinRT(_DeviceRTBase):
                 def __init__(self):
                     self.compiled = compiled
                     self.builder = MergedBatchBuilder(
                         compiled.merged, batch, dict(stream_defs))
                     self.state = compiled.init_state()
-                    self.callback = None
                     self._warned_drops = 0
-
-                def add_callback(self, fn):
-                    self.callback = fn
 
                 def send(self, stream_id, row, timestamp=0):
                     self.builder.append(stream_id, row, timestamp)
                     if self.builder.full:
                         self.flush()
 
-                def flush(self):
-                    if len(self.builder) == 0:
-                        return
-                    b = self.builder.emit()
+                def process(self, b):
                     self.state, out = self.compiled.step(self.state, b)
                     rows = self.compiled.decode_outputs(out)
                     drops = int(self.state["join_drops"]) + \
@@ -235,26 +436,23 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                             "query '%s': %d joined rows/ring entries dropped "
                             "(raise @device(joined=/ring=))", name, drops)
                         self._warned_drops = drops
-                    if self.callback and rows:
-                        self.callback(rows)
+                    return rows
 
                 def snapshot_state(self):
-                    import jax
-                    return {"device": jax.device_get(self.state),
-                            "dict": self.compiled.merged.snapshot_dictionaries()}
+                    from ..tpu.batch import device_state_snapshot
+                    return device_state_snapshot(self.state,
+                                                 self.compiled.merged)
 
                 def restore_state(self, st):
-                    import jax
-                    if isinstance(st, dict) and "device" in st:
-                        self.compiled.merged.restore_dictionaries(st["dict"])
-                        self.state = jax.device_put(st["device"])
-                    else:       # pre-round-3 snapshot shape
-                        self.state = jax.device_put(st)
+                    from ..tpu.batch import device_state_restore
+                    self.state = device_state_restore(
+                        st, self.compiled.merged)
 
             rt = _JoinRT()
             bridge = DeviceQueryBridge(
                 "join", rt, app_context,
-                [compiled.left_id, compiled.right_id], target, name)
+                [compiled.left_id, compiled.right_id], target, name,
+                async_mode=async_mode)
             bridge.output_schema = ([n for (n, _, t, _) in compiled.out_specs],
                                     [t for (n, _, t, _) in compiled.out_specs])
         else:
@@ -278,8 +476,33 @@ class _BridgeState:
         self.bridge = bridge
 
     def snapshot_state(self):
-        self.bridge.flush()
-        return self.bridge.runtime.snapshot_state()
+        if self.bridge.driver is None:
+            self.bridge.flush()
+            return self.bridge.runtime.snapshot_state()
+        # async mode: SiddhiAppRuntime._pre_snapshot already flushed + paused
+        # the driver (flushing here would deadlock — we hold root_lock and
+        # the worker's delivery phase needs it). Events that raced in between
+        # the pre-drain and this lock acquisition sit in the builder / driver
+        # queue — checkpoint them as staged batches so the cut is consistent
+        # with the host-side state walked under the same lock.
+        return {
+            "rt": self.bridge.runtime.snapshot_state(),
+            "staged": self.bridge.driver.snapshot_staged(),
+            "builder": self.bridge.runtime.builder.snapshot(),
+        }
 
     def restore_state(self, state):
+        if isinstance(state, dict) and "rt" in state:
+            # async-mode snapshot shape — also restorable into a runtime
+            # whose async opt-in was removed: staged batches are stepped
+            # synchronously instead of re-queued
+            self.bridge.runtime.restore_state(state["rt"])
+            self.bridge.runtime.builder.restore(state["builder"])
+            if self.bridge.driver is not None:
+                self.bridge.driver.restore_staged(state["staged"])
+            else:
+                rt = self.bridge.runtime
+                for batch in state["staged"]:
+                    rt.deliver(rt.process(batch), batch.get("last_ts"))
+            return
         self.bridge.runtime.restore_state(state)

@@ -25,7 +25,13 @@ class OnErrorAction:
 
 
 class StreamJunction:
-    """Per-stream event bus: receivers subscribe; publishers send."""
+    """Per-stream event bus: receivers subscribe; publishers send.
+
+    ``@async(buffer.size, workers, batch.size.max)`` on the stream definition
+    switches the junction to asynchronous dispatch (the reference's Disruptor
+    mode, ``StreamJunction.java:279-316``): ``send_event`` enqueues into an
+    ``AsyncDispatcher`` and worker threads deliver under the app lock.
+    """
 
     def __init__(self, definition: AbstractDefinition, app_context,
                  on_error_action: str = OnErrorAction.LOG):
@@ -35,12 +41,40 @@ class StreamJunction:
         self.on_error_action = on_error_action
         self.fault_junction: Optional["StreamJunction"] = None
         self.throughput = 0
+        self.dispatcher = None             # AsyncDispatcher when @async
 
     def subscribe(self, receiver) -> None:
         if receiver not in self.receivers:
             self.receivers.append(receiver)
 
+    def enable_async(self, buffer_size: int = 1024, workers: int = 1,
+                     batch_size_max: int = 64) -> None:
+        from .async_junction import AsyncDispatcher
+        self.dispatcher = AsyncDispatcher(
+            self, self.app_context, buffer_size=buffer_size, workers=workers,
+            batch_size_max=batch_size_max)
+
     def send_event(self, event: StreamEvent) -> None:
+        if self.dispatcher is not None:
+            # throughput counts at DELIVERY (worker, under the engine lock):
+            # a bare += here would race between producer threads
+            self.dispatcher.enqueue(("event", event))
+            return
+        self.deliver_event(event)
+
+    def send_events(self, events: list[StreamEvent]) -> None:
+        """Deliver a chunk, preserving batch identity for chunk-aware receivers
+        (``#window.batch()`` semantics depend on it)."""
+        if not events:
+            return
+        if self.dispatcher is not None:
+            self.dispatcher.enqueue(("chunk", events))
+            return
+        self.deliver_events(events)
+
+    def deliver_event(self, event: StreamEvent) -> None:
+        """Synchronous delivery into the receiver chain (worker entry point in
+        async mode; delivery is serialized under the engine lock)."""
         self.throughput += 1
         first_error = None
         for r in self.receivers:
@@ -53,11 +87,7 @@ class StreamJunction:
         if first_error is not None:
             self.handle_error(event, first_error)
 
-    def send_events(self, events: list[StreamEvent]) -> None:
-        """Deliver a chunk, preserving batch identity for chunk-aware receivers
-        (``#window.batch()`` semantics depend on it)."""
-        if not events:
-            return
+    def deliver_events(self, events: list[StreamEvent]) -> None:
         self.throughput += len(events)
         first_error = None
         for r in self.receivers:
@@ -105,6 +135,29 @@ class InputHandler:
 
     def send(self, data, timestamp: Optional[int] = None) -> None:
         """Accepts ``[a, b, c]``, ``Event``, or ``list[Event]``."""
+        if self.junction.dispatcher is not None:
+            # async junction: producers only touch the queue mutex — the
+            # watermark advances at DELIVERY time on the worker (under the
+            # engine lock), so timers fire in processing order
+            if isinstance(data, Event):
+                self._check_arity(data.data)
+                self.junction.send_event(
+                    StreamEvent(data.timestamp, list(data.data),
+                                EventType.CURRENT))
+            elif data and isinstance(data[0], Event):
+                for ev in data:
+                    self._check_arity(ev.data)
+                self.junction.send_events([
+                    StreamEvent(ev.timestamp, list(ev.data), EventType.CURRENT)
+                    for ev in data
+                ])
+            else:
+                ts = timestamp if timestamp is not None \
+                    else self.app_context.current_time()
+                self._check_arity(data)
+                self.junction.send_event(
+                    StreamEvent(ts, list(data), EventType.CURRENT))
+            return
         with self.app_context.root_lock:
             if isinstance(data, Event):
                 self._send_one(data.timestamp, data.data)

@@ -1,9 +1,12 @@
 """TPU compiled path: columnar ingress, vectorized query programs, NFA kernels.
 
-Everything here is jit-compiled XLA (plus Pallas kernels for the hottest ops);
-all mutable state lives in pytrees carried through the step functions, so
-checkpointing is ``device_get`` and multi-chip scaling is ``shard_map`` over a
-``jax.sharding.Mesh`` (see ``partition.py``).
+Everything here is jit-compiled XLA; all mutable state lives in pytrees
+carried through the step functions, so checkpointing is ``device_get`` and
+multi-chip scaling is ``shard_map`` over a ``jax.sharding.Mesh``
+(see ``partition.py``). The pattern engine has two kernels: a batch-parallel
+blocked formulation for stream-state chains (``nfa_block.py``, sequential
+depth = number of NFA states) and a per-event scan fallback covering
+count/logical/absent states (``nfa.py``).
 """
 
 import jax

@@ -77,6 +77,26 @@ def snapshot_dictionaries(dictionaries: dict) -> dict:
     return out
 
 
+def device_state_snapshot(state, dict_owner) -> dict:
+    """Canonical device-runtime checkpoint: host-fetched pytree + the string
+    dictionary that decodes its codes (advisor r2 finding: codes without the
+    dictionary are meaningless in a fresh process). ``dict_owner`` is any
+    object with snapshot_dictionaries()/restore_dictionaries()."""
+    import jax
+    return {"device": jax.device_get(state),
+            "dict": dict_owner.snapshot_dictionaries()}
+
+
+def device_state_restore(snap, dict_owner):
+    """Inverse of device_state_snapshot; accepts the pre-round-3 bare-pytree
+    shape too. Returns the device state to assign."""
+    import jax
+    if isinstance(snap, dict) and "device" in snap:
+        dict_owner.restore_dictionaries(snap.get("dict", {}))
+        return jax.device_put(snap["device"])
+    return jax.device_put(snap)      # pre-round-3 snapshot shape
+
+
 def restore_dictionaries(dictionaries: dict, snap: dict) -> None:
     """Restores in-place; sharing structure comes from the live schema, so
     each snapshotted table lands in (and via aliasing, propagates to) every
@@ -177,9 +197,26 @@ class BatchBuilder:
             "ts": self._ts.copy(),
             "valid": valid,
             "count": self._n,
+            "last_ts": int(self._ts[self._n - 1]) if self._n else 0,
         }
         self._n = 0
         return out
+
+    def snapshot(self) -> dict:
+        """Staged-but-unemitted rows (checkpointing the async ingest gap)."""
+        n = self._n
+        return {
+            "cols": {k: v[:n].copy() for k, v in self._cols.items()},
+            "ts": self._ts[:n].copy(),
+            "n": n,
+        }
+
+    def restore(self, snap: dict) -> None:
+        n = snap["n"]
+        self._n = n
+        for k, v in snap["cols"].items():
+            self._cols[k][:n] = v
+        self._ts[:n] = snap["ts"]
 
 
 def columns_from_rows(schema: BatchSchema, rows: list[list],

@@ -240,7 +240,10 @@ class CompiledJoinQuery:
         un_right = jt in (JoinType.RIGHT_OUTER_JOIN, JoinType.FULL_OUTER_JOIN)
         L = W + B + 1      # per-probe layout: ring | in-batch | unmatched
 
-        def step(state, cols, tag, ts, valid):
+        def step(state, cols, tag, ts, ts_base, nvalid):
+            # wire format: int32 ts deltas + per-batch base, prefix validity
+            ts = ts_base.astype(jnp.int64) + ts.astype(jnp.int64)
+            valid = jnp.arange(B, dtype=jnp.int32) < nvalid
             is_l = (tag == 0) & valid
             is_r = (tag == 1) & valid
             probe_ok = valid & jnp.where(tag == 0, emit_left, emit_right)
@@ -436,7 +439,7 @@ class CompiledJoinQuery:
     # -------------------------------------------------------------- execution
     def step(self, state, batch: dict):
         return self._step(state, batch["cols"], batch["tag"], batch["ts"],
-                          batch["valid"])
+                          batch["ts_base"], np.int32(batch["count"]))
 
     def decode_outputs(self, out) -> list[list]:
         valid = np.asarray(out["valid"])
@@ -524,12 +527,9 @@ class DeviceJoinRuntime:
         return int(jax.device_get(self.state["ring_drops"]))
 
     def snapshot_state(self):
-        return {"device": jax.device_get(self.state),
-                "dict": self.compiler.merged.snapshot_dictionaries()}
+        from .batch import device_state_snapshot
+        return device_state_snapshot(self.state, self.compiler.merged)
 
     def restore_state(self, state) -> None:
-        if isinstance(state, dict) and "device" in state:
-            self.compiler.merged.restore_dictionaries(state.get("dict", {}))
-            self.state = jax.device_put(state["device"])
-        else:       # pre-round-3 snapshot shape
-            self.state = jax.device_put(state)
+        from .batch import device_state_restore
+        self.state = device_state_restore(state, self.compiler.merged)

@@ -88,18 +88,33 @@ class MergedBatchSchema:
 
 
 class MergedBatchBuilder:
+    """Stages events and emits device micro-batches in the WIRE format:
+
+    ``{"cols": {key: [B]}, "tag": int8 [B], "ts": int32 [B] (deltas),
+    "ts_base": int64 scalar, "count": int}``
+
+    Only columns in ``used_cols`` (those the compiled program reads) are
+    staged/transferred; timestamps travel as int32 deltas against the batch
+    minimum; validity is the prefix ``[0, count)`` — the h2d tunnel
+    bandwidth is the measured device-path bottleneck, so the wire carries
+    ~10B/event instead of ~21B."""
+
     def __init__(self, schema: MergedBatchSchema, capacity: int,
-                 stream_defs: dict[str, StreamDefinition]):
+                 stream_defs: dict[str, StreamDefinition],
+                 used_cols: Optional[set] = None):
         self.schema = schema
         self.capacity = capacity
         self.stream_defs = stream_defs
+        keys = schema.columns.keys() if used_cols is None \
+            else [k for k in schema.columns if k in used_cols]
         self._cols = {
-            key: np.zeros(capacity, dtype=_NP[t])
-            for key, t in schema.columns.items()
+            key: np.zeros(capacity, dtype=_NP[schema.columns[key]])
+            for key in keys
         }
-        self._tag = np.zeros(capacity, dtype=np.int32)
+        self._tag = np.zeros(capacity, dtype=np.int8)
         self._ts = np.zeros(capacity, dtype=np.int64)
         self._n = 0
+        self.ts_clamped = 0        # events whose in-batch ts delta overflowed
 
     def __len__(self):
         return self._n
@@ -114,25 +129,57 @@ class MergedBatchBuilder:
         d = self.stream_defs[stream_id]
         for a, v in zip(d.attributes, row):
             key = f"s{si}_{a.name}"
+            col = self._cols.get(key)
+            if col is None:
+                continue               # column unused by the compiled program
             if a.type == DataType.STRING:
                 v = self.schema.dictionaries[key].encode(v)
-            self._cols[key][i] = 0 if v is None else v
+            col[i] = 0 if v is None else v
         self._tag[i] = si
         self._ts[i] = ts
         self._n += 1
 
     def emit(self) -> dict:
-        valid = np.zeros(self.capacity, dtype=bool)
-        valid[: self._n] = True
+        n = self._n
+        base = int(self._ts[:n].min()) if n else 0
+        deltas = self._ts - base
+        deltas[n:] = 0
+        if n and deltas[:n].max() > 2**31 - 1:
+            # an in-batch event-time span over ~24.8 days: clamp + count
+            # (callers should flush long-idle builders before this occurs)
+            self.ts_clamped += int(np.sum(deltas[:n] > 2**31 - 1))
+            log = __import__("logging").getLogger("siddhi_tpu.device")
+            log.warning("batch ts span exceeds int32 ms; %d clamped",
+                        self.ts_clamped)
+            np.clip(deltas, 0, 2**31 - 1, out=deltas)
         out = {
             "cols": {k: v.copy() for k, v in self._cols.items()},
             "tag": self._tag.copy(),
-            "ts": self._ts.copy(),
-            "valid": valid,
-            "count": self._n,
+            "ts": deltas.astype(np.int32),
+            "ts_base": np.int64(base),
+            "count": n,
+            "last_ts": int(self._ts[n - 1]) if n else 0,
         }
         self._n = 0
         return out
+
+    def snapshot(self) -> dict:
+        """Staged-but-unemitted rows (checkpointing the async ingest gap)."""
+        n = self._n
+        return {
+            "cols": {k: v[:n].copy() for k, v in self._cols.items()},
+            "tag": self._tag[:n].copy(),
+            "ts": self._ts[:n].copy(),
+            "n": n,
+        }
+
+    def restore(self, snap: dict) -> None:
+        n = snap["n"]
+        self._n = n
+        for k, v in snap["cols"].items():
+            self._cols[k][:n] = v
+        self._tag[:n] = snap["tag"]
+        self._ts[:n] = snap["ts"]
 
 
 # ---------------------------------------------------------------------------
@@ -207,6 +254,7 @@ class _NFAResolver:
             key = nfa.merged.col_key(sid, var.attribute)
             if var.attribute not in nfa.compiled.alias_defs[a].attribute_names:
                 raise DeviceCompileError(f"unknown attribute '{var.attribute}'")
+            nfa.used_ev_cols.add(key)
             return f"ev_{key}", nfa.merged.columns[key]
         if alias not in nfa.alias_branch:
             raise DeviceCompileError(f"unknown alias '{alias}'")
@@ -337,9 +385,23 @@ class DeviceNFACompiler:
             (s.index for s in self.states if s.ends_every), None)
 
         # compile predicates (after alias map ready) from the original ASTs
+        self.used_ev_cols: set[str] = set()
         self._compile_predicates(ist)
         # output programs
         self._compile_output(query)
+        # merged columns the compiled program actually reads — the builders
+        # stage and TRANSFER only these (the tunnel's h2d bandwidth is the
+        # measured bottleneck; unreferenced columns like partition keys cost
+        # 4B/event for nothing)
+        resolver = _NFAResolver(self, None)
+        self.used_cols = set(self.used_ev_cols)
+        for (q, key, t) in self.referenced:
+            self.used_cols.add(resolver._bound_to_merged(key))
+        # kernel selection: stream-state chains with `every` take the blocked
+        # batch-parallel kernel (sequential depth S, not B — nfa_block.py);
+        # count/logical/absent states use the per-event scan
+        from .nfa_block import blocked_eligible
+        self.blocked = blocked_eligible(self)
         self._step = jax.jit(self._make_step(), donate_argnums=(0,))
 
     def _compile_predicates(self, ist: StateInputStream) -> None:
@@ -414,6 +476,9 @@ class DeviceNFACompiler:
 
     # ------------------------------------------------------------------ state
     def init_state(self) -> dict:
+        if self.blocked:
+            from .nfa_block import block_init_state
+            return block_init_state(self)
         C, S = self.C, self.S
         pend = {}
         for s in range(S):
@@ -448,6 +513,9 @@ class DeviceNFACompiler:
 
     # ------------------------------------------------------------------- step
     def _make_step(self):
+        if self.blocked:
+            from .nfa_block import make_block_step
+            return make_block_step(self)
         C, S = self.C, self.S
         states = self.states
         within = self.within
@@ -935,14 +1003,19 @@ class DeviceNFACompiler:
                 ys[name] = out_cols[oi]
             return new_carry, ys
 
-        def step(state, cols, tag, ts, valid):
+        def step(state, cols, tag, ts, ts_base, nvalid):
+            # wire format: int32 ts deltas + per-batch base, prefix validity
+            nB = ts.shape[0]
+            ts64 = ts_base.astype(jnp.int64) + ts.astype(jnp.int64)
+            valid = jnp.arange(nB, dtype=jnp.int32) < nvalid
+
             def body(carry, xs):
                 ev = {"cols": {k: xs[f"c_{k}"] for k in cols},
                       "tag": xs["tag"], "ts": xs["ts"], "valid": xs["valid"]}
                 return step_event(carry, ev)
 
             xs = {f"c_{k}": v for k, v in cols.items()}
-            xs.update({"tag": tag, "ts": ts, "valid": valid})
+            xs.update({"tag": tag, "ts": ts64, "valid": valid})
             state, ys = jax.lax.scan(body, state, xs)
             return state, ys
 
@@ -951,17 +1024,22 @@ class DeviceNFACompiler:
     # -------------------------------------------------------------- execution
     def make_step(self):
         """Public builder for the un-jitted single-lane step function
-        ``(state, cols, tag, ts, valid) -> (state, ys)`` — the composable
-        surface ``vmap``/``shard_map`` wrappers (partition runtime, bench,
-        ``__graft_entry__``) build on. ``self.step`` is the jitted
-        single-lane convenience over the same function."""
+        ``(state, cols, tag, ts, ts_base, nvalid) -> (state, ys)`` in the
+        wire format (int32 ts deltas + int64 base scalar, validity = prefix
+        ``[0, nvalid)``) — the composable surface ``vmap``/``shard_map``
+        wrappers (partition runtime, bench, ``__graft_entry__``) build on.
+        ``self.step`` is the jitted single-lane convenience over the same
+        function."""
         return self._make_step()
 
     def step(self, state, batch: dict):
         return self._step(state, batch["cols"], batch["tag"], batch["ts"],
-                          batch["valid"])
+                          batch["ts_base"], np.int32(batch["count"]))
 
     def decode_outputs(self, ys) -> list[list]:
+        if self.blocked:
+            from .nfa_block import decode_block_outputs
+            return decode_block_outputs(self, ys)
         mask = np.asarray(ys["mask"])              # [B, 2, C]
         rows = []
         cols = {name: np.asarray(ys[name]) for (name, _, t) in self.out_specs}
@@ -1008,9 +1086,11 @@ class DeviceNFARuntime:
         self.compiler = DeviceNFACompiler(
             query, dict(app.stream_definitions), slot_capacity, batch_capacity)
         self.builder = MergedBatchBuilder(
-            self.compiler.merged, batch_capacity, dict(app.stream_definitions))
+            self.compiler.merged, batch_capacity, dict(app.stream_definitions),
+            used_cols=self.compiler.used_cols)
         self.state = self.compiler.init_state()
         self.callback: Optional[Callable[[list[list]], None]] = None
+        self.driver = None          # AsyncDeviceDriver when @async device mode
 
     def add_callback(self, fn) -> None:
         self.callback = fn
@@ -1020,16 +1100,32 @@ class DeviceNFARuntime:
         if self.builder.full:
             self.flush()
 
+    def process(self, batch: dict) -> list[list]:
+        """Device step + decode (async driver's worker entry)."""
+        self.state, ys = self.compiler.step(self.state, batch)
+        return self.compiler.decode_outputs(ys)
+
+    def deliver(self, rows: list[list], emit_ts=None) -> None:
+        fn = self.callback
+        if fn is not None and rows:
+            if getattr(getattr(fn, "__self__", None),
+                       "_on_rows_accepts_ts", False):
+                fn(rows, emit_ts)
+            else:           # plain user callback: rows only
+                fn(rows)
+
     def flush(self, decode: bool = True):
         if len(self.builder) == 0:
             return None
         batch = self.builder.emit()
-        self.state, ys = self.compiler.step(self.state, batch)
+        if self.driver is not None:
+            self.driver.submit(batch)
+            return None
         if decode:
-            rows = self.compiler.decode_outputs(ys)
-            if self.callback is not None and rows:
-                self.callback(rows)
+            rows = self.process(batch)
+            self.deliver(rows)
             return rows
+        self.state, ys = self.compiler.step(self.state, batch)
         return ys
 
     @property
@@ -1041,14 +1137,9 @@ class DeviceNFARuntime:
         return int(jax.device_get(self.state["drops"]))
 
     def snapshot_state(self):
-        # string codes in rings/match tables decode against the dictionary —
-        # it must travel with the device pytree (advisor r2 finding)
-        return {"device": jax.device_get(self.state),
-                "dict": self.compiler.merged.snapshot_dictionaries()}
+        from .batch import device_state_snapshot
+        return device_state_snapshot(self.state, self.compiler.merged)
 
     def restore_state(self, state) -> None:
-        if isinstance(state, dict) and "device" in state:
-            self.compiler.merged.restore_dictionaries(state.get("dict", {}))
-            self.state = jax.device_put(state["device"])
-        else:       # pre-round-3 snapshot shape
-            self.state = jax.device_put(state)
+        from .batch import device_state_restore
+        self.state = device_state_restore(state, self.compiler.merged)

@@ -1,0 +1,337 @@
+"""Blocked NFA step: batch-level parallel pattern matching.
+
+The round-2 verdict measured the per-event ``lax.scan`` kernel (``nfa.py``) at
+~1.9s per 32k-event batch on a real v5e — 512 sequential scan iterations of
+~300 tiny [C]-wide ops are pure dispatch latency, near-zero MFU. This module
+is the reformulation the north star asks for: sequential depth **S (number of
+NFA states)** instead of **B (events per batch)**.
+
+Key insight: for linear chains of *stream* states with ``every`` at the start
+(the dominant pattern shape — BASELINE configs #2/#3/#5), advancement is
+*consuming* and *deterministic*: a partial at state ``s`` advances on the
+FIRST later event matching state ``s``'s predicate, and then leaves the
+state. So the number of partials created at any state during a batch is
+bounded by ``C + B`` (old slots + one per source partial), NOT exponential,
+and the whole batch resolves in S data-parallel stages:
+
+  stage s: grid[j, p] = valid[j] & gate_s[j] & within_ok[j, p]
+                         & (j > born_p)  & pred_s(event_j, bindings_p)
+           j*(p) = first j with grid[j, p]     (vectorized argmax)
+           advanced partials become stage s+1's candidates with
+           born' = j*, bindings' = bindings + event_{j*}'s columns.
+
+Each stage is one [B, P] masked grid — exactly the "candidate×event pairs as
+one grid per state per batch" shape the verdict names. Sequences add the
+strict-continuity constraint ``vidx[j] == vidx[born]+1`` (``vidx`` = running
+count of valid events); ``within`` is a timestamp mask on the grid.
+
+Capacity semantics (documented divergence from the per-event kernel): within
+a batch the partial population grows exactly (static shapes, ``sC + B``; an
+optional ``creation_cap`` budget compacts each stage to ``[B, C+K]`` for very
+long patterns, overflow counted); match tables truncate to C entries at
+*batch boundaries* (keep-oldest: old slots first, then in-batch creations in
+candidate order, counted in ``drops``). Under capacity pressure this kernel
+finds a SUPERSET of the per-event kernel's matches (closer to the host
+oracle, which never drops); with no pressure the two are identical.
+
+Scope: every state ``kind == 'stream'``, ``every`` scope = whole pattern
+(``always_seed``) or absent entirely with S == 1; patterns and sequences;
+stream-level ``within``. Count/logical/absent states use the per-event scan
+kernel (``nfa.py``).
+
+Reference semantics: ``StreamPreStateProcessor.processAndReturn``
+(``query/input/stream/state/StreamPreStateProcessor.java:364-403``), expiry
+``isExpired:118``; the blocked formulation is original to this framework.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..query_api.definition import DataType
+from .dtypes import JNP as _JNP
+
+if TYPE_CHECKING:
+    from .nfa import DeviceNFACompiler
+
+
+def blocked_eligible(nfa: "DeviceNFACompiler") -> bool:
+    """True when the pattern fits the blocked kernel's shape: a chain of
+    stream states whose ``every`` scope is the whole pattern (always-seed)."""
+    return all(s.kind == "stream" for s in nfa.states) \
+        and nfa.states[0].ends_every
+
+
+def block_init_state(nfa: "DeviceNFACompiler") -> dict:
+    """Tables for states 1..S-1 (seeds enter state 1; state 0 holds nothing
+    for stream chains) + counters.
+
+    Invariant: table slots are packed in creation order (oldest first) — the
+    per-batch survivor pack preserves candidate order, and candidates are
+    [old slots (already ordered), creations (born ascending)]. Drop-newest
+    truncation is therefore just "keep the first C survivors"."""
+    C = nfa.C
+    tables = {}
+    for s in range(1, nfa.S):
+        fields = {
+            "valid": jnp.zeros((C,), jnp.bool_),
+            "first_ts": jnp.full((C,), -1, jnp.int64),
+        }
+        for (q, key, t) in nfa.referenced:
+            if q < s:
+                fields[key] = jnp.zeros((C,), _JNP[t])
+        tables[f"t{s}"] = fields
+    return {
+        "tables": tables,
+        "matches": jnp.array(0, jnp.int64),
+        "drops": jnp.array(0, jnp.int64),
+    }
+
+
+def make_block_step(nfa: "DeviceNFACompiler"):
+    """Returns step(state, cols, tag, ts, ts_base, nvalid) -> (state, ys)
+    in the wire format (int32 ts deltas + int64 base, prefix validity).
+
+    ys: {"mask": [P] bool, "j": [P] i32 (match event index, for ordering),
+         "ts": [P] i64 (match event timestamp), <out-name>: [P] ...}
+    where P = (S-1)*C + B for S > 1, else B.
+    """
+    C, S, B = nfa.C, nfa.S, nfa.B
+    states = nfa.states
+    within = nfa.within
+    is_seq = nfa.is_sequence
+    referenced = sorted(nfa.referenced)
+    out_specs = nfa.out_specs
+    # optional creation budget: partials entering a state within one batch
+    # are compacted to K entries (order-preserving; overflow counted in
+    # `drops`), capping every stage's grid at [B, C+K]. Off by default —
+    # exact growth is [B, sC+B], fine for realistic S — but long patterns
+    # (large S) can opt in via ``DeviceNFACompiler.creation_cap``.
+    K = getattr(nfa, "creation_cap", None)
+
+    def binding_keys(s: int) -> list:
+        """Referenced bound-value keys carried by a partial AT state s."""
+        return [key for (q, key, t) in referenced if q < s]
+
+    def key_dtype(key: str):
+        for (q, k, t) in referenced:
+            if k == key:
+                return _JNP[t]
+        raise KeyError(key)
+
+    def new_binding_cols(s: int, cols, idx=None):
+        """Bindings minted when state ``s`` consumes an event: b{s}_attr."""
+        out = {}
+        sid = nfa.compiled.alias_defs[states[s].alias].id
+        for (q, key, t) in referenced:
+            if q == s:
+                attr = key[len(f"b{s}_"):]
+                mk = nfa.merged.col_key(sid, attr)
+                col = cols[mk].astype(_JNP[t])
+                out[key] = col if idx is None else col[idx]
+        return out
+
+    def step(state, cols, tag, ts, ts_base, nvalid):
+        tables = dict(state["tables"])
+        matches = state["matches"]
+        drops = state["drops"]
+
+        jidx = jnp.arange(B, dtype=jnp.int32)
+        # wire format: int32 ts deltas + per-batch base, prefix validity
+        ts = ts_base.astype(jnp.int64) + ts.astype(jnp.int64)
+        valid = jidx < nvalid
+        ev_env = {f"ev_{k}": cols[k] for k in cols}
+        n_valid = jnp.sum(valid.astype(jnp.int32))
+        vidx = jnp.cumsum(valid.astype(jnp.int32))        # 1-based at valids
+        ts_last = jnp.max(jnp.where(valid, ts, jnp.int64(-(2**62))))
+
+        # ---- seeds: state-0 predicate over the raw batch ------------------
+        st0 = states[0]
+        gate0 = valid & (tag == st0.stream_idx)
+        if st0.predicate is not None:
+            p0 = jnp.broadcast_to(jnp.asarray(st0.predicate(ev_env)), (B,))
+            gate0 = gate0 & p0
+
+        if S == 1:
+            # single-state every-pattern: each matching event IS a match
+            out = {"mask": gate0, "j": jidx, "ts": ts}
+            emit_env = dict(ev_env)
+            for (q, key, t) in referenced:
+                if q == 0:
+                    emit_env[key] = new_binding_cols(0, cols)[key]
+            for (name, fn, t) in out_specs:
+                out[name] = jnp.broadcast_to(
+                    jnp.asarray(fn(emit_env)), (B,)).astype(_JNP[t])
+            new_state = {"tables": tables, "drops": drops,
+                         "matches": matches + jnp.sum(gate0.astype(jnp.int64))}
+            return new_state, out
+
+        def compact(cre):
+            """Order-preserving compaction of a creations dict to K slots;
+            returns (creations, n_dropped). Identity when no budget is set."""
+            ex = cre["exists"]
+            n = ex.shape[0]
+            if K is None or n <= K:
+                return cre, jnp.int64(0)
+            rank = jnp.cumsum(ex.astype(jnp.int32)) - 1
+            tgt = jnp.where(ex, rank, K)
+
+            def cp(vals, fill):
+                return jnp.full((K,), fill, vals.dtype).at[tgt].set(
+                    jnp.where(ex, vals, fill), mode="drop")
+
+            out = {
+                "exists": jnp.zeros((K,), jnp.bool_).at[tgt].set(
+                    ex, mode="drop"),
+                "born": cp(cre["born"], jnp.int32(0)),
+                "vb": cp(cre["vb"], jnp.int32(0)),
+                "first_ts": cp(cre["first_ts"], jnp.int64(-1)),
+                "bind": {k: cp(v, jnp.zeros((), v.dtype))
+                         for k, v in cre["bind"].items()},
+            }
+            dropped = jnp.maximum(
+                jnp.sum(ex.astype(jnp.int64)) - K, 0)
+            return out, dropped
+
+        # creations entering state 1
+        creations, dropped = compact({
+            "exists": gate0,
+            "born": jidx,                                  # batch position
+            "vb": vidx,                                    # vidx[born]
+            "first_ts": ts,
+            "bind": new_binding_cols(0, cols),             # b0_* [B]
+        })
+        drops = drops + dropped
+
+        out_mask = out_j = out_ts = None
+        out_cols = {}
+
+        for s in range(1, S):
+            st = states[s]
+            tbl = tables[f"t{s}"]
+            Pc = creations["exists"].shape[0]
+            P = C + Pc
+
+            # candidate arrays: old slots first, then creations (born order)
+            cand_exists = jnp.concatenate([tbl["valid"], creations["exists"]])
+            cand_born = jnp.concatenate(
+                [jnp.full((C,), -1, jnp.int32), creations["born"]])
+            cand_vb = jnp.concatenate(
+                [jnp.zeros((C,), jnp.int32), creations["vb"]])
+            cand_first = jnp.concatenate(
+                [tbl["first_ts"], creations["first_ts"]])
+            cand_bind = {}
+            for key in binding_keys(s):
+                dt = key_dtype(key)
+                old = tbl[key]
+                new = creations["bind"].get(key)
+                if new is None:
+                    new = jnp.zeros((Pc,), dt)
+                cand_bind[key] = jnp.concatenate(
+                    [old.astype(dt), new.astype(dt)])
+
+            # ---- the [B, P] grid ----------------------------------------
+            gate = valid & (tag == st.stream_idx)          # [B]
+            grid = gate[:, None] & cand_exists[None, :]
+            if st.predicate is not None:
+                env = {k: v[:, None] for k, v in ev_env.items()}
+                env.update({k: v[None, :] for k, v in cand_bind.items()})
+                pred = jnp.asarray(st.predicate(env))
+                grid = grid & jnp.broadcast_to(pred, (B, P))
+            if within is not None:
+                grid = grid & ((ts[:, None] - cand_first[None, :]) <= within)
+            if is_seq:
+                grid = grid & (vidx[:, None] == cand_vb[None, :] + 1)
+            else:
+                grid = grid & (jidx[:, None] > cand_born[None, :])
+
+            adv = jnp.any(grid, axis=0)                    # [P]
+            jstar = jnp.argmax(grid, axis=0).astype(jnp.int32)
+
+            if s == S - 1:
+                # ---- emission --------------------------------------------
+                out_mask = adv
+                out_j = jstar
+                out_ts = ts[jstar]
+                emit_env = {k: v[jstar] for k, v in ev_env.items()}
+                emit_env.update(cand_bind)
+                emit_env.update(new_binding_cols(s, cols, idx=jstar))
+                for (name, fn, t) in out_specs:
+                    out_cols[name] = jnp.broadcast_to(
+                        jnp.asarray(fn(emit_env)), (P,)).astype(_JNP[t])
+                matches = matches + jnp.sum(adv.astype(jnp.int64))
+            else:
+                # ---- creations for state s+1 -----------------------------
+                nbind = {}
+                for key in binding_keys(s + 1):
+                    if key in cand_bind:
+                        nbind[key] = cand_bind[key]
+                nbind.update(new_binding_cols(s, cols, idx=jstar))
+                creations, dropped = compact({
+                    "exists": adv,
+                    "born": jstar,
+                    "vb": vidx[jstar],
+                    "first_ts": jnp.where(cand_first >= 0, cand_first,
+                                          ts[jstar]),
+                    "bind": nbind,
+                })
+                drops = drops + dropped
+
+            # ---- survivors → new table s (truncate to C, drop-newest) ----
+            surv = cand_exists & ~adv
+            if within is not None:
+                surv = surv & ((ts_last - cand_first) <= within)
+            if is_seq:
+                # strict continuity: survive only if no valid event followed
+                surv = surv & (cand_vb == n_valid)
+            # candidates are already in creation order (see block_init_state
+            # invariant) — pack survivors by rank, ranks ≥ C drop off
+            rank = jnp.cumsum(surv.astype(jnp.int32)) - 1
+            tgt = jnp.where(surv, rank, C)
+
+            def pack(vals, fill):
+                return jnp.full((C,), fill, vals.dtype).at[tgt].set(
+                    jnp.where(surv, vals, fill), mode="drop")
+
+            ntbl = {
+                "valid": jnp.zeros((C,), jnp.bool_).at[tgt].set(
+                    surv, mode="drop"),
+                "first_ts": pack(cand_first, jnp.int64(-1)),
+            }
+            for key in binding_keys(s):
+                ntbl[key] = pack(cand_bind[key],
+                                 jnp.zeros((), key_dtype(key)))
+            tables[f"t{s}"] = ntbl
+            n_surv = jnp.sum(surv.astype(jnp.int64))
+            drops = drops + jnp.maximum(n_surv - C, 0)
+
+        new_state = {"tables": tables, "matches": matches, "drops": drops}
+        ys = {"mask": out_mask, "j": out_j, "ts": out_ts}
+        ys.update(out_cols)
+        return new_state, ys
+
+    return step
+
+
+def decode_block_outputs(nfa: "DeviceNFACompiler", ys) -> list[list]:
+    """ys → host rows, ordered by match event (j), then candidate rank."""
+    mask = np.asarray(ys["mask"])
+    if not mask.any():
+        return []
+    idx = np.nonzero(mask)[0]
+    j = np.asarray(ys["j"])[idx]
+    order = np.argsort(j, kind="stable")
+    idx = idx[order]
+    cols = {name: np.asarray(ys[name]) for (name, _, t) in nfa.out_specs}
+    from .nfa import _decode_scalar
+    rows = []
+    for p in idx:
+        row = []
+        for (name, _, t) in nfa.out_specs:
+            row.append(_decode_scalar(nfa, name, cols[name][p], t))
+        rows.append(row)
+    return rows

@@ -61,22 +61,28 @@ class PartitionedNFARuntime:
             query, dict(app.stream_definitions), slot_capacity, lane_batch)
         self.stream_defs = dict(app.stream_definitions)
         self.builders = [
-            MergedBatchBuilder(self.compiler.merged, lane_batch, self.stream_defs)
+            MergedBatchBuilder(self.compiler.merged, lane_batch,
+                               self.stream_defs,
+                               used_cols=self.compiler.used_cols)
             for _ in range(num_partitions)
         ]
 
         # vmap the single-lane step over the lane axis
         step = self.compiler.make_step()
-        vstep = jax.vmap(step, in_axes=(0, 0, 0, 0, 0))
+        vstep = jax.vmap(step, in_axes=(0, 0, 0, 0, 0, 0))
         if mesh is not None:
-            from jax.experimental.shard_map import shard_map
             spec = P(axis)
-            vstep = shard_map(
-                vstep, mesh=mesh,
-                in_specs=(spec, spec, spec, spec, spec),
-                out_specs=(spec, spec),
-                check_rep=False,
-            )
+            specs6 = (spec, spec, spec, spec, spec, spec)
+            try:
+                from jax import shard_map          # jax >= 0.8
+                vstep = shard_map(
+                    vstep, mesh=mesh, in_specs=specs6,
+                    out_specs=(spec, spec), check_vma=False)
+            except ImportError:                    # pragma: no cover
+                from jax.experimental.shard_map import shard_map
+                vstep = shard_map(
+                    vstep, mesh=mesh, in_specs=specs6,
+                    out_specs=(spec, spec), check_rep=False)
             self._sharding = NamedSharding(mesh, spec)
         else:
             self._sharding = None
@@ -156,18 +162,35 @@ class PartitionedNFARuntime:
         if all(self._ning.lane_len(ln) == 0 for ln in range(self.P)):
             return [] if decode else None
         batches = [self._ning.emit_lane(ln) for ln in range(self.P)]
+        used = self.compiler.used_cols
         cols = {}
         for ci, key in enumerate(self._col_keys):
+            if key not in used:
+                continue
             stacked = np.stack([bt["cols"][ci] for bt in batches])
             if self._bool_cols[ci]:
                 stacked = stacked.astype(bool)
             cols[key] = stacked
-        tag = np.stack([bt["tag"] for bt in batches])
-        ts = np.stack([bt["ts"] for bt in batches])
-        valid = np.stack([bt["valid"] for bt in batches])
+        tag = np.stack([bt["tag"] for bt in batches]).astype(np.int8)
+        # wire format from the C++ int64 lane timestamps
+        ts64 = np.stack([bt["ts"] for bt in batches])
+        counts = np.array([bt["count"] for bt in batches], dtype=np.int32)
+        base = np.array(
+            [int(t[:n].min()) if n else 0 for t, n in zip(ts64, counts)],
+            dtype=np.int64)
+        deltas = ts64 - base[:, None]
+        over = int(np.sum(deltas > 2**31 - 1))
+        if over:
+            # same loud-overflow policy as MergedBatchBuilder.emit
+            self.ts_clamped = getattr(self, "ts_clamped", 0) + over
+            import logging
+            logging.getLogger("siddhi_tpu.device").warning(
+                "native lane ts span exceeds int32 ms; %d clamped",
+                self.ts_clamped)
+        ts = np.clip(deltas, 0, 2**31 - 1).astype(np.int32)
         if decode:
             self._sync_dict_from_native()
-        return self._step_and_decode(cols, tag, ts, valid, decode)
+        return self._step_and_decode(cols, tag, ts, base, counts, decode)
 
     def _sync_dict_from_native(self) -> None:
         # pull strings the C++ dict minted during ingest into the Python
@@ -202,11 +225,13 @@ class PartitionedNFARuntime:
         }
         tag = np.stack([bt["tag"] for bt in batches])
         ts = np.stack([bt["ts"] for bt in batches])
-        valid = np.stack([bt["valid"] for bt in batches])
-        return self._step_and_decode(cols, tag, ts, valid, decode)
+        ts_base = np.array([bt["ts_base"] for bt in batches], dtype=np.int64)
+        counts = np.array([bt["count"] for bt in batches], dtype=np.int32)
+        return self._step_and_decode(cols, tag, ts, ts_base, counts, decode)
 
-    def _step_and_decode(self, cols, tag, ts, valid, decode: bool):
-        self.state, ys = self._vstep(self.state, cols, tag, ts, valid)
+    def _step_and_decode(self, cols, tag, ts, ts_base, counts, decode: bool):
+        self.state, ys = self._vstep(self.state, cols, tag, ts, ts_base,
+                                     counts)
         if not decode:
             return ys
         rows = []

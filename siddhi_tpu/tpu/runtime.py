@@ -75,12 +75,9 @@ class DeviceStreamRuntime:
 
     # -- checkpointing: state is a pytree + the string dictionary ------------
     def snapshot_state(self) -> dict:
-        return {"device": jax.device_get(self.state),
-                "dict": self.compiled.schema.snapshot_dictionaries()}
+        from .batch import device_state_snapshot
+        return device_state_snapshot(self.state, self.compiled.schema)
 
     def restore_state(self, state) -> None:
-        if isinstance(state, dict) and "device" in state:
-            self.compiled.schema.restore_dictionaries(state.get("dict", {}))
-            self.state = jax.device_put(state["device"])
-        else:       # pre-round-3 snapshot shape
-            self.state = jax.device_put(state)
+        from .batch import device_state_restore
+        self.state = device_state_restore(state, self.compiled.schema)

@@ -1,0 +1,271 @@
+"""Async ingestion: @async junction dispatch + async device driver.
+
+Reference: ``StreamJunction.java:279-316`` (Disruptor mode) — ``@async`` on a
+stream decouples producers from delivery; the device analog overlaps host-side
+micro-batch packing with device compute (``AsyncDeviceDriver``).
+"""
+
+import threading
+import time
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+def _drain(rt):
+    rt.drain_async()
+    rt.flush_device()
+
+
+def test_async_junction_multithreaded_send():
+    """N producer threads send concurrently into one @async stream; every
+    event is delivered exactly once (the multi-threaded send() test named in
+    VERDICT r2 item 4)."""
+    app = """
+    @async(buffer.size='256', workers='2', batch.size.max='32')
+    define stream S (tid int, v long);
+    from S[v >= 0] select tid, v insert into O;
+    """
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    got = []
+    lock = threading.Lock()
+
+    def on_out(evs):
+        with lock:
+            got.extend(tuple(e.data) for e in evs)
+
+    rt.add_callback("O", StreamCallback(on_out))
+    rt.start()
+    ih = rt.input_handler("S")
+
+    N_THREADS, N_EACH = 4, 250
+
+    def producer(tid):
+        for i in range(N_EACH):
+            ih.send([tid, i])
+
+    threads = [threading.Thread(target=producer, args=(t,))
+               for t in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    _drain(rt)
+    assert sorted(got) == sorted(
+        (t, i) for t in range(N_THREADS) for i in range(N_EACH))
+    j = rt.ctx.stream_junctions["S"]
+    assert j.dispatcher is not None
+    assert j.dispatcher.total_enqueued == N_THREADS * N_EACH
+    assert j.dispatcher.buffered_events == 0          # drained
+    m.shutdown()
+
+
+def test_async_junction_preserves_order_single_producer():
+    app = """
+    @async(buffer.size='64')
+    define stream S (v int);
+    from S select v insert into O;
+    """
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback("O", StreamCallback(
+        lambda evs: got.extend(e.data[0] for e in evs)))
+    rt.start()
+    ih = rt.input_handler("S")
+    for i in range(500):
+        ih.send([i])
+    _drain(rt)
+    assert got == list(range(500))        # single worker: FIFO
+    m.shutdown()
+
+
+def test_async_device_query_parity():
+    """@async stream + @device query: outputs match the synchronous device
+    path; packing overlaps compute on the driver thread."""
+    app_async = """
+    @async(buffer.size='128')
+    define stream S (sym string, price double);
+    @device(batch='64')
+    from S[price > 10.0] select sym, price insert into O;
+    """
+    app_sync = app_async.replace("@async(buffer.size='128')\n    ", "")
+    rows = [["a", 5.0], ["b", 11.5], ["c", 20.0], ["d", 10.0]] * 64
+
+    def run(app):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(app)
+        got = []
+        rt.add_callback("O", StreamCallback(
+            lambda evs: got.extend(tuple(e.data) for e in evs)))
+        rt.start()
+        ih = rt.input_handler("S")
+        for r in rows:
+            ih.send(list(r), timestamp=1000)
+        _drain(rt)
+        m.shutdown()
+        return got
+
+    async_out = run(app_async)
+    sync_out = run(app_sync)
+    assert sorted(async_out) == sorted(sync_out)
+    assert len(async_out) == 2 * 64
+
+
+def test_async_device_driver_overlap_counters():
+    app = """
+    define stream S (v double);
+    @device(batch='32', async='true')
+    from S[v > 0.0] select v insert into O;
+    """
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback("O", StreamCallback(
+        lambda evs: got.extend(e.data[0] for e in evs)))
+    rt.start()
+    bridge = rt.device_bridges[0]
+    assert bridge.driver is not None
+    ih = rt.input_handler("S")
+    for i in range(256):
+        ih.send([float(i + 1)])
+    _drain(rt)
+    assert bridge.driver.batches_stepped >= 8
+    assert bridge.driver.step_seconds > 0.0
+    assert len(got) == 256
+    m.shutdown()
+
+
+def test_persist_restore_with_async_device():
+    """Snapshot quiesces the async driver; restore resumes cleanly (window
+    state survives)."""
+    app = """
+    @async(buffer.size='64')
+    define stream S (v long);
+    @device(batch='16')
+    from S#window.length(8) select sum(v) as t insert into O;
+    """
+    from siddhi_tpu.core.snapshot import InMemoryPersistenceStore
+    store = InMemoryPersistenceStore()
+    m = SiddhiManager()
+    m.set_persistence_store(store)
+    rt = m.create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback("O", StreamCallback(
+        lambda evs: got.extend(e.data[0] for e in evs)))
+    rt.start()
+    ih = rt.input_handler("S")
+    for i in range(32):
+        ih.send([i])
+    _drain(rt)
+    rev = rt.persist()
+    before = list(got)
+    m.shutdown()
+
+    m2 = SiddhiManager()
+    m2.set_persistence_store(store)
+    rt2 = m2.create_siddhi_app_runtime(app)
+    got2 = []
+    rt2.add_callback("O", StreamCallback(
+        lambda evs: got2.extend(e.data[0] for e in evs)))
+    rt2.start()
+    rt2.restore_revision(rev)
+    ih2 = rt2.input_handler("S")
+    for i in range(32, 48):
+        ih2.send([i])
+    _drain(rt2)
+    m2.shutdown()
+
+    # continuation parity vs an uninterrupted run
+    m3 = SiddhiManager()
+    rt3 = m3.create_siddhi_app_runtime(app)
+    got3 = []
+    rt3.add_callback("O", StreamCallback(
+        lambda evs: got3.extend(e.data[0] for e in evs)))
+    rt3.start()
+    ih3 = rt3.input_handler("S")
+    for i in range(48):
+        ih3.send([i])
+    _drain(rt3)
+    m3.shutdown()
+    assert before + got2 == got3
+
+
+def test_async_snapshot_restores_into_sync_runtime():
+    """A snapshot persisted in async device mode must restore into a runtime
+    whose @async opt-in was removed (staged batches stepped synchronously)."""
+    app_async = """
+    @async(buffer.size='64')
+    define stream S (v long);
+    @device(batch='16')
+    from S#window.length(8) select sum(v) as t insert into O;
+    """
+    app_sync = app_async.replace("@async(buffer.size='64')\n    ", "")
+    from siddhi_tpu.core.snapshot import InMemoryPersistenceStore
+    store = InMemoryPersistenceStore()
+    m = SiddhiManager()
+    m.set_persistence_store(store)
+    rt = m.create_siddhi_app_runtime(app_async)
+    rt.start()
+    ih = rt.input_handler("S")
+    for i in range(20):
+        ih.send([i])
+    _drain(rt)
+    rev = rt.persist()
+    m.shutdown()
+
+    m2 = SiddhiManager()
+    m2.set_persistence_store(store)
+    rt2 = m2.create_siddhi_app_runtime(app_sync)
+    assert rt2.device_bridges and rt2.device_bridges[0].driver is None
+    got = []
+    rt2.add_callback("O", StreamCallback(
+        lambda evs: got.extend(e.data[0] for e in evs)))
+    rt2.start()
+    rt2.restore_revision(rev)
+    ih2 = rt2.input_handler("S")
+    for i in range(20, 36):
+        ih2.send([i])
+    rt2.flush_device()
+    m2.shutdown()
+
+    # window state survived: compare against an uninterrupted sync run
+    m3 = SiddhiManager()
+    rt3 = m3.create_siddhi_app_runtime(app_sync)
+    got3 = []
+    rt3.add_callback("O", StreamCallback(
+        lambda evs: got3.extend(e.data[0] for e in evs)))
+    rt3.start()
+    ih3 = rt3.input_handler("S")
+    for i in range(36):
+        ih3.send([i])
+    rt3.flush_device()
+    m3.shutdown()
+    assert got == got3[-len(got):]
+
+
+def test_async_backpressure_grows_not_deadlocks():
+    """A tiny buffer with a slow consumer must not wedge the producer."""
+    app = """
+    @async(buffer.size='4', batch.size.max='2')
+    define stream S (v int);
+    from S select v insert into O;
+    """
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    n = [0]
+
+    def slow(evs):
+        time.sleep(0.002)
+        n[0] += len(evs)
+
+    rt.add_callback("O", StreamCallback(slow))
+    rt.start()
+    ih = rt.input_handler("S")
+    t0 = time.monotonic()
+    for i in range(100):
+        ih.send([i])
+    _drain(rt)
+    assert n[0] == 100
+    assert time.monotonic() - t0 < 30.0
+    m.shutdown()
