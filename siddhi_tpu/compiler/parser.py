@@ -417,6 +417,27 @@ class Parser:
         """Lookahead: classify the from-clause as single / join / state."""
         if self.at_kw("every", "not"):
             return "state"
+        if self.at_op("("):
+            # `from (every e1=... -> e2=...) within 1 sec` — a parenthesized
+            # whole-pattern: markers live at depth 1 (WithinPatternTestCase
+            # testQuery2/3 shape)
+            i, depth = self.pos, 0
+            toks = self.tokens
+            while i < len(toks):
+                t = toks[i]
+                if t.type == TokenType.OP:
+                    if t.value in ("(", "["):
+                        depth += 1
+                    elif t.value in (")", "]"):
+                        depth -= 1
+                        if depth == 0:
+                            break
+                    elif depth == 1 and t.value in ("->", ",", "="):
+                        return "state"
+                elif t.type == TokenType.IDENT and depth == 1 and \
+                        t.value.lower() in ("every", "not"):
+                    return "state"
+                i += 1
         depth = 0
         i = self.pos
         toks = self.tokens
@@ -639,10 +660,13 @@ class Parser:
         if alias:
             stream.alias = alias
         sse = StreamStateElement(stream)
-        # counting / kleene postfix
+        # counting / kleene postfix: <n>, <n:m>, <n:>, <:m>
         if self.at_op("<"):
             self.next()
-            mn = int(self.next().value)
+            if self.at_op(":"):          # `<:m>` — unspecified min is 0
+                mn = 0
+            else:
+                mn = int(self.next().value)
             mx = mn
             if self.accept_op(":"):
                 if self.peek().type == TokenType.INT:

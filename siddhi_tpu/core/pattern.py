@@ -239,6 +239,13 @@ class PatternRuntime:
             self.app_context.scheduler.notify_at(
                 fire_at, lambda ts, ni=node_idx, pp=p: self._absent_timer(ni, pp, ts))
         if node.is_count and node.min_count == 0:
+            if node_idx == len(self.c.nodes) - 1:
+                # final zero-min count: the pattern is already complete on
+                # arrival (reference emits immediately with the count empty;
+                # SequenceTestCase.testQuery3)
+                self._emit_from(node, p, now)
+                self._remove_everywhere(p)
+                return
             # zero occurrences allowed: immediately eligible at the successor
             self._make_eligible(node_idx, p, now)
 
@@ -279,12 +286,49 @@ class PatternRuntime:
                     continue
                 if self._expired_partial(node, p, event.timestamp):
                     self._remove_everywhere(p)
+                    # an `every` scope whose instance expired re-initializes
+                    # its start state, and the CURRENT event may consume the
+                    # fresh seed (reference StreamPreStateProcessor expiry +
+                    # init; WithinPatternTestCase.testQuery4)
+                    reseeded = self._reseed_on_expiry(i, p, event.timestamp)
+                    if reseeded is not None:
+                        seed, start = reseeded
+                        # start < i is revisited by the reverse loop; a seed
+                        # landing AT i must be offered this event explicitly
+                        # (the loop iterates a snapshot of pending[i])
+                        if start == i and seed in self.pending[start]:
+                            slist = [b for b in self.c.nodes[start].branches
+                                     if b.stream_id == stream_id]
+                            if slist:
+                                self._try_match(start, self.c.nodes[start],
+                                                slist, seed, event, touched,
+                                                created)
                     continue
                 res = self._try_match(i, node, listens, p, event, touched, created)
                 matched_any = matched_any or res
 
         if self.c.is_sequence:
             self._enforce_strict(stream_id, event, touched, created)
+
+    def _reseed_on_expiry(self, i: int, p: StateEvent, now: int):
+        """Re-seed the `every` scope containing node i after its pending
+        instance expired or was strict-killed (scope = [reseed_to .. j] of the
+        nearest enclosing every end-node j ≥ i). Returns the new seed."""
+        for j in range(i, len(self.c.nodes)):
+            node_j = self.c.nodes[j]
+            if node_j.reseed_to is not None and node_j.reseed_to <= i:
+                start = node_j.reseed_to
+                # another live instance of the scope → nothing to re-seed
+                if any(self.pending[k] for k in range(start, j + 1)):
+                    return None
+                seed = self._build_seed(node_j, p)
+                self._place(start, seed, now)
+                # unlike completion re-seeds, an expiry re-seed is visible to
+                # the event being processed (the reference re-inits the start
+                # state during expiry, before matching)
+                self._created.discard(id(seed))
+                return seed, start
+        return None
 
     def _expired_partial(self, node: StateNode, p: StateEvent, ts: int) -> bool:
         w = self.c.within_ms
@@ -310,32 +354,83 @@ class PatternRuntime:
             matched = True
             touched.add(id(p))
             if b.is_absent:
+                if node.index == 0 and node.kind == "absent" \
+                        and node.waiting_time_ms is not None:
+                    # start-state absent: the forbidden event RESTARTS the
+                    # wait instead of killing the pattern (reference
+                    # AbsentStreamPreStateProcessor keeps start states live;
+                    # AbsentPatternTestCase.testQueryAbsent6/8)
+                    arrival_key = f"absent_arrival_{node.index}"
+                    p.meta[arrival_key] = now
+                    self.app_context.scheduler.notify_at(
+                        now + node.waiting_time_ms,
+                        lambda ts, ni=i, pp=p: self._absent_timer(ni, pp, ts))
+                    return True
                 # the forbidden event arrived → kill the partial
                 self._remove_everywhere(p)
                 return True
             if node.kind == "stream":
-                self.pending[i].remove(p)
+                # an open count node the partial is leaving completes its
+                # `every` scope now (the scope's reseed lives on the count
+                # node; consumption is its completion —
+                # SequenceTestCase.testQuery4 shape)
+                prev_reseed = None
+                if i > 0:
+                    prev = self.c.nodes[i - 1]
+                    if prev.is_count and prev.reseed_to is not None \
+                            and p in self.pending[i - 1]:
+                        prev_reseed = prev
+                # consume from EVERY node (count partials are shared into the
+                # successor's pending via _make_eligible — advancing must
+                # consume the count instance too, reference
+                # CountPatternTestCase.testQuery2)
+                self._remove_everywhere(p)
                 adv = p.copy()
                 adv.bind(b.alias, event)
+                if prev_reseed is not None:
+                    self._do_reseed(prev_reseed, p, now)
                 self._advance(node, adv, now)
             elif node.kind == "count":
                 p.bind(b.alias, event, append=True)
                 cnt = len(p.events[b.alias])
                 if cnt >= node.min_count:
                     if i == len(self.c.nodes) - 1:
-                        # final count node: emit a match per reaching event
+                        # final count node: emit ONCE at min-count and
+                        # consume (reference CountPatternTestCase.testQuery13
+                        # — further extensions do not re-emit)
                         self._emit_from(node, p, now)
-                    else:
-                        self._make_eligible(i, p, now)
+                        self._remove_everywhere(p)
+                        return True
+                    self._make_eligible(i, p, now)
                 if node.max_count != -1 and cnt >= node.max_count:
                     if p in self.pending[i]:
                         self.pending[i].remove(p)
+                    # a maxed-out count node ends its own `every` scope: the
+                    # scope restarts while the closed partial waits at the
+                    # successor (SequenceTestCase.testQuery6: `every e1?`)
+                    if node.reseed_to is not None:
+                        self._do_reseed(node, p, now)
             elif node.kind == "logical":
                 other = [x for x in node.branches if x is not b]
                 p.bind(b.alias, event)
                 sides = p.meta.setdefault(f"logical_{i}", set())
                 sides.add(b.alias)
                 need_both = node.logical_type == LogicalType.AND
+                if need_both:
+                    # ONE event can satisfy both AND sides (the reference's
+                    # two pre-state processors each receive it;
+                    # LogicalPatternTestCase.testQuery5)
+                    for ob in other:
+                        # b is a listening branch, so b.stream_id IS the
+                        # current event's stream
+                        if ob.is_absent or ob.alias in sides or \
+                                ob.stream_id != b.stream_id:
+                            continue
+                        oframe = StateFrame(p, current_alias=ob.alias,
+                                            current_event=event)
+                        if ob.filter_fn is None or bool(ob.filter_fn(oframe)):
+                            p.bind(ob.alias, event)
+                            sides.add(ob.alias)
                 absent_other = other and other[0].is_absent
                 done = (not need_both) or absent_other or all(
                     x.alias in sides for x in node.branches if not x.is_absent
@@ -370,15 +465,13 @@ class PatternRuntime:
         self._do_reseed(node, p, now)
         self._emit(p.copy(), now)
 
-    def _do_reseed(self, node: StateNode, p: StateEvent, now: int) -> None:
-        if node.reseed_to is None:
-            return
+    def _build_seed(self, node: StateNode, p: StateEvent) -> StateEvent:
+        """Clone ``p`` minus the `every` scope's own bindings, recomputing
+        timestamps from the surviving (pre-scope) bindings."""
         seed = p.copy()
         for alias in node.reseed_aliases:
             seed.events.pop(alias, None)
-        for k in list(seed.meta):
-            seed.meta.pop(k)
-        # recompute timestamps from surviving bindings
+        seed.meta.clear()
         ts_list = []
         for v in seed.events.values():
             if isinstance(v, list):
@@ -387,7 +480,12 @@ class PatternRuntime:
                 ts_list.append(v.timestamp)
         seed.first_timestamp = min(ts_list) if ts_list else None
         seed.timestamp = max(ts_list) if ts_list else None
-        self._place(node.reseed_to, seed, now)
+        return seed
+
+    def _do_reseed(self, node: StateNode, p: StateEvent, now: int) -> None:
+        if node.reseed_to is None:
+            return
+        self._place(node.reseed_to, self._build_seed(node, p), now)
 
     def _emit(self, p: StateEvent, now: int) -> None:
         self._remove_everywhere(p)
@@ -407,6 +505,8 @@ class PatternRuntime:
         if arrival is None:
             return
         if node.kind == "absent":
+            if ts < arrival + node.waiting_time_ms:
+                return                   # stale timer: the wait was restarted
             # non-occurrence established → advance
             self.pending[node_idx].remove(p)
             adv = p.copy()
@@ -425,19 +525,37 @@ class PatternRuntime:
     # -- sequence strictness --------------------------------------------------
     def _enforce_strict(self, stream_id: str, event: StreamEvent,
                         touched: set[int], created: set[int]) -> None:
+        seen: set[int] = set()
         for i, lst in enumerate(self.pending):
             node = self.c.nodes[i]
             for p in list(lst):
                 pid = id(p)
+                if pid in seen:
+                    continue            # shared count/eligible partial:
+                seen.add(pid)           # judge it once, at its lowest node
                 if pid in touched or pid in created:
                     continue
-                if i == 0 and not p.events:
+                if not p.events:
                     # start seed: with `every`, seeds persist (retry at every
                     # position); without, the failed first attempt dies
                     has_every = any(n.reseed_to == 0 for n in self.c.nodes)
                     if has_every:
                         continue
-                lst.remove(p)
+                self._remove_everywhere(p)
+                # strict continuity killed an `every` instance mid-scope: the
+                # scope restarts and the fresh attempt may consume THIS very
+                # event (reference SequenceTestCase.testQuery6 — the killing
+                # event seeds the next instance)
+                reseeded = self._reseed_on_expiry(i, p, event.timestamp)
+                if reseeded is not None:
+                    seed, start = reseeded
+                    if seed in self.pending[start]:
+                        snode = self.c.nodes[start]
+                        listens = [b for b in snode.branches
+                                   if b.stream_id == stream_id]
+                        if listens:
+                            self._try_match(start, snode, listens, seed,
+                                            event, touched, created)
 
     # -- snapshot -------------------------------------------------------------
     def snapshot_state(self) -> dict:

@@ -264,10 +264,16 @@ class _NFAResolver:
             raise DeviceCompileError(f"unknown attribute '{var.attribute}'")
         t = d.attribute_type(var.attribute)
         if nfa.states[q].kind == "count":
+            from ..query_api.expression import LAST_INDEX as _LAST
             if var.stream_index == 0:
                 variant = f"b{q}_first_{var.attribute}"
-            else:          # last / None
+            elif var.stream_index in (None, _LAST):
                 variant = f"b{q}_last_{var.attribute}"
+            else:
+                # e2[1], e2[2], ... would silently alias to `last` — the
+                # fixed-width match tables keep only first/last bindings
+                raise DeviceCompileError(
+                    "count e[k] indexing beyond first/last needs host path")
         elif nfa.states[q].kind == "logical":
             variant = f"b{q}x{bi}_{var.attribute}"
         else:
@@ -351,9 +357,9 @@ class DeviceNFACompiler:
                     and nodes[node.index - 1].kind == "count":
                 raise DeviceCompileError(
                     "logical/absent after a count state needs the host path")
-            if node.kind in ("logical", "absent") and self.is_sequence:
+            if node.kind in ("logical", "absent", "count") and self.is_sequence:
                 raise DeviceCompileError(
-                    "logical/absent in sequences needs the host path")
+                    "logical/absent/count in sequences needs the host path")
             if node.kind == "logical" and node.index == 0 and \
                     any(b.is_absent for b in node.branches):
                 raise DeviceCompileError(
@@ -588,8 +594,24 @@ class DeviceNFACompiler:
                     slots = dict(pend[f"p{s}"])
                     has_first = slots["first_ts"] >= 0
                     alive = ~(has_first & (ev_ts - slots["first_ts"] > within))
+                    if not always_seed and every_end is not None \
+                            and s <= every_end:
+                        # an expired in-scope instance re-initializes the
+                        # `every` scope start: its seed returns, usable by
+                        # THIS event (reference re-inits start states during
+                        # expiry; WithinPatternTestCase.testQuery4)
+                        expired = slots["valid"] & ~alive
+                        seeds = seeds + jnp.sum(expired.astype(jnp.int64))
                     slots["valid"] = slots["valid"] & alive
                     pend[f"p{s}"] = slots
+
+            # seeds available to THIS event: replenishments from scope
+            # completions during this event become usable only on the NEXT
+            # event (the reference re-seeds via the post-state processor,
+            # after the completing event is done; EveryPatternTestCase
+            # testQuery7 — the completing event must not immediately reuse
+            # the seed it just returned). Expiry returns (above) ARE visible.
+            seeds0 = seeds
 
             out_mask = jnp.zeros((2, C), jnp.bool_)
             out_cols = [jnp.zeros((2, C), _JNP[t]) for (_, _, t) in out_specs]
@@ -697,10 +719,12 @@ class DeviceNFACompiler:
                                 mask, ev["cols"][mk].astype(_JNP[t]), base)
 
                 if st.logical_type == "and" and not absent_bis:
-                    # both sides must arrive (any order); flags + in-place bind
+                    # both sides must arrive (any order) — and ONE event may
+                    # satisfy both (reference LogicalPatternTestCase
+                    # testQuery5: the same IBM event binds e2 and e3)
                     m0 = bm[0]
-                    m1 = bm[1] & ~m0       # one event binds one side (host:
-                    ns = dict(slots)       # first matching branch wins)
+                    m1 = bm[1]
+                    ns = dict(slots)
                     for bi, ap in ((0, m0), (1, m1)):
                         ns[f"done{bi}"] = ns[f"done{bi}"] | ap
                         side_bind(ns, bi, ap, into=ns)
@@ -763,7 +787,7 @@ class DeviceNFACompiler:
                     # consumes its seed immediately, so always_seed is safe
                     is_and0 = st.logical_type == "and"
                     seeds_ok = jnp.array(True) if (always_seed and not is_and0) \
-                        else seeds > 0
+                        else seeds0 > 0
                     cans = {}
                     taken = jnp.asarray(False)
                     for bi in pres:
@@ -771,7 +795,10 @@ class DeviceNFACompiler:
                         g0 = ev_ok & (ev_tag == br.stream_idx)
                         p0 = jnp.asarray(True) if br.predicate is None \
                             else jnp.asarray(br.predicate(env0))
-                        c = g0 & p0 & ~taken
+                        if st.logical_type == "and":
+                            c = g0 & p0         # one event may bind BOTH sides
+                        else:
+                            c = g0 & p0 & ~taken    # OR: first side wins
                         taken = taken | c
                         cans[bi] = c & seeds_ok
                     can_any = taken & seeds_ok
@@ -781,13 +808,53 @@ class DeviceNFACompiler:
                             seed_vals[f"done{bi}"] = jnp.broadcast_to(
                                 cans[bi], (C,))
                             side_bind(seed_vals, bi, cans[bi])
-                        ins_mask = jnp.zeros((C,), jnp.bool_).at[0].set(can_any)
+                        # one event satisfying BOTH sides completes the state
+                        # on the spot (matching the host path) — a half-done
+                        # seed would otherwise sit complete in p0 until the
+                        # next event, or forever if none arrives
+                        seed_done = can_any
+                        for bi in pres:
+                            seed_done = seed_done & cans[bi]
+                        ins_pend = can_any & ~seed_done
+                        if S == 1:
+                            ins0 = jnp.zeros((C,), jnp.bool_).at[0].set(
+                                seed_done)
+                            emit_env = {f"ev_{k}": ev["cols"][k]
+                                        for k in ev["cols"]}
+                            for (q, key, t) in referenced:
+                                if q == 0:
+                                    emit_env[key] = seed_vals.get(
+                                        key, jnp.zeros((C,), _JNP[t]))
+                            out_mask, out_cols, n_match = emit_rows(
+                                out_mask, out_cols, n_match, ins0, 0,
+                                emit_env)
+                        else:
+                            insc_mask = jnp.zeros((C,), jnp.bool_).at[0].set(
+                                seed_done)
+                            cvals = {key: seed_vals[key]
+                                     for key in seed_vals
+                                     if not key.startswith("done")}
+                            if states[1].kind == "absent":
+                                cvals["arrive_ts"] = jnp.broadcast_to(
+                                    ev_ts, (C,)).astype(jnp.int64)
+                            newc, droppedc, insertedc = insert(
+                                pend["p1"], insc_mask, cvals,
+                                jnp.broadcast_to(ev_ts, (C,)),
+                                jnp.zeros((C,), jnp.int32))
+                            pend["p1"] = newc
+                            touched[1] = touched[1] | insertedc
+                            drops = drops + droppedc.astype(jnp.int64)
+                        ins_mask = jnp.zeros((C,), jnp.bool_).at[0].set(
+                            ins_pend)
                         new0, dropped, inserted = insert(
                             pend["p0"], ins_mask, seed_vals,
                             jnp.broadcast_to(ev_ts, (C,)))
                         pend["p0"] = new0
                         touched[0] = touched[0] | inserted
                         drops = drops + dropped.astype(jnp.int64)
+                        if every_end == 0:
+                            # same-event scope completion replenishes `every`
+                            seeds = seeds + seed_done.astype(jnp.int64)
                     else:    # OR seed completes the state immediately
                         seed_vals = {key: jnp.zeros((C,), _JNP[t])
                                      for (q, key, t) in referenced if q == 0}
@@ -810,7 +877,8 @@ class DeviceNFACompiler:
                                     ev_ts, (C,)).astype(jnp.int64)
                             new1, dropped, inserted = insert(
                                 pend["p1"], ins_mask, seed_vals,
-                                jnp.broadcast_to(ev_ts, (C,)))
+                                jnp.broadcast_to(ev_ts, (C,)),
+                                jnp.zeros((C,), jnp.int32))
                             pend["p1"] = new1
                             touched[1] = touched[1] | inserted
                             drops = drops + dropped.astype(jnp.int64)
@@ -850,9 +918,13 @@ class DeviceNFACompiler:
                     else jnp.broadcast_to(st.predicate(env), (C,))
                 if st.kind == "count":
                     ext = slots["valid"] & ~slots["closed"] & pred & gate
+                    first_ext = ext & (slots["count"] == 0)
                     new_slots = dict(slots)
                     new_slots["count"] = slots["count"] + ext.astype(jnp.int32)
-                    # update last-bound values for extended slots
+                    # update bound values for extended slots: last on every
+                    # extension, first only on the 0→1 transition (slots
+                    # inserted with count=0 have no binding yet — reference
+                    # e1[0] refs; CountPatternTestCase.testQuery9)
                     for (q, key, t) in referenced:
                         if q == s and key.startswith(f"b{s}_last_"):
                             attr = key[len(f"b{s}_last_"):]
@@ -860,6 +932,14 @@ class DeviceNFACompiler:
                                 self.compiled.alias_defs[st.alias].id, attr)
                             new_slots[key] = jnp.where(
                                 ext, ev["cols"][mk].astype(slots[key].dtype),
+                                slots[key])
+                        elif q == s and key.startswith(f"b{s}_first_"):
+                            attr = key[len(f"b{s}_first_"):]
+                            mk = self.merged.col_key(
+                                self.compiled.alias_defs[st.alias].id, attr)
+                            new_slots[key] = jnp.where(
+                                first_ext,
+                                ev["cols"][mk].astype(slots[key].dtype),
                                 slots[key])
                     if st.max_count != -1:
                         new_slots["closed"] = new_slots["closed"] | (
@@ -938,7 +1018,7 @@ class DeviceNFACompiler:
                     env0 = {f"ev_{k}": ev["cols"][k] for k in ev["cols"]}
                     pred0 = True if st.predicate is None else st.predicate(env0)
                     can_seed = gate & jnp.asarray(pred0) & (
-                        jnp.array(True) if always_seed else seeds > 0)
+                        jnp.array(True) if always_seed else seeds0 > 0)
                     # seed advances directly into pending[1] (binding ev) or,
                     # for count state 0, into pending[0] with count=1 — count
                     # state 0 extension handled above won't double-fire because
@@ -981,7 +1061,8 @@ class DeviceNFACompiler:
                                     ev_ts, (C,)).astype(jnp.int64)
                             new1, dropped, inserted = insert(
                                 pend["p1"], ins_mask, seed_vals,
-                                jnp.broadcast_to(ev_ts, (C,)))
+                                jnp.broadcast_to(ev_ts, (C,)),
+                                jnp.zeros((C,), jnp.int32))
                             pend["p1"] = new1
                             touched[1] = touched[1] | inserted
                             drops = drops + dropped.astype(jnp.int64)

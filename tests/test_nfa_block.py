@@ -168,6 +168,29 @@ def test_blocked_capacity_truncation_counts_drops():
     rt.flush()
 
 
+def test_and_single_event_binds_both_sides():
+    """One event satisfying both AND branches completes the logical state on
+    the spot — host and device agree (reference LogicalPatternTestCase
+    testQuery5 shape, single-stream variant)."""
+    app = """
+    define stream A (v double);
+    define stream B (v double);
+    from e1=A[v > 1.0] -> e2=B[v > 10.0] and e3=B[v < 100.0]
+    select e1.v as a, e2.v as b, e3.v as c insert into O;
+    """
+    events = [("A", [5.0], 1000), ("B", [50.0], 1100)]
+    host = oracle(app, events)
+    rt = DeviceNFARuntime(app, slot_capacity=16, batch_capacity=16)
+    assert not rt.compiler.blocked       # logical state → scan kernel
+    rows = []
+    rt.add_callback(rows.extend)
+    for sid, row, ts in events:
+        rt.send(sid, row, ts)
+    rt.flush()
+    assert host == [[5.0, 50.0, 50.0]]
+    assert_rows_match(host, rows)
+
+
 def test_blocked_snapshot_roundtrip():
     events = gen_one_stream(40, 51)
     rows1, rt = device(CHAIN3, events)

@@ -1,0 +1,837 @@
+"""Pattern/sequence corpus transliterated from the reference test suites.
+
+The reference's behavioral tests are the spec (SURVEY §4). Assertions (NOT
+code) ported from:
+
+- ``.../core/query/pattern/EveryPatternTestCase.java``
+- ``.../core/query/pattern/WithinPatternTestCase.java``
+- ``.../core/query/pattern/CountPatternTestCase.java``
+- ``.../core/query/pattern/LogicalPatternTestCase.java``
+- ``.../core/query/pattern/ComplexPatternTestCase.java``
+- ``.../core/query/pattern/absent/AbsentPatternTestCase.java``
+- ``.../core/query/sequence/SequenceTestCase.java``
+
+Each case drives the public API (DSL string → runtime → send → assert) under
+the deterministic playback clock; the reference's ``Thread.sleep`` timing
+becomes explicit event-timestamp gaps. Every case also attempts the compiled
+device path and checks parity when the query is device-compilable (cases
+whose expected rows contain nulls skip device parity: the device NFA's
+unmatched-side zero-value divergence is documented at nfa.py).
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+S2 = """
+define stream Stream1 (symbol string, price double, volume int);
+define stream Stream2 (symbol string, price double, volume int);
+"""
+S2B = """
+define stream Stream1 (symbol string, price double, volume int);
+define stream Stream2 (symbol string, price1 double, volume int);
+"""
+S3 = S2 + "define stream Stream3 (symbol string, price double, volume int);\n"
+S4 = S3 + "define stream Stream4 (symbol string, price double, volume int);\n"
+S1 = "define stream Stream1 (symbol string, price double, volume int);\n"
+
+
+def _case(id, app, seq, expect, end=0, no_device=False):
+    return pytest.param(app, seq, expect, end, no_device, id=id)
+
+
+# seq entries: (stream_id, row) with a default +100ms gap, or
+# (stream_id, row, gap_ms) for explicit spacing. expect: ordered rows, or an
+# int (match count only — the reference asserts only inEventCount there).
+CASES = [
+    # ---------------- EveryPatternTestCase ------------------------------
+    _case("every1", S2 + """
+from e1=Stream1[price>20] -> e2=Stream2[price>e1.price]
+select e1.symbol as symbol1, e2.symbol as symbol2 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 55.7, 100])],
+        [["WSO2", "IBM"]]),
+    _case("every2", S2B + """
+from e1=Stream1[price>20] -> e2=Stream2[price1>e1.price]
+select e1.symbol as symbol1, e2.symbol as symbol2 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["GOOG", 55.6, 100]),
+      ("Stream2", ["IBM", 55.7, 100])],
+        [["WSO2", "IBM"]]),
+    _case("every3", S2B + """
+from every e1=Stream1[price>20] -> e2=Stream2[price1>e1.price]
+select e1.symbol as symbol1, e2.symbol as symbol2 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["GOOG", 55.6, 100]),
+      ("Stream2", ["IBM", 55.7, 100])],
+        [["WSO2", "IBM"], ["GOOG", "IBM"]]),
+    _case("every4", S2 + """
+from every (e1=Stream1[price>20] -> e3=Stream1[price>20])
+  -> e2=Stream2[price>e1.price]
+select e1.price as price1, e3.price as price3, e2.price as price2
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["GOOG", 54.0, 100]),
+      ("Stream2", ["IBM", 57.7, 100])],
+        [[55.6, 54.0, 57.7]]),
+    _case("every5", S2 + """
+from every (e1=Stream1[price>20] -> e3=Stream1[price>20])
+  -> e2=Stream2[price>e1.price]
+select e1.price as price1, e3.price as price3, e2.price as price2
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["GOOG", 54.0, 100]),
+      ("Stream1", ["WSO2", 53.6, 100]), ("Stream1", ["GOOG", 53.0, 100]),
+      ("Stream2", ["IBM", 57.7, 100])],
+        [[55.6, 54.0, 57.7], [53.6, 53.0, 57.7]]),
+    _case("every6", S2 + """
+from e4=Stream1[symbol=='MSFT'] -> every (e1=Stream1[price>20]
+  -> e3=Stream1[price>20]) -> e2=Stream2[price>e1.price]
+select e1.price as price1, e3.price as price3, e2.price as price2
+insert into OutputStream;
+""", [("Stream1", ["MSFT", 55.6, 100]), ("Stream1", ["WSO2", 55.7, 100]),
+      ("Stream1", ["GOOG", 54.0, 100]), ("Stream1", ["WSO2", 53.6, 100]),
+      ("Stream1", ["GOOG", 53.0, 100]), ("Stream2", ["IBM", 57.7, 100])],
+        [[55.7, 54.0, 57.7], [53.6, 53.0, 57.7]]),
+    _case("every7", S1 + """
+from every (e1=Stream1[price>20] -> e3=Stream1[price>20])
+select e1.price as price1, e3.price as price3 insert into OutputStream;
+""", [("Stream1", ["MSFT", 55.6, 100]), ("Stream1", ["WSO2", 57.6, 100]),
+      ("Stream1", ["GOOG", 54.0, 100]), ("Stream1", ["WSO2", 53.6, 100])],
+        [[55.6, 57.6], [54.0, 53.6]]),
+    _case("every8", S1 + """
+from every e1=Stream1[price>20]
+select e1.price as price1 insert into OutputStream;
+""", [("Stream1", ["MSFT", 55.6, 100]), ("Stream1", ["WSO2", 57.6, 100])],
+        [[55.6], [57.6]]),
+
+    # ---------------- WithinPatternTestCase -----------------------------
+    _case("within1", S2 + """
+from every e1=Stream1[price>20] -> e2=Stream2[price>e1.price] within 1 sec
+select e1.symbol as symbol1, e2.symbol as symbol2 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["GOOG", 54.0, 100], 1500),
+      ("Stream2", ["IBM", 55.7, 100], 500)],
+        [["GOOG", "IBM"]]),
+    _case("within2", S2 + """
+from (every e1=Stream1[price>20] -> e2=Stream2[price>e1.price]) within 1 sec
+select e1.symbol as symbol1, e2.symbol as symbol2 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["GOOG", 54.0, 100], 1500),
+      ("Stream2", ["IBM", 55.7, 100], 500)],
+        [["GOOG", "IBM"]]),
+    _case("within3", S2 + """
+from (every (e1=Stream1[price>20] -> e3=Stream1[price>20])
+  -> e2=Stream2[price>e1.price]) within 2 sec
+select e1.price as price1, e3.price as price3, e2.price as price2
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["GOOG", 54.0, 100], 600),
+      ("Stream1", ["WSO2", 53.6, 100], 600), ("Stream1", ["GOOG", 53.0, 100], 900),
+      ("Stream2", ["IBM", 57.7, 100], 600)],
+        [[53.6, 53.0, 57.7]]),
+    _case("within4", S1 + """
+from every (e1=Stream1 -> e2=Stream1[symbol == e1.symbol]) within 5 sec
+select e1.symbol as symbol1, e1.volume as volume1, e2.symbol as symbol2,
+  e2.volume as volume2 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["WSO2", 55.7, 150], 6000),
+      ("Stream1", ["WSO2", 58.7, 200], 500), ("Stream1", ["WSO2", 58.7, 250])],
+        [["WSO2", 150, "WSO2", 200]]),
+    _case("within5", S1 + """
+from every (e1=Stream1 -> e2=Stream1[symbol == e1.symbol]
+  -> e3=Stream1[symbol == e2.symbol]) within 5 sec
+select e1.symbol as symbol1, e1.volume as volume1, e2.symbol as symbol2,
+  e2.volume as volume2, e3.symbol as symbol3, e3.volume as volume3
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["WSO2", 56.6, 150]),
+      ("Stream1", ["WSO2", 57.7, 200], 6000), ("Stream1", ["WSO2", 58.7, 250], 500),
+      ("Stream1", ["WSO2", 57.7, 300]), ("Stream1", ["WSO2", 59.7, 350])],
+        [["WSO2", 200, "WSO2", 250, "WSO2", 300]]),
+    _case("within6", S1 + """
+from every (e1=Stream1 -> e2=Stream1[symbol == e1.symbol]
+  -> e3=Stream1[symbol == e2.symbol]) within 5 sec
+select e1.symbol as symbol1, e1.volume as volume1, e2.symbol as symbol2,
+  e2.volume as volume2, e3.symbol as symbol3, e3.volume as volume3
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["WSO2", 55.7, 150]),
+      ("Stream1", ["WSO2", 58.7, 200]), ("Stream1", ["WSO2", 58.7, 210]),
+      ("Stream1", ["WSO2", 58.7, 250], 500), ("Stream1", ["WSO2", 58.7, 260]),
+      ("Stream1", ["WSO2", 58.7, 270])],
+        [["WSO2", 100, "WSO2", 150, "WSO2", 200],
+         ["WSO2", 210, "WSO2", 250, "WSO2", 260]]),
+    _case("within7", S1 + """
+from every (e1=Stream1 -> e2=Stream1[symbol == e1.symbol]
+  -> e3=Stream1[symbol == e2.symbol]) within 5 sec
+select e1.symbol as symbol1, e1.volume as volume1, e2.symbol as symbol2,
+  e2.volume as volume2, e3.symbol as symbol3, e3.volume as volume3
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["WSO2", 56.6, 150], 6000),
+      ("Stream1", ["WSO2", 57.7, 200]), ("Stream1", ["WSO2", 58.7, 250], 500),
+      ("Stream1", ["WSO2", 57.7, 300]), ("Stream1", ["WSO2", 59.7, 350])],
+        [["WSO2", 150, "WSO2", 200, "WSO2", 250]]),
+
+    # ---------------- CountPatternTestCase ------------------------------
+    _case("count1", S2 + """
+from e1=Stream1[price>20]<2:5> -> e2=Stream2[price>20]
+select e1[0].price as price1_0, e1[1].price as price1_1,
+  e1[2].price as price1_2, e1[3].price as price1_3, e2.price as price2
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 25.6, 100]), ("Stream1", ["GOOG", 47.6, 100]),
+      ("Stream1", ["GOOG", 13.7, 100]), ("Stream1", ["GOOG", 47.8, 100]),
+      ("Stream2", ["IBM", 45.7, 100]), ("Stream2", ["IBM", 55.7, 100])],
+        [[25.6, 47.6, 47.8, None, 45.7]]),
+    _case("count2", S2 + """
+from e1=Stream1[price>20]<2:5> -> e2=Stream2[price>20]
+select e1[0].price as price1_0, e1[1].price as price1_1,
+  e1[2].price as price1_2, e1[3].price as price1_3, e2.price as price2
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 25.6, 100]), ("Stream1", ["GOOG", 47.6, 100]),
+      ("Stream1", ["GOOG", 13.7, 100]), ("Stream2", ["IBM", 45.7, 100]),
+      ("Stream1", ["GOOG", 47.8, 100]), ("Stream2", ["IBM", 55.7, 100])],
+        [[25.6, 47.6, None, None, 45.7]]),
+    _case("count3", S2 + """
+from e1=Stream1[price>20]<2:5> -> e2=Stream2[price>20]
+select e1[0].price as price1_0, e1[1].price as price1_1,
+  e1[2].price as price1_2, e1[3].price as price1_3, e2.price as price2
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 25.6, 100]), ("Stream2", ["IBM", 45.7, 100]),
+      ("Stream1", ["GOOG", 47.8, 100]), ("Stream2", ["IBM", 55.7, 100])],
+        [[25.6, 47.8, None, None, 55.7]]),
+    _case("count4", S2 + """
+from e1=Stream1[price>20]<2:5> -> e2=Stream2[price>20]
+select e1[0].price as price1_0, e1[1].price as price1_1,
+  e1[2].price as price1_2, e1[3].price as price1_3, e2.price as price2
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 25.6, 100]), ("Stream2", ["IBM", 45.7, 100])],
+        0),
+    _case("count5", S2 + """
+from e1=Stream1[price>20]<2:5> -> e2=Stream2[price>20]
+select e1[0].price as price1_0, e1[1].price as price1_1,
+  e1[2].price as price1_2, e1[3].price as price1_3, e2.price as price2
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 25.6, 100]), ("Stream1", ["GOOG", 47.6, 100]),
+      ("Stream1", ["GOOG", 23.7, 100]), ("Stream1", ["GOOG", 24.7, 100]),
+      ("Stream1", ["GOOG", 25.7, 100]), ("Stream1", ["WSO2", 27.6, 100]),
+      ("Stream2", ["IBM", 45.7, 100]), ("Stream1", ["GOOG", 47.8, 100]),
+      ("Stream2", ["IBM", 55.7, 100])],
+        [[25.6, 47.6, 23.7, 24.7, 45.7]]),
+    _case("count6", S2 + """
+from e1=Stream1[price>20]<2:5> -> e2=Stream2[price>e1[1].price]
+select e1[0].price as price1_0, e1[1].price as price1_1, e2.price as price2
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 25.6, 100]), ("Stream1", ["GOOG", 47.6, 100]),
+      ("Stream2", ["IBM", 45.7, 100]), ("Stream2", ["IBM", 55.7, 100])],
+        [[25.6, 47.6, 55.7]]),
+    _case("count7", S2 + """
+from e1=Stream1[price>20]<0:5> -> e2=Stream2[price>20]
+select e1[0].price as price1_0, e1[1].price as price1_1, e2.price as price2
+insert into OutputStream;
+""", [("Stream2", ["IBM", 45.7, 100])],
+        [[None, None, 45.7]]),
+    _case("count8", S2 + """
+from e1=Stream1[price>20]<0:5> -> e2=Stream2[price>e1[0].price]
+select e1[0].price as price1_0, e1[1].price as price1_1, e2.price as price2
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 25.6, 100]), ("Stream1", ["GOOG", 7.6, 100]),
+      ("Stream2", ["IBM", 45.7, 100])],
+        [[25.6, None, 45.7]]),
+    _case("count9", """
+define stream EventStream (symbol string, price double, volume int);
+from e1=EventStream[price >= 50 and volume > 100]
+  -> e2=EventStream[price <= 40]<0:5> -> e3=EventStream[volume <= 70]
+select e1.symbol as symbol1, e2[0].symbol as symbol2, e3.symbol as symbol3
+insert into StockQuote;
+""", [("EventStream", ["IBM", 75.6, 105]), ("EventStream", ["GOOG", 21.0, 81]),
+      ("EventStream", ["WSO2", 176.6, 65])],
+        [["IBM", "GOOG", "WSO2"]]),
+    _case("count10", """
+define stream EventStream (symbol string, price double, volume int);
+from e1=EventStream[price >= 50 and volume > 100]
+  -> e2=EventStream[price <= 40]<:5> -> e3=EventStream[volume <= 70]
+select e1.symbol as symbol1, e2[0].symbol as symbol2, e3.symbol as symbol3
+insert into StockQuote;
+""", [("EventStream", ["IBM", 75.6, 105]), ("EventStream", ["GOOG", 21.0, 61]),
+      ("EventStream", ["WSO2", 21.0, 61])],
+        [["IBM", None, "GOOG"]]),
+    _case("count11", """
+define stream EventStream (symbol string, price double, volume int);
+from e1=EventStream[price >= 50 and volume > 100]
+  -> e2=EventStream[price <= 40]<:5> -> e3=EventStream[volume <= 70]
+select e1.symbol as symbol1, e2[last].symbol as symbol2, e3.symbol as symbol3
+insert into StockQuote;
+""", [("EventStream", ["IBM", 75.6, 105]), ("EventStream", ["GOOG", 21.0, 61]),
+      ("EventStream", ["WSO2", 21.0, 61])],
+        [["IBM", None, "GOOG"]]),
+    _case("count12", """
+define stream EventStream (symbol string, price double, volume int);
+from e1=EventStream[price >= 50 and volume > 100]
+  -> e2=EventStream[price <= 40]<:5> -> e3=EventStream[volume <= 70]
+select e1.symbol as symbol1, e2[last].symbol as symbol2, e3.symbol as symbol3
+insert into StockQuote;
+""", [("EventStream", ["IBM", 75.6, 105]), ("EventStream", ["GOOG", 21.0, 91]),
+      ("EventStream", ["FB", 21.0, 81]), ("EventStream", ["WSO2", 21.0, 61])],
+        [["IBM", "FB", "WSO2"]]),
+    _case("count13", """
+define stream EventStream (symbol string, price double, volume int);
+from every e1=EventStream -> e2=EventStream[e1.symbol==e2.symbol]<4:6>
+select e1.volume as volume1, e2[0].volume as volume2, e2[1].volume as volume3,
+  e2[2].volume as volume4, e2[3].volume as volume5, e2[4].volume as volume6,
+  e2[5].volume as volume7
+insert into StockQuote;
+""", [("EventStream", ["IBM", 75.6, 100]), ("EventStream", ["IBM", 75.6, 200]),
+      ("EventStream", ["IBM", 75.6, 300]), ("EventStream", ["GOOG", 21.0, 91]),
+      ("EventStream", ["IBM", 75.6, 400]), ("EventStream", ["IBM", 75.6, 500]),
+      ("EventStream", ["GOOG", 21.0, 91]), ("EventStream", ["IBM", 75.6, 600]),
+      ("EventStream", ["IBM", 75.6, 700]), ("EventStream", ["IBM", 75.6, 800]),
+      ("EventStream", ["GOOG", 21.0, 91]), ("EventStream", ["IBM", 75.6, 900])],
+        [[100, 200, 300, 400, 500, None, None],
+         [200, 300, 400, 500, 600, None, None],
+         [300, 400, 500, 600, 700, None, None],
+         [400, 500, 600, 700, 800, None, None],
+         [500, 600, 700, 800, 900, None, None]]),
+    _case("count15", S2 + """
+from every e1=Stream1[price>20] -> e2=Stream1[price>20]<2>
+  -> not Stream1[price>20] and e3=Stream2
+select e1.price as price1_0, e2[0].price as price2_0, e2[1].price as price2_1,
+  e2[2].price as price2_2, e3.price as price3_0
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 25.6, 100]), ("Stream1", ["WSO2", 23.6, 100]),
+      ("Stream1", ["WSO2", 23.6, 100]), ("Stream1", ["GOOG", 27.6, 100]),
+      ("Stream1", ["GOOG", 28.6, 100]), ("Stream2", ["IBM", 45.7, 100])],
+        [[23.6, 27.6, 28.6, None, 45.7]]),
+
+    # ---------------- LogicalPatternTestCase ----------------------------
+    _case("logical1", S2 + """
+from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price]
+  or e3=Stream2['IBM' == symbol]
+select e1.symbol as symbol1, e2.symbol as symbol2 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["GOOG", 59.6, 100])],
+        [["WSO2", "GOOG"]]),
+    _case("logical2", S2 + """
+from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price]
+  or e3=Stream2['IBM' == symbol]
+select e1.symbol as symbol1, e2.symbol as symbol2 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 10.7, 100])],
+        [["WSO2", None]]),
+    _case("logical3", S2 + """
+from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price]
+  or e3=Stream2['IBM' == symbol]
+select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 72.7, 100]),
+      ("Stream2", ["IBM", 75.7, 100])],
+        [["WSO2", 72.7, None]]),
+    _case("logical4", S2 + """
+from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price]
+  and e3=Stream2['IBM' == symbol]
+select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["GOOG", 72.7, 100]),
+      ("Stream2", ["IBM", 4.7, 100])],
+        [["WSO2", 72.7, 4.7]]),
+    _case("logical5", S2 + """
+from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price]
+  and e3=Stream2['IBM' == symbol]
+select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 72.7, 100]),
+      ("Stream2", ["IBM", 75.7, 100])],
+        [["WSO2", 72.7, 72.7]]),
+    _case("logical6", S2 + """
+from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price]
+  and e3=Stream1['IBM' == symbol]
+select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 72.7, 100]),
+      ("Stream1", ["IBM", 75.7, 100])],
+        [["WSO2", 72.7, 75.7]]),
+    _case("logical7", S2 + """
+from e1=Stream1[price > 20] and e2=Stream2[price > 30]
+  -> e3=Stream2['IBM' == symbol]
+select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["GOOG", 72.7, 100]),
+      ("Stream2", ["IBM", 4.7, 100])],
+        [["WSO2", 72.7, 4.7]]),
+    _case("logical8", S2 + """
+from e1=Stream1[price > 20] or e2=Stream2[price > 30]
+  -> e3=Stream2['IBM' == symbol]
+select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["GOOG", 72.7, 100]),
+      ("Stream2", ["IBM", 4.7, 100])],
+        [["WSO2", None, 4.7]]),
+    _case("logical9", S2 + """
+from e1=Stream1[price > 20] or e2=Stream2[price > 30]
+  -> e3=Stream2['IBM' == symbol]
+select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+insert into OutputStream;
+""", [("Stream2", ["GOOG", 72.7, 100]), ("Stream2", ["IBM", 4.7, 100])],
+        [[None, 72.7, 4.7]]),
+    _case("logical10", S2 + """
+from e1=Stream1[price > 20] or e2=Stream2[price > 30]
+  -> e3=Stream2['IBM' == symbol]
+select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 4.7, 100])],
+        [["WSO2", None, 4.7]]),
+    _case("logical11", S3 + """
+from every e1=Stream1[price > 20] -> e2=Stream2['IBM' == symbol]
+  and e3=Stream3['WSO2' == symbol]
+select e1.price as price1, e2.price as price2, e3.price as price3
+insert into OutputStream;
+""", [("Stream1", ["IBM", 25.5, 100]), ("Stream1", ["IBM", 59.65, 100]),
+      ("Stream2", ["IBM", 45.5, 100]), ("Stream3", ["WSO2", 46.56, 100])],
+        [[25.5, 45.5, 46.56], [59.65, 45.5, 46.56]]),
+    _case("logical12", S3 + """
+from every e1=Stream1[price > 20] -> e2=Stream2['IBM' == symbol]
+  or e3=Stream3['WSO2' == symbol]
+select e1.price as price1, e2.price as price2, e3.price as price3
+insert into OutputStream;
+""", [("Stream1", ["IBM", 25.5, 100]), ("Stream1", ["IBM", 59.65, 100]),
+      ("Stream2", ["IBM", 45.5, 100])],
+        [[25.5, 45.5, None], [59.65, 45.5, None]]),
+    _case("logical13", S2 + """
+from e1=Stream1[price > 20] and e2=Stream2[price > 30]
+select e1.symbol as symbol1, e2.price as price2 insert into OutputStream;
+""", [("Stream1", ["WSO2", 25.0, 100]), ("Stream2", ["IBM", 35.0, 100]),
+      ("Stream1", ["GOOGLE", 45.0, 100]), ("Stream2", ["ORACLE", 55.0, 100])],
+        [["WSO2", 35.0]]),
+    _case("logical14", S2 + """
+from e1=Stream1[price > 20] or e2=Stream2[price > 30]
+select e1.symbol as symbol1, e2.price as price2 insert into OutputStream;
+""", [("Stream1", ["WSO2", 25.0, 100]), ("Stream2", ["IBM", 35.0, 100]),
+      ("Stream2", ["ORACLE", 45.0, 100])],
+        [["WSO2", None]]),
+    _case("logical15", S2 + """
+from every (e1=Stream1[price > 20] and e2=Stream2[price > 30])
+select e1.symbol as symbol1, e2.price as price2 insert into OutputStream;
+""", [("Stream1", ["WSO2", 25.0, 100]), ("Stream2", ["IBM", 35.0, 100]),
+      ("Stream1", ["GOOGLE", 45.0, 100]), ("Stream2", ["ORACLE", 55.0, 100])],
+        [["WSO2", 35.0], ["GOOGLE", 55.0]]),
+    _case("logical16", S2 + """
+from every (e1=Stream1[price > 20] or e2=Stream2[price > 30])
+select e1.symbol as symbol1, e2.price as price2 insert into OutputStream;
+""", [("Stream1", ["WSO2", 25.0, 100]), ("Stream2", ["IBM", 35.0, 100]),
+      ("Stream2", ["ORACLE", 45.0, 100])],
+        [["WSO2", None], [None, 35.0], [None, 45.0]]),
+    _case("logical17", S2 + """
+from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price]
+  or e3=Stream2['IBM' == symbol] within 1 sec
+select e1.symbol as symbol1, e2.symbol as symbol2 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["GOOG", 59.6, 100], 1200)],
+        0),
+    _case("logical18", S2 + """
+from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price]
+  and e3=Stream2['IBM' == symbol] within 1 sec
+select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["GOOG", 72.7, 100], 1200),
+      ("Stream2", ["IBM", 4.7, 100])],
+        0),
+    _case("logical19", S3 + """
+from every (e1=Stream1[price>10] and e2=Stream2[price>20])
+  -> e3=Stream3[price>30]
+select e1.symbol as symbol1, e2.symbol as symbol2, e3.symbol as symbol3
+insert into OutputStream;
+""", [("Stream1", ["ORACLE", 15.0, 100]), ("Stream2", ["MICROSOFT", 45.0, 100]),
+      ("Stream1", ["IBM", 55.0, 100]), ("Stream2", ["WSO2", 65.0, 100]),
+      ("Stream3", ["GOOGLE", 75.0, 100])],
+        [["ORACLE", "MICROSOFT", "GOOGLE"], ["IBM", "WSO2", "GOOGLE"]]),
+    _case("logical20", S3 + """
+from every (e1=Stream1[price>10] and e2=Stream2[price>20]
+  -> e3=Stream3[price>30])
+select e1.symbol as symbol1, e2.symbol as symbol2, e3.symbol as symbol3
+insert into OutputStream;
+""", [("Stream1", ["ORACLE", 15.0, 100]), ("Stream2", ["MICROSOFT", 45.0, 100]),
+      ("Stream1", ["IBM", 55.0, 100]), ("Stream2", ["WSO2", 65.0, 100]),
+      ("Stream3", ["GOOGLE", 75.0, 100]), ("Stream1", ["IBM1", 55.0, 100]),
+      ("Stream2", ["WSO21", 65.0, 100]), ("Stream3", ["GOOGLE1", 75.0, 100])],
+        [["ORACLE", "MICROSOFT", "GOOGLE"], ["IBM1", "WSO21", "GOOGLE1"]]),
+
+    # ---------------- ComplexPatternTestCase ----------------------------
+    _case("complex1", S2 + """
+from every (e1=Stream1[price > 20] -> e2=Stream2[price > e1.price]
+  or e3=Stream2['IBM' == symbol]) -> e4=Stream2[price > e1.price]
+select e1.price as price1, e2.price as price2, e3.price as price3,
+  e4.price as price4
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["WSO2", 55.7, 100]),
+      ("Stream2", ["GOOG", 55.0, 100]), ("Stream1", ["GOOG", 54.0, 100]),
+      ("Stream2", ["IBM", 57.7, 100]), ("Stream2", ["IBM", 59.7, 100])],
+        [[55.6, 55.7, None, 57.7], [54.0, 57.7, None, 59.7]]),
+    _case("complex2", S2 + """
+from every (e1=Stream1[price > 20] -> e2=Stream1[price > 20]<1:2>)
+  -> e3=Stream1[price > e1.price]
+select e1.price as price1, e2[0].price as price2_0, e2[1].price as price2_1,
+  e3.price as price3
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["GOOG", 54.0, 100]),
+      ("Stream1", ["WSO2", 53.6, 100]), ("Stream1", ["GOOG", 57.0, 100])],
+        [[55.6, 54.0, 53.6, 57.0]]),
+    _case("complex3", S1 + """
+from every e1=Stream1[price >= 50 and volume > 100]
+  -> e2=Stream1[price <= 40]<2:> -> e3=Stream1[volume <= 70]
+select e1.symbol as symbol1, e2[last].symbol as symbol2, e3.symbol as symbol3
+insert into StockQuote;
+""", [("Stream1", ["IBM", 75.6, 105]), ("Stream1", ["GOOG", 39.8, 91]),
+      ("Stream1", ["FB", 35.0, 81]), ("Stream1", ["WSO2", 21.0, 61]),
+      ("Stream1", ["ADP", 50.0, 101]), ("Stream1", ["GOOG", 41.2, 90]),
+      ("Stream1", ["FB", 40.0, 100]), ("Stream1", ["WSO2", 33.6, 85]),
+      ("Stream1", ["AMZN", 23.5, 55]), ("Stream1", ["WSO2", 51.7, 180]),
+      ("Stream1", ["TXN", 34.0, 61]), ("Stream1", ["QQQ", 24.6, 45]),
+      ("Stream1", ["CSCO", 181.6, 40]), ("Stream1", ["WSO2", 53.7, 200])],
+        [["IBM", "FB", "WSO2"], ["ADP", "WSO2", "AMZN"],
+         ["WSO2", "QQQ", "CSCO"]]),
+    _case("complex5", S2 + """
+from e1=Stream1[price >= 50 and volume > 100]
+  -> e2=Stream2[e1.symbol != 'AMBA'] -> e3=Stream2[volume <= 70]
+select e3.symbol as symbol1, e2[0].symbol as symbol2, e3.volume as volume3
+insert into StockQuote;
+""", [("Stream1", ["IBM", 75.6, 105]), ("Stream2", ["GOOG", 21.0, 81]),
+      ("Stream2", ["WSO2", 176.6, 65]), ("Stream1", ["BIRT", 21.0, 81]),
+      ("Stream1", ["AMBA", 126.6, 165]), ("Stream2", ["DDD", 23.0, 181]),
+      ("Stream2", ["BIRT", 21.0, 86]), ("Stream2", ["BIRT", 21.0, 82]),
+      ("Stream2", ["WSO2", 176.6, 60]), ("Stream1", ["AMBA", 126.6, 165]),
+      ("Stream2", ["DOX", 16.2, 25])],
+        [["WSO2", "GOOG", 65]]),
+
+    # ---------------- AbsentPatternTestCase (counts) --------------------
+    _case("absent1", S2 + """
+from e1=Stream1[price>20] -> not Stream2[price>e1.price] for 1 sec
+select e1.symbol as symbol1 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100])], [["WSO2"]], end=1100),
+    _case("absent2", S2 + """
+from e1=Stream1[price>20] -> not Stream2[price>e1.price] for 1 sec
+select e1.symbol as symbol1 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 58.7, 100], 1100)],
+        1, end=1100),
+    _case("absent3", S2 + """
+from e1=Stream1[price>20] -> not Stream2[price>e1.price] for 1 sec
+select e1.symbol as symbol1 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 58.7, 100])],
+        0, end=1100),
+    _case("absent4", S2 + """
+from e1=Stream1[price>20] -> not Stream2[price>e1.price] for 1 sec
+select e1.symbol as symbol1 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 50.7, 100])],
+        1, end=1100),
+    _case("absent5", S2 + """
+from not Stream1[price>20] for 1 sec -> e2=Stream2[price>30]
+select e2.symbol as symbol insert into OutputStream;
+""", [("Stream2", ["IBM", 58.7, 100], 1100)], [["IBM"]]),
+    _case("absent6", S2 + """
+from not Stream1[price>20] for 1 sec -> e2=Stream2[price>30]
+select e2.symbol as symbol insert into OutputStream;
+""", [("Stream1", ["WSO2", 59.6, 100], 100),
+      ("Stream2", ["IBM", 58.7, 100], 2100)],
+        1),
+    _case("absent7", S2 + """
+from not Stream1[price>20] for 1 sec -> e2=Stream2[price>30]
+select e2.symbol as symbol insert into OutputStream;
+""", [("Stream1", ["WSO2", 5.6, 100], 100), ("Stream2", ["IBM", 58.7, 100])],
+        0),
+    _case("absent8", S2 + """
+from not Stream1[price>20] for 1 sec -> e2=Stream2[price>30]
+select e2.symbol as symbol insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100], 100), ("Stream2", ["IBM", 58.7, 100])],
+        0),
+    _case("absent9", S3 + """
+from e1=Stream1[price>10] -> e2=Stream2[price>20]
+  -> not Stream3[price>30] for 1 sec
+select e1.symbol as symbol1, e2.symbol as symbol2 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.6, 100]), ("Stream2", ["IBM", 28.7, 100]),
+      ("Stream3", ["GOOGLE", 55.7, 100])],
+        0, end=1100),
+    _case("absent10", S3 + """
+from e1=Stream1[price>10] -> e2=Stream2[price>20]
+  -> not Stream3[price>30] for 1 sec
+select e1.symbol as symbol1, e2.symbol as symbol2 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.6, 100]), ("Stream2", ["IBM", 28.7, 100]),
+      ("Stream3", ["GOOGLE", 25.7, 100])],
+        [["WSO2", "IBM"]], end=1100),
+    _case("absent11", S3 + """
+from e1=Stream1[price>10] -> e2=Stream2[price>20]
+  -> not Stream3[price>30] for 1 sec
+select e1.symbol as symbol1, e2.symbol as symbol2 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.6, 100]), ("Stream2", ["IBM", 28.7, 100])],
+        1, end=1100),
+    _case("absent12", S3 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+  -> e3=Stream3[price>30]
+select e1.symbol as symbol1, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.6, 100]),
+      ("Stream3", ["GOOGLE", 55.7, 100], 1100)],
+        [["WSO2", "GOOGLE"]]),
+    _case("absent13", S3 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+  -> e3=Stream3[price>30]
+select e1.symbol as symbol1, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.6, 100]), ("Stream2", ["IBM", 8.7, 100]),
+      ("Stream3", ["GOOGLE", 55.7, 100], 1100)],
+        1),
+    _case("absent14", S3 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+  -> e3=Stream3[price>30]
+select e1.symbol as symbol1, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.6, 100]), ("Stream2", ["IBM", 28.7, 100]),
+      ("Stream3", ["GOOGLE", 55.7, 100])],
+        0, end=1100),
+    _case("absent16", S3 + """
+from not Stream1[price>10] for 1 sec -> e2=Stream2[price>20]
+  -> e3=Stream3[price>30]
+select e2.symbol as symbol2, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream2", ["IBM", 28.7, 100], 2100),
+      ("Stream3", ["GOOGLE", 55.7, 100])],
+        1),
+    _case("absent21", S4 + """
+from e1=Stream1[price>10] -> e2=Stream2[price>20]
+  -> not Stream3[price>30] for 1 sec -> e4=Stream4[price>40]
+select e1.symbol as symbol1, e2.symbol as symbol2, e4.symbol as symbol4
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.6, 100]), ("Stream2", ["IBM", 28.7, 100]),
+      ("Stream4", ["ORACLE", 44.7, 100], 1100)],
+        [["WSO2", "IBM", "ORACLE"]]),
+    _case("absent22", S4 + """
+from e1=Stream1[price>10] -> e2=Stream2[price>20]
+  -> not Stream3[price>30] for 1 sec -> e4=Stream4[price>40]
+select e1.symbol as symbol1, e2.symbol as symbol2, e4.symbol as symbol4
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.6, 100]), ("Stream2", ["IBM", 28.7, 100]),
+      ("Stream3", ["GOOGLE", 38.7, 100]), ("Stream4", ["ORACLE", 44.7, 100], 1100)],
+        0, end=1100),
+    _case("absent24", S4 + """
+from not Stream1[price>10] for 1 sec -> e2=Stream2[price>20]
+  -> not Stream3[price>30] for 1 sec -> e4=Stream4[price>40]
+select e2.symbol as symbol2, e4.symbol as symbol4 insert into OutputStream;
+""", [("Stream2", ["IBM", 28.7, 100], 1100),
+      ("Stream4", ["ORACLE", 44.7, 100], 1100)],
+        [["IBM", "ORACLE"]]),
+    _case("absent28", S4 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+  -> e2=Stream3[price>30] and e3=Stream4[price>40]
+select e1.symbol as symbol1, e2.symbol as symbol2, e3.symbol as symbol3
+insert into OutputStream;
+""", [("Stream1", ["IBM", 18.7, 100]), ("Stream3", ["WSO2", 35.0, 100], 1100),
+      ("Stream4", ["GOOGLE", 56.86, 100])],
+        [["IBM", "WSO2", "GOOGLE"]]),
+    _case("absent29", S4 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+  -> e2=Stream3[price>30] and e3=Stream4[price>40]
+select e1.symbol as symbol1, e2.symbol as symbol2, e3.symbol as symbol3
+insert into OutputStream;
+""", [("Stream1", ["IBM", 18.7, 100]), ("Stream3", ["WSO2", 35.0, 100]),
+      ("Stream4", ["GOOGLE", 56.86, 100])],
+        0, end=1100),
+    _case("absent30", S4 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+  -> e2=Stream3[price>30] or e3=Stream4[price>40]
+select e1.symbol as symbol1, e2.symbol as symbol2, e3.symbol as symbol3
+insert into OutputStream;
+""", [("Stream1", ["IBM", 18.7, 100]), ("Stream3", ["WSO2", 35.0, 100], 1100)],
+        [["IBM", "WSO2", None]]),
+    _case("absent31", S4 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+  -> e2=Stream3[price>30] or e3=Stream4[price>40]
+select e1.symbol as symbol1, e2.symbol as symbol2, e3.symbol as symbol3
+insert into OutputStream;
+""", [("Stream1", ["IBM", 18.7, 100]),
+      ("Stream4", ["GOOGLE", 56.86, 100], 1100)],
+        [["IBM", None, "GOOGLE"]]),
+    _case("absent36", S2 + """
+from not Stream1[price>10] for 1 sec -> e2=Stream2[price>20]<2:5>
+select e2[0].symbol as symbol0, e2[1].symbol as symbol1,
+  e2[2].symbol as symbol2, e2[3].symbol as symbol3
+insert into OutputStream;
+""", [("Stream2", ["WSO2", 35.0, 100], 1100), ("Stream2", ["IBM", 45.0, 100])],
+        1, end=1100),
+    _case("absent42", S2 + """
+from not Stream1[price>20] for 1 sec -> e2=Stream2[price>30] within 2 sec
+select e2.symbol as symbol insert into OutputStream;
+""", [("Stream2", ["IBM", 58.7, 100], 1100)],
+        1),
+
+    # ---------------- SequenceTestCase ----------------------------------
+    _case("seq1", S2 + """
+from e1=Stream1[price>20], e2=Stream2[price>e1.price]
+select e1.symbol as symbol1, e2.symbol as symbol2 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 55.7, 100])],
+        [["WSO2", "IBM"]]),
+    _case("seq2", S2 + """
+from every e1=Stream1[price>20], e2=Stream2[price>e1.price]
+select e1.symbol as symbol1, e2.symbol as symbol2 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["GOOG", 57.6, 100]),
+      ("Stream2", ["IBM", 65.7, 100])],
+        [["GOOG", "IBM"]]),
+    _case("seq3", S2 + """
+from every e1=Stream1[price>20], e2=Stream2[price>e1.price]*
+select e1.symbol as symbol1, e2[0].symbol as symbol2, e2[1].symbol as symbol3
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["IBM", 55.7, 100])],
+        [["WSO2", None, None], ["IBM", None, None]]),
+    _case("seq4", S2 + """
+from every e1=Stream2[price>20]*, e2=Stream1[price>e1[0].price]
+select e1[0].price as price1, e1[1].price as price2, e2.price as price3
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 59.6, 100]), ("Stream2", ["WSO2", 55.6, 100]),
+      ("Stream2", ["IBM", 55.7, 100]), ("Stream1", ["WSO2", 57.6, 100])],
+        [[55.6, 55.7, 57.6]]),
+    _case("seq5", S2 + """
+from every e1=Stream2[price>20]*, e2=Stream1[price>e1[0].price]
+select e1[0].price as price1, e1[1].price as price2, e2.price as price3
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 59.6, 100]), ("Stream2", ["WSO2", 55.6, 100]),
+      ("Stream2", ["IBM", 55.0, 100]), ("Stream1", ["WSO2", 57.6, 100])],
+        [[55.6, 55.0, 57.6]]),
+    _case("seq6", S2 + """
+from every e1=Stream2[price>20]?, e2=Stream1[price>e1[0].price]
+select e1[0].price as price1, e2.price as price3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 59.6, 100]), ("Stream2", ["WSO2", 55.6, 100]),
+      ("Stream2", ["IBM", 55.7, 100]), ("Stream1", ["WSO2", 57.6, 100])],
+        [[55.7, 57.6]]),
+    _case("seq7", S2 + """
+from every e1=Stream2[price>20], e2=Stream2[price>e1.price]
+  or e3=Stream2[symbol=='IBM']
+select e1.price as price1, e2.price as price2, e3.price as price3
+insert into OutputStream;
+""", [("Stream2", ["WSO2", 59.6, 100]), ("Stream2", ["WSO2", 55.6, 100]),
+      ("Stream2", ["IBM", 55.7, 100]), ("Stream2", ["WSO2", 57.6, 100])],
+        [[55.6, 55.7, None], [55.7, 57.6, None]]),
+    _case("seq8", S2 + """
+from every e1=Stream2[price>20], e2=Stream2[price>e1.price]
+  or e3=Stream2[symbol=='IBM']
+select e1.price as price1, e2.price as price2, e3.price as price3
+insert into OutputStream;
+""", [("Stream2", ["WSO2", 59.6, 100]), ("Stream2", ["WSO2", 55.6, 100]),
+      ("Stream2", ["IBM", 55.0, 100]), ("Stream2", ["WSO2", 57.6, 100])],
+        [[55.6, None, 55.0], [55.0, 57.6, None]]),
+    _case("seq9", S2 + """
+from every e1=Stream2[price>20], e2=Stream2[price>e1.price]
+  or e3=Stream2[symbol=='IBM']
+select e1.price as price1, e2.price as price2, e3.price as price3
+insert into OutputStream;
+""", [("Stream2", ["WSO2", 59.6, 100]), ("Stream2", ["WSO2", 55.6, 100]),
+      ("Stream2", ["WSO2", 57.6, 100]), ("Stream2", ["IBM", 55.7, 100])],
+        [[55.6, 57.6, None], [57.6, None, 55.7]]),
+    _case("seq10", S2 + """
+from every e1=Stream2[price>20]+, e2=Stream1[price>e1[0].price]
+select e1[0].price as price1, e1[1].price as price2, e2.price as price3
+insert into OutputStream;
+""", [("Stream1", ["WSO2", 59.6, 100]), ("Stream2", ["WSO2", 55.6, 100]),
+      ("Stream1", ["WSO2", 57.6, 100])],
+        [[55.6, None, 57.6]]),
+    _case("seq12", """
+define stream StockStream (symbol string, price double, volume int);
+define stream TwitterStream (symbol string, count int);
+from every e1=StockStream[price >= 50 and volume > 100],
+  e2=TwitterStream[count > 10]
+select e1.price as price, e1.symbol as symbol, e2.count as count
+insert into OutputStream;
+""", [("StockStream", ["IBM", 75.6, 105]), ("StockStream", ["GOOG", 51.0, 101]),
+      ("StockStream", ["IBM", 76.6, 111]), ("TwitterStream", ["IBM", 20]),
+      ("StockStream", ["WSO2", 45.6, 100]), ("TwitterStream", ["GOOG", 20])],
+        [[76.6, "IBM", 20]]),
+    _case("seq13", """
+define stream StockStream (symbol string, price double, volume int);
+define stream TwitterStream (symbol string, count int);
+from every e1=StockStream[price >= 50 and volume > 100],
+  e2=StockStream[price <= 40]*, e3=StockStream[volume <= 70]
+select e1.symbol as symbol1, e2[0].symbol as symbol2, e3.symbol as symbol3
+insert into OutputStream;
+""", [("StockStream", ["IBM", 75.6, 105]), ("StockStream", ["GOOG", 21.0, 81]),
+      ("StockStream", ["WSO2", 176.6, 65])],
+        [["IBM", "GOOG", "WSO2"]]),
+    _case("seq14", """
+define stream StockStream1 (symbol string, price double, volume int);
+define stream StockStream2 (symbol string, price double, volume int);
+from every e1=StockStream1[price >= 50 and volume > 100],
+  e2=StockStream2[price <= 40]*, e3=StockStream2[volume <= 70]
+select e3.symbol as symbol1, e2[0].symbol as symbol2, e3.volume as volume
+insert into OutputStream;
+""", [("StockStream1", ["IBM", 75.6, 105]), ("StockStream2", ["GOOG", 21.0, 81]),
+      ("StockStream2", ["WSO2", 176.6, 65]), ("StockStream1", ["BIRT", 21.0, 81]),
+      ("StockStream1", ["AMBA", 126.6, 165]), ("StockStream2", ["DDD", 23.0, 181]),
+      ("StockStream2", ["BIRT", 21.0, 86]), ("StockStream2", ["BIRT", 21.0, 82]),
+      ("StockStream2", ["WSO2", 176.6, 60]), ("StockStream1", ["AMBA", 126.6, 165]),
+      ("StockStream2", ["DOX", 16.2, 25])],
+        [["WSO2", "GOOG", 65], ["WSO2", "DDD", 60], ["DOX", None, 25]]),
+]
+
+
+# the app "starts" at START; each seq entry's gap (default 100ms) elapses
+# BEFORE its send — mirrors the reference's runtime.start(); Thread.sleep(gap);
+# send() shape (absent-pattern waiting clocks are armed at start time)
+START = 900
+
+
+def _run_host(app, seq, end):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, playback=True, start_time=START)
+    rows = []
+    rt.add_callback("OutputStream" if "OutputStream" in app else "StockQuote",
+                    StreamCallback(lambda evs: rows.extend(
+                        list(e.data) for e in evs)))
+    rt.start()
+    ts = START
+    for entry in seq:
+        sid, row = entry[0], entry[1]
+        ts += entry[2] if len(entry) > 2 else 100
+        rt.input_handler(sid).send(list(row), timestamp=ts)
+    if end:
+        rt.advance_time(ts + end)
+    m.shutdown()
+    return rows
+
+
+def _run_device(app, seq):
+    from siddhi_tpu.tpu.expr_compile import DeviceCompileError
+    from siddhi_tpu.tpu.nfa import DeviceNFARuntime
+    try:
+        rt = DeviceNFARuntime(app, slot_capacity=32, batch_capacity=32)
+    except DeviceCompileError:
+        return None
+    rows = []
+    rt.add_callback(rows.extend)
+    ts = START
+    for entry in seq:
+        sid, row = entry[0], entry[1]
+        ts += entry[2] if len(entry) > 2 else 100
+        rt.send(sid, list(row), ts)
+    rt.flush()
+    return rows
+
+
+def _key(row):
+    return [repr(v) for v in row]
+
+
+def _rows_match(got, want, tol=0.0):
+    """Order-insensitive row-set comparison; floats within tol (the device
+    computes in f32 — dtype policy)."""
+    if len(got) != len(want):
+        return False
+    for g, w in zip(sorted(got, key=_key), sorted(want, key=_key)):
+        if len(g) != len(w):
+            return False
+        for a, b in zip(g, w):
+            if isinstance(a, float) and isinstance(b, float):
+                if abs(a - b) > tol + 1e-9 + abs(b) * 1e-5:
+                    return False
+            elif a != b:
+                return False
+    return True
+
+
+@pytest.mark.parametrize("app,seq,expect,end,no_device", CASES)
+def test_reference_corpus(app, seq, expect, end, no_device):
+    rows = _run_host(app, seq, end)
+    if isinstance(expect, int):
+        assert len(rows) == expect, f"host rows: {rows}"
+    else:
+        assert _rows_match(rows, expect), f"host rows: {rows}"
+
+    # device parity (best-effort: host-only shapes raise DeviceCompileError;
+    # null-bearing outputs diverge by design — device emits zero values)
+    has_null = (not isinstance(expect, int)) and \
+        any(v is None for r in expect for v in r)
+    if no_device or end or has_null:
+        return
+    drows = _run_device(app, seq)
+    if drows is None:
+        return
+    if isinstance(expect, int):
+        assert len(drows) == expect, f"device rows: {drows}"
+    else:
+        assert _rows_match(drows, expect, tol=1e-4), f"device rows: {drows}"
