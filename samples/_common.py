@@ -1,0 +1,16 @@
+"""Shared sample scaffolding: force the CPU backend (samples must run
+anywhere; the TPU tunnel is only needed for bench.py) and put the repo on
+sys.path so samples run standalone: ``python samples/<name>.py``."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:
+    import jax._src.xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

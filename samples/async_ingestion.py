@@ -1,0 +1,39 @@
+"""Async ingestion: @async decouples producers from processing (the
+reference's Disruptor mode); with @device it overlaps host-side batch
+packing with device compute."""
+
+import _common  # noqa: F401
+
+import threading
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+APP = """
+@async(buffer.size='256', batch.size.max='32')
+define stream S (tid int, v long);
+
+from S select tid, sum(v) as total insert into O;
+"""
+
+manager = SiddhiManager()
+runtime = manager.create_siddhi_app_runtime(APP)
+count = [0]
+runtime.add_callback("O", StreamCallback(
+    lambda events: count.__setitem__(0, count[0] + len(events))))
+runtime.start()
+
+handler = runtime.input_handler("S")
+
+def producer(tid):
+    for i in range(500):
+        handler.send([tid, i])          # thread-safe: async enqueue
+
+threads = [threading.Thread(target=producer, args=(t,)) for t in range(4)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+runtime.drain_async()                   # barrier: queue empty, workers idle
+print(f"  processed {count[0]} events from 4 producer threads")
+assert count[0] == 2000
+manager.shutdown()

@@ -1,0 +1,29 @@
+"""Device offload for patterns: the blocked NFA kernel resolves a whole
+micro-batch in S data-parallel stages (S = pattern states). Same DSL, same
+results as the host path."""
+
+import _common  # noqa: F401
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+APP = """
+define stream S (v double);
+
+@device(batch='32', slots='16')
+from every e1=S[v > 10.0] -> e2=S[v > e1.v] -> e3=S[v > e2.v] within 5 sec
+select e1.v as a, e2.v as b, e3.v as c
+insert into Rising;
+"""
+
+manager = SiddhiManager()
+runtime = manager.create_siddhi_app_runtime(APP, playback=True)
+runtime.add_callback("Rising", StreamCallback(
+    lambda events: [print(f"  rising chain: {e.data}") for e in events]))
+runtime.start()
+assert runtime.device_bridges
+
+handler = runtime.input_handler("S")
+for i, v in enumerate([11.0, 5.0, 12.0, 13.0, 2.0, 14.0]):
+    handler.send([v], timestamp=1000 + i * 100)
+runtime.flush_device()
+manager.shutdown()

@@ -1,0 +1,35 @@
+"""Quickstart: a custom scalar function extension (reference
+ExtensionSample.java's custom string:concat)."""
+
+import _common  # noqa: F401
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.extension import ScalarFunctionExtension, extension
+from siddhi_tpu.query_api.definition import DataType
+
+
+@extension("custom:fahrenheit", kind="function",
+           description="Celsius to Fahrenheit")
+class Fahrenheit(ScalarFunctionExtension):
+    return_type = DataType.DOUBLE
+
+    def execute(self, args):
+        return args[0] * 9.0 / 5.0 + 32.0
+
+
+APP = """
+define stream TempStream (room string, celsius double);
+
+from TempStream
+select room, custom:fahrenheit(celsius) as fahrenheit
+insert into OutStream;
+"""
+
+manager = SiddhiManager()
+manager.set_extension("custom:fahrenheit", Fahrenheit)
+runtime = manager.create_siddhi_app_runtime(APP, playback=True)
+runtime.add_callback("OutStream", StreamCallback(
+    lambda events: [print(f"  {e.data}") for e in events]))
+runtime.start()
+runtime.input_handler("TempStream").send(["r1", 100.0], timestamp=1000)
+manager.shutdown()

@@ -1,0 +1,32 @@
+"""Incremental aggregation: sec...min rollup cascade queried on demand with
+`within ... per ...` (reference AggregationRuntime)."""
+
+import _common  # noqa: F401
+
+from siddhi_tpu import SiddhiManager
+
+APP = """
+define stream Trades (sym string, price double);
+
+define aggregation TradeAgg
+from Trades
+select sym, avg(price) as avgPrice, count() as n
+group by sym
+aggregate every sec ... min;
+"""
+
+manager = SiddhiManager()
+runtime = manager.create_siddhi_app_runtime(APP, playback=True)
+runtime.start()
+
+handler = runtime.input_handler("Trades")
+handler.send(["a", 10.0], timestamp=1_000)
+handler.send(["a", 20.0], timestamp=1_400)
+handler.send(["a", 30.0], timestamp=62_000)
+
+rows = runtime.query(
+    "from TradeAgg within 0L, 120000L per 'seconds' "
+    "select AGG_TIMESTAMP, sym, avgPrice, n")
+for e in rows:
+    print(f"  bucket: {e.data}")
+manager.shutdown()

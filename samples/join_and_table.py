@@ -1,0 +1,28 @@
+"""Stream-table join with an in-memory table and primary-key pushdown."""
+
+import _common  # noqa: F401
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+APP = """
+define stream Orders (sym string, qty int);
+@PrimaryKey('sym')
+define table Prices (sym string, price double);
+
+from Orders join Prices on Prices.sym == Orders.sym
+select Orders.sym as sym, Orders.qty as qty,
+       Orders.qty * Prices.price as value
+insert into Valued;
+"""
+
+manager = SiddhiManager()
+runtime = manager.create_siddhi_app_runtime(APP, playback=True)
+runtime.add_callback("Valued", StreamCallback(
+    lambda events: [print(f"  {e.data}") for e in events]))
+runtime.start()
+
+runtime.ctx.tables["Prices"].add([["a", 10.0], ["b", 2.5]])
+handler = runtime.input_handler("Orders")
+handler.send(["a", 3], timestamp=1000)
+handler.send(["b", 4], timestamp=1100)
+manager.shutdown()

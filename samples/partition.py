@@ -1,0 +1,32 @@
+"""Quickstart: value partition — per-key isolated query state (reference
+PartitionSample.java)."""
+
+import _common  # noqa: F401
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+APP = """
+define stream LoginStream (user string, ok bool);
+
+partition with (user of LoginStream)
+begin
+  @info(name = 'failCount')
+  from LoginStream[ok == false]#window.lengthBatch(3)
+  select user, count() as fails
+  insert into AlertStream;
+end;
+"""
+
+manager = SiddhiManager()
+runtime = manager.create_siddhi_app_runtime(APP, playback=True)
+runtime.add_callback("AlertStream", StreamCallback(
+    lambda events: [print(f"  3 failures: {e.data}") for e in events]))
+runtime.start()
+
+handler = runtime.input_handler("LoginStream")
+for i, (user, ok) in enumerate([
+        ("alice", False), ("bob", False), ("alice", False), ("bob", True),
+        ("alice", False), ("bob", False), ("bob", False)]):
+    handler.send([user, ok], timestamp=1000 + i * 10)
+
+manager.shutdown()

@@ -1,0 +1,30 @@
+"""Quickstart: pattern detection — `every A -> B` with a bound reference and
+`within` expiry (reference pattern test shapes; BASELINE config #2)."""
+
+import _common  # noqa: F401
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+APP = """
+define stream TempStream (room string, temp double);
+
+@info(name = 'spike')
+from every e1=TempStream[temp > 30.0]
+  -> e2=TempStream[room == e1.room and temp > e1.temp] within 1 min
+select e1.room as room, e1.temp as first, e2.temp as second
+insert into SpikeStream;
+"""
+
+manager = SiddhiManager()
+runtime = manager.create_siddhi_app_runtime(APP, playback=True)
+runtime.add_callback("SpikeStream", StreamCallback(
+    lambda events: [print(f"  rising spike: {e.data}") for e in events]))
+runtime.start()
+
+handler = runtime.input_handler("TempStream")
+handler.send(["r1", 31.0], timestamp=1_000)
+handler.send(["r2", 33.0], timestamp=2_000)
+handler.send(["r1", 35.0], timestamp=3_000)    # matches r1's chain
+handler.send(["r2", 36.0], timestamp=4_000)    # matches r2's chain
+
+manager.shutdown()

@@ -1,0 +1,43 @@
+"""Performance harness: simple-filter throughput, printed every batch
+(reference SimpleFilterSingleQueryPerformance.java:46-58 — prints throughput
+per 10M events; scaled down here)."""
+
+import _common  # noqa: F401
+
+import random
+import time
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+APP = """
+define stream StockStream (symbol string, price double, volume long);
+from StockStream[price > 50.0]
+select symbol, price insert into Out;
+"""
+
+N = int(__import__("os").environ.get("N_EVENTS", 100_000))
+BATCH = 20_000
+
+manager = SiddhiManager()
+runtime = manager.create_siddhi_app_runtime(APP, playback=True)
+matched = [0]
+runtime.add_callback("Out", StreamCallback(
+    lambda evs: matched.__setitem__(0, matched[0] + len(evs))))
+runtime.start()
+
+handler = runtime.input_handler("StockStream")
+rng = random.Random(1)
+rows = [["s" + str(rng.randrange(100)), rng.uniform(0, 100), 10]
+        for _ in range(BATCH)]
+sent = 0
+t0 = time.perf_counter()
+last = t0
+while sent < N:
+    for i, r in enumerate(rows):
+        handler.send(r, timestamp=sent + i)
+    sent += len(rows)
+    now = time.perf_counter()
+    print(f"  {sent:>9} events; batch {len(rows)/ (now-last):,.0f} ev/s; "
+          f"overall {sent/(now-t0):,.0f} ev/s; matched {matched[0]}")
+    last = now
+manager.shutdown()

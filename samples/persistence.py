@@ -1,0 +1,40 @@
+"""Checkpoint/restore: persist() snapshots every stateful element (window
+contents, pattern partials, tables — device state included as fetched
+pytrees); restore_last_revision() resumes exactly."""
+
+import _common  # noqa: F401
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.snapshot import InMemoryPersistenceStore
+
+APP = """
+define stream S (v long);
+from S#window.length(4) select sum(v) as total insert into O;
+"""
+
+store = InMemoryPersistenceStore()
+
+m1 = SiddhiManager()
+m1.set_persistence_store(store)
+r1 = m1.create_siddhi_app_runtime(APP, playback=True)
+r1.add_callback("O", StreamCallback(lambda evs: None))
+r1.start()
+ih = r1.input_handler("S")
+for i, v in enumerate([10, 20, 30]):
+    ih.send([v], timestamp=1000 + i)
+revision = r1.persist()
+print(f"  persisted revision {revision}")
+m1.shutdown()
+
+m2 = SiddhiManager()
+m2.set_persistence_store(store)
+r2 = m2.create_siddhi_app_runtime(APP, playback=True)
+out = []
+r2.add_callback("O", StreamCallback(
+    lambda evs: out.extend(e.data[0] for e in evs)))
+r2.start()
+r2.restore_last_revision()
+r2.input_handler("S").send([40], timestamp=2000)
+print(f"  sum after restore + one event: {out[-1]}")   # 10+20+30+40
+assert out[-1] == 100
+m2.shutdown()

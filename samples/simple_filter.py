@@ -1,0 +1,30 @@
+"""Quickstart: filter query (reference SimpleFilterSample.java).
+
+A SiddhiApp is a text DSL: stream definitions + continuous queries. Events go
+in through an InputHandler; results come back through callbacks."""
+
+import _common  # noqa: F401
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+APP = """
+define stream StockStream (symbol string, price double, volume long);
+
+@info(name = 'filterQuery')
+from StockStream[price > 50.0]
+select symbol, price
+insert into HighPriceStream;
+"""
+
+manager = SiddhiManager()
+runtime = manager.create_siddhi_app_runtime(APP, playback=True)
+runtime.add_callback("HighPriceStream", StreamCallback(
+    lambda events: [print(f"  high price: {e.data}") for e in events]))
+runtime.start()
+
+handler = runtime.input_handler("StockStream")
+for i, (sym, price, vol) in enumerate([
+        ("WSO2", 55.6, 100), ("IBM", 40.0, 50), ("GOOG", 120.0, 30)]):
+    handler.send([sym, price, vol], timestamp=1000 + i * 100)
+
+manager.shutdown()

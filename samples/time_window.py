@@ -1,0 +1,30 @@
+"""Quickstart: sliding time window + aggregation (reference
+TimeWindowSample.java). Playback mode makes the clock event-driven: windows
+expire as event time advances — deterministic, no sleeps."""
+
+import _common  # noqa: F401
+
+from siddhi_tpu import SiddhiManager, QueryCallback, StreamCallback
+
+APP = """
+define stream TempStream (room string, temp double);
+
+@info(name = 'avgQuery')
+from TempStream#window.time(10 sec)
+select room, avg(temp) as avgTemp
+group by room
+insert into AvgTempStream;
+"""
+
+manager = SiddhiManager()
+runtime = manager.create_siddhi_app_runtime(APP, playback=True)
+runtime.add_callback("AvgTempStream", StreamCallback(
+    lambda events: [print(f"  avg: {e.data}") for e in events]))
+runtime.start()
+
+handler = runtime.input_handler("TempStream")
+handler.send(["r1", 20.0], timestamp=1_000)
+handler.send(["r1", 24.0], timestamp=4_000)
+handler.send(["r1", 28.0], timestamp=12_000)   # the 1s event has expired
+
+manager.shutdown()

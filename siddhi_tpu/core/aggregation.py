@@ -180,6 +180,42 @@ class AggregationRuntime:
         }
         app_context.register_state(f"aggregation-{definition.id}", self)
 
+        # @store(type='X'): persisted incremental aggregation (reference
+        # ``aggregation/persistedaggregation/`` + CudStreamProcessorQueueManager
+        # .java:29 — completed buckets are written behind to one store table
+        # per duration; reads merge store rows with live in-memory buckets,
+        # so rollups survive restart). Store rows are an append-log of
+        # [bucket_ts, key_repr, pickled-state]; readers take the newest
+        # version of each (bucket, key) — out-of-order reopenings simply
+        # append a fresher version.
+        from ..query_api.annotation import find_annotation as _find_ann
+        store_ann = _find_ann(definition.annotations, "store")
+        self.persist_stores: dict[TimePeriodDuration, Any] = {}
+        self._dirty: dict[TimePeriodDuration, set[int]] = {
+            d: set() for d in definition.durations}
+        self._max_bucket: dict[TimePeriodDuration, Optional[int]] = {
+            d: None for d in definition.durations}
+        if store_ann is not None:
+            stype = store_ann.get("type")
+            cls = app_context.siddhi_context.extensions.get(f"store:{stype}")
+            if cls is None:
+                raise SiddhiAppRuntimeError(
+                    f"aggregation '{definition.id}': no store extension "
+                    f"'{stype}'")
+            from ..query_api.definition import DataType, TableDefinition
+            opts = {e.key: e.value for e in store_ann.elements if e.key}
+            for d in definition.durations:
+                td = TableDefinition(f"{definition.id}_{d.value.upper()}")
+                td.attribute("AGG_TIMESTAMP", DataType.LONG)
+                td.attribute("KEY", DataType.STRING)
+                td.attribute("STATE", DataType.STRING)
+                t = cls(td, app_context)
+                # same contract as the @store table path: a ConfigReader is
+                # handed to every store extension before init
+                t.config_reader = app_context.config_reader("store", stype)
+                t.init(td, opts)
+                self.persist_stores[d] = t
+
         # subscribe via a junction receiver
         junction = app_context.stream_junctions.get(sid)
         if junction is not None:
@@ -239,6 +275,13 @@ class AggregationRuntime:
         key = tuple(fn(frame) for fn in self.group_fns) if self.group_fns else None
         for duration, buckets in self.stores.items():
             bs = bucket_start(ts, duration)
+            if self.persist_stores:
+                prev_max = self._max_bucket[duration]
+                if prev_max is None or bs > prev_max:
+                    self._max_bucket[duration] = bs
+                    # write-behind: buckets older than the new one completed
+                    self._flush_duration(duration, up_to_exclusive=bs)
+                self._dirty[duration].add(bs)
             bucket = buckets.setdefault(bs, {})
             state = bucket.get(key)
             if state is None:
@@ -278,12 +321,88 @@ class AggregationRuntime:
             ret = self.retention.get(duration)
             if ret is None:
                 continue
+            if self.persist_stores:
+                # a dirty bucket deleted here would be lost from BOTH memory
+                # and the store — flush write-behinds before purging
+                self._flush_duration(duration)
             cutoff = now - ret
             keep = bucket_start(now, duration)
             for bs in [b for b in buckets if b < cutoff and b != keep]:
                 del buckets[bs]
                 removed += 1
         return removed
+
+    # -- persisted store I/O ---------------------------------------------------
+    @staticmethod
+    def _encode_state(key, state: dict) -> str:
+        import base64
+        import pickle
+        payload = {
+            "key": key,
+            "aggs": {n: a.snapshot() for n, a in state["aggs"].items()},
+            "values": dict(state["values"]),
+        }
+        return base64.b64encode(
+            pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)).decode()
+
+    def _decode_state(self, blob: str) -> tuple:
+        import base64
+        import pickle
+        payload = pickle.loads(base64.b64decode(blob.encode()))
+        state = {
+            "aggs": {
+                name: make_aggregator(agg_name, arg_t)
+                for name, kind, fn, agg_name, rt, arg_t in self.attr_specs
+                if kind == "agg"
+            },
+            "values": dict(payload["values"]),
+        }
+        for n, a in state["aggs"].items():
+            a.restore(payload["aggs"][n])
+        return payload["key"], state
+
+    def _flush_duration(self, duration, up_to_exclusive=None) -> None:
+        store = self.persist_stores.get(duration)
+        if store is None:
+            return
+        dirty = self._dirty[duration]
+        buckets = self.stores[duration]
+        rows = []
+        for bs in sorted(dirty):
+            if up_to_exclusive is not None and bs >= up_to_exclusive:
+                continue
+            for key, state in buckets.get(bs, {}).items():
+                rows.append([bs, repr(key), self._encode_state(key, state)])
+            dirty.discard(bs)
+        if rows:
+            store.record_add(rows)
+
+    def flush_persisted(self) -> None:
+        """Flush every dirty bucket — shutdown/persist barrier (the reference
+        drains its CUD queue)."""
+        for duration in self.persist_stores:
+            self._flush_duration(duration)
+
+    def _persisted_rows(self, duration, start=None, end=None) -> dict:
+        """{(bucket_ts, key_repr): (key, state)} — newest version wins.
+        Bounds filter and last-wins dedup happen BEFORE unpickling, so a
+        bounded query doesn't pay for the whole append-log history."""
+        store = self.persist_stores.get(duration)
+        if store is None:
+            return {}
+        latest: dict = {}
+        for bs, key_repr, blob in store.record_find({}):
+            bs = int(bs)
+            if start is not None and bs < start:
+                continue
+            if end is not None and bs >= end:
+                continue
+            latest[(bs, key_repr)] = blob       # append order: last wins
+        out: dict = {}
+        for k, blob in latest.items():
+            key, state = self._decode_state(blob)
+            out[k] = (key, state)
+        return out
 
     # -- retrieval ------------------------------------------------------------
     @property
@@ -325,13 +444,23 @@ class AggregationRuntime:
             from .errors import SiddhiAppRuntimeError
             raise SiddhiAppRuntimeError(
                 f"aggregation '{self.definition.id}' has no duration {duration}")
+        # persisted mode: older rollups live in the store; live in-memory
+        # buckets overlay them (they're strictly newer)
+        merged: dict[int, dict[Any, dict]] = {}
+        if self.persist_stores:
+            for (bs, _krepr), (key, state) in \
+                    self._persisted_rows(duration, start, end).items():
+                merged.setdefault(bs, {})[key] = state
+        for bs, bucket in buckets.items():
+            for key, state in bucket.items():
+                merged.setdefault(bs, {})[key] = state
         rows = []
-        for bs in sorted(buckets):
+        for bs in sorted(merged):
             if start is not None and bs < start:
                 continue
             if end is not None and bs >= end:
                 continue
-            for key, state in buckets[bs].items():
+            for key, state in merged[bs].items():
                 row = [bs]
                 for name, kind, fn, agg_name, rt, arg_t in self.attr_specs:
                     if kind == "agg":

@@ -60,6 +60,22 @@ class SiddhiAppRuntime:
         self.ctx = SiddhiAppContext(siddhi_context, self.name, playback, start_time)
         self.ctx.runtime = self
         self.ctx.statistics_manager = StatisticsManager(self.name)
+        # @app(statistics='true'|'detail', statistics.reporter='log',
+        # statistics.interval='30') — reference @app statistics wiring
+        if app_ann is not None:
+            stats = (app_ann.get("statistics") or "").lower()
+            if stats in ("true", "basic"):
+                self.ctx.statistics_manager.set_level(Level.BASIC)
+            elif stats == "detail":
+                self.ctx.statistics_manager.set_level(Level.DETAIL)
+            reporter = app_ann.get("statistics.reporter")
+            interval = app_ann.get("statistics.interval")
+            if reporter or interval:
+                try:
+                    self.ctx.statistics_manager.configure_reporter(
+                        reporter, float(interval) if interval else None)
+                except ValueError as e:
+                    raise SiddhiAppCreationError(str(e)) from None
         self.input_handlers: dict[str, InputHandler] = {}
         self.query_runtimes: dict[str, QueryRuntime] = {}
         self.partition_runtimes: list[PartitionRuntime] = []
@@ -212,6 +228,29 @@ class SiddhiAppRuntime:
                 self.partition_runtimes.append(prt)
         # sources & sinks from stream annotations
         self._wire_io()
+        self._wire_gauges()
+
+    def _wire_gauges(self) -> None:
+        """Buffered-events + memory gauges (reference BufferedEventsTracker /
+        SiddhiMemoryUsageMetric): async queue depths and per-element retained
+        size, incl. device pytree HBM bytes."""
+        sm = self.ctx.statistics_manager
+        for sid, j in self.ctx.stream_junctions.items():
+            if j.dispatcher is not None:
+                sm.buffered_tracker(
+                    f"stream.{sid}", lambda d=j.dispatcher: d.buffered_events)
+        for b in self.device_bridges:
+            if b.driver is not None:
+                sm.buffered_tracker(
+                    f"device.{b.query_name}",
+                    lambda drv=b.driver: len(drv._q))
+            # device state HBM: nbytes summed over the pytree
+            sm.memory_tracker(
+                f"device.{b.query_name}",
+                lambda rt=b.runtime: rt.state)
+        for element_id, holder in self.ctx.state_registry.items():
+            if not element_id.startswith("device-"):
+                sm.memory_tracker(element_id, lambda h=holder: h)
 
     def _stream_defs(self) -> dict:
         defs = dict(self.app.stream_definitions)
@@ -377,6 +416,7 @@ class SiddhiAppRuntime:
             tr.start()
         for src in self.sources:
             src.connect_with_retry()
+        self.ctx.statistics_manager.start_reporting()
         if not self.ctx.timestamp_generator.playback:
             self.ctx.ticker = SystemTicker(self.ctx.scheduler)
             self.ctx.ticker.start()
@@ -390,10 +430,14 @@ class SiddhiAppRuntime:
         for b in self.device_bridges:
             if b.driver is not None:
                 b.driver.stop()
+        for agg in self.ctx.aggregations.values():
+            if getattr(agg, "persist_stores", None):
+                agg.flush_persisted()    # drain write-behind rollups
         for src in self.sources:
             src.disconnect()
         for sink in self.sinks:
             sink.disconnect()
+        self.ctx.statistics_manager.stop_reporting()
         if self.ctx.ticker is not None:
             self.ctx.ticker.stop()
         self._started = False

@@ -1,14 +1,30 @@
-"""Statistics: throughput / latency trackers with runtime on/off levels.
+"""Statistics: throughput / latency / memory / buffered-events trackers with
+runtime on/off levels and pluggable reporters.
 
-Reference: ``core/util/statistics/`` SPI + ``metrics/`` Dropwizard impl
-(``SiddhiStatisticsManager.java``, ``Level.java`` OFF/BASIC/DETAIL).
+Reference: ``core/util/statistics/`` SPI (``ThroughputTracker``,
+``LatencyTracker``, ``MemoryUsageTracker``, ``BufferedEventsTracker``,
+``StatisticsManager``) + ``metrics/`` Dropwizard impl
+(``SiddhiStatisticsManager.java:35``, ``Level.java`` OFF/BASIC/DETAIL,
+``memory/SiddhiMemoryUsageMetric.java`` — an object-graph walker; here
+``sys.getsizeof``-based with a pytree fast path for device state, where the
+honest figure is the HBM bytes of the arrays).
+
+Reporters: ``@app(statistics='true')`` enables BASIC; @app elements
+``statistics.reporter`` ('log' | 'console' | registered name) and
+``statistics.interval`` (seconds) configure periodic emission — the analog
+of the reference's Dropwizard reporter wiring.
 """
 
 from __future__ import annotations
 
 import enum
+import logging
+import sys
+import threading
 import time
-from typing import Optional
+from typing import Callable, Optional
+
+log = logging.getLogger("siddhi_tpu.metrics")
 
 
 class Level(enum.Enum):
@@ -47,12 +63,94 @@ class LatencyTracker:
         return (self.total_ns / self.count) / 1e6 if self.count else 0.0
 
 
+class BufferedEventsTracker:
+    """Gauge over a queue-depth callable (reference
+    ``BufferedEventsTracker.java`` / ``StreamJunction.getBufferedEvents:359``
+    — async junction ring occupancy)."""
+
+    def __init__(self, name: str, depth_fn: Callable[[], int]):
+        self.name = name
+        self._depth_fn = depth_fn
+
+    @property
+    def buffered(self) -> int:
+        try:
+            return int(self._depth_fn())
+        except Exception:       # noqa: BLE001 — a dead gauge reads 0
+            return 0
+
+
+def _deep_size(obj, seen: set, depth: int = 0) -> int:
+    """Retained-size estimate (reference SiddhiMemoryUsageMetric walks the
+    object graph). Device arrays report their on-device byte size."""
+    if depth > 6 or id(obj) in seen:
+        return 0
+    seen.add(id(obj))
+    nbytes = getattr(obj, "nbytes", None)
+    if nbytes is not None and isinstance(nbytes, int):
+        return nbytes                          # numpy / jax array: HBM bytes
+    size = sys.getsizeof(obj, 0)
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            size += _deep_size(k, seen, depth + 1)
+            size += _deep_size(v, seen, depth + 1)
+    elif isinstance(obj, (list, tuple, set, frozenset)):
+        for v in obj:
+            size += _deep_size(v, seen, depth + 1)
+    elif hasattr(obj, "__dict__"):
+        size += _deep_size(obj.__dict__, seen, depth + 1)
+    return size
+
+
+class MemoryUsageTracker:
+    """Gauge over a state-holder (reference
+    ``memory/SiddhiMemoryUsageMetric.java``'s object-graph walker)."""
+
+    def __init__(self, name: str, target_fn: Callable[[], object]):
+        self.name = name
+        self._target_fn = target_fn
+
+    @property
+    def bytes(self) -> int:
+        try:
+            return _deep_size(self._target_fn(), set())
+        except Exception:       # noqa: BLE001
+            return 0
+
+
+class Reporter:
+    """Reporter SPI: receives the report dict every interval."""
+
+    def report(self, data: dict) -> None:
+        raise NotImplementedError
+
+
+class LogReporter(Reporter):
+    def report(self, data: dict) -> None:
+        log.info("statistics %s: %s", data.get("app"), data)
+
+
+class ConsoleReporter(Reporter):
+    def report(self, data: dict) -> None:
+        print(f"[statistics] {data}")
+
+
+REPORTERS: dict[str, type] = {"log": LogReporter, "console": ConsoleReporter}
+
+
 class StatisticsManager:
     def __init__(self, app_name: str):
         self.app_name = app_name
         self.level = Level.OFF
         self.throughput: dict[str, ThroughputTracker] = {}
         self.latency: dict[str, LatencyTracker] = {}
+        self.buffered: dict[str, BufferedEventsTracker] = {}
+        self.memory: dict[str, MemoryUsageTracker] = {}
+        self.reporter: Optional[Reporter] = None
+        self.report_interval_s: float = 60.0
+        self._timer: Optional[threading.Timer] = None
+        self._reporting = False
+        self._lock = threading.Lock()
 
     def throughput_tracker(self, name: str) -> ThroughputTracker:
         return self.throughput.setdefault(name, ThroughputTracker(name))
@@ -60,13 +158,72 @@ class StatisticsManager:
     def latency_tracker(self, name: str) -> LatencyTracker:
         return self.latency.setdefault(name, LatencyTracker(name))
 
+    def buffered_tracker(self, name: str, depth_fn) -> BufferedEventsTracker:
+        return self.buffered.setdefault(
+            name, BufferedEventsTracker(name, depth_fn))
+
+    def memory_tracker(self, name: str, target_fn) -> MemoryUsageTracker:
+        return self.memory.setdefault(
+            name, MemoryUsageTracker(name, target_fn))
+
     def set_level(self, level: Level) -> None:
         self.level = level
 
+    # -- reporter wiring ------------------------------------------------------
+    def configure_reporter(self, name: Optional[str],
+                           interval_s: Optional[float] = None) -> None:
+        if name:
+            cls = REPORTERS.get(name.lower())
+            if cls is None:
+                raise ValueError(
+                    f"unknown statistics reporter '{name}' "
+                    f"(known: {sorted(REPORTERS)})")
+            self.reporter = cls()
+        if interval_s is not None:
+            self.report_interval_s = float(interval_s)
+
+    def start_reporting(self) -> None:
+        if self.reporter is None or self._timer is not None:
+            return
+        self._reporting = True
+
+        def tick():
+            if self.level != Level.OFF and self.reporter is not None:
+                try:
+                    self.reporter.report(self.report())
+                except Exception:       # noqa: BLE001
+                    log.exception("statistics reporter failed")
+            with self._lock:
+                # a stop racing an in-flight tick would otherwise cancel the
+                # already-fired timer while this re-arm keeps the chain alive
+                if not self._reporting:
+                    return
+                self._timer = threading.Timer(self.report_interval_s, tick)
+                self._timer.daemon = True
+                self._timer.start()
+
+        with self._lock:
+            self._timer = threading.Timer(self.report_interval_s, tick)
+            self._timer.daemon = True
+            self._timer.start()
+
+    def stop_reporting(self) -> None:
+        with self._lock:
+            self._reporting = False
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+
     def report(self) -> dict:
-        return {
+        data = {
             "app": self.app_name,
             "level": self.level.name,
             "throughput": {k: v.count for k, v in self.throughput.items()},
             "latency_avg_ms": {k: v.avg_ms for k, v in self.latency.items()},
+            "buffered_events": {k: v.buffered
+                                for k, v in self.buffered.items()},
         }
+        if self.level == Level.DETAIL:
+            data["memory_bytes"] = {k: v.bytes
+                                    for k, v in self.memory.items()}
+        return data

@@ -68,9 +68,9 @@ class OnDemandQueryRuntime:
                 else:
                     table.update_or_add(cond, [], setters, now)
                 return []
-            rows = [list(r) for r in table.find(None, None, now)]
-            if cond is not None:
-                rows = [r for r in rows if cond.fn(TableMatchFrame(r, [], now))]
+            # hand the compiled condition to the table: record stores push it
+            # down (StoreExpression), in-memory tables use the PK fast path
+            rows = [list(r) for r in table.find(cond, None, now)]
         elif store_id in ctx.named_windows:
             nw = ctx.named_windows[store_id]
             names = nw.definition.attribute_names

@@ -240,11 +240,14 @@ class PatternRuntime:
                 fire_at, lambda ts, ni=node_idx, pp=p: self._absent_timer(ni, pp, ts))
         if node.is_count and node.min_count == 0:
             if node_idx == len(self.c.nodes) - 1:
-                # final zero-min count: the pattern is already complete on
-                # arrival (reference emits immediately with the count empty;
-                # SequenceTestCase.testQuery3)
-                self._emit_from(node, p, now)
-                self._remove_everywhere(p)
+                # final zero-min count: a partial ARRIVING here with earlier
+                # bindings is already complete (reference emits immediately
+                # with the count empty; SequenceTestCase.testQuery3). A bare
+                # seed stays pending — emitting it would recurse through the
+                # every-reseed forever with no event driving it.
+                if p.events:
+                    self._emit_from(node, p, now)
+                    self._remove_everywhere(p)
                 return
             # zero occurrences allowed: immediately eligible at the successor
             self._make_eligible(node_idx, p, now)

@@ -402,6 +402,54 @@ def _build_setters(set_attributes, table, out_names, out_types, app_context):
     return setters
 
 
+def _record_store_find(table, table_ref, table_is_left, on_condition, builder):
+    """Push the join condition down to a record store (reference
+    ``AbstractQueryableRecordTable.java:99``): the store receives a
+    StoreExpression once plus per-probe parameter values and returns
+    pre-filtered rows. None when the table isn't record-backed, the
+    condition doesn't convert, or the store declines."""
+    from .table import AbstractRecordTable, CacheTable, StoreExpression, \
+        build_store_tree
+    backing = table.backing if isinstance(table, CacheTable) else table
+    if not isinstance(backing, AbstractRecordTable) or on_condition is None:
+        return None
+    tdef = table.definition
+    table_ids = {table_ref, tdef.id}
+
+    def classify(var):
+        if var.stream_id in table_ids:
+            if var.attribute not in tdef.attribute_names:
+                return "bail"
+            return ("attribute", var.attribute)
+        if var.stream_id is None and var.attribute in tdef.attribute_names:
+            return "bail"      # ambiguous bare ref: no pushdown, host decides
+        return "param"
+
+    def build_param(expr):
+        try:
+            fn, _ = builder.build(expr)
+        except Exception:       # noqa: BLE001
+            return None
+        return fn
+
+    node, params = build_store_tree(on_condition, classify, build_param)
+    if node is None:
+        return None
+    compiled = backing.record_compile_condition(StoreExpression(node))
+    if compiled is None:
+        return None
+    from .event import StreamEvent as _SE
+    from .executor import JoinFrame as _JF
+
+    def find(probe_ev, t=backing, left=table_is_left):
+        frame = _JF(None, probe_ev, probe_ev.timestamp) if left \
+            else _JF(probe_ev, None, probe_ev.timestamp)
+        p = {name: fn(frame) for name, fn in params.items()}
+        return [_SE(probe_ev.timestamp, r) for r in t.record_find(p, compiled)]
+
+    return find
+
+
 def _table_pushdown_find(table, table_ref, table_is_left, on_condition, builder):
     """Compile ``T.pk == <probe expr>`` into a point lookup fn(probe_ev),
     or None if the condition has no such conjunct (falls back to scan)."""
@@ -543,6 +591,9 @@ def _build_join(ist: JoinInputStream, rt: QueryRuntime, app_context,
             table = app_context.tables[side["stream"].stream_id]
             fn = _table_pushdown_find(table, side["ref"], is_left,
                                       ist.on_condition, builder)
+            if fn is None:
+                fn = _record_store_find(table, side["ref"], is_left,
+                                        ist.on_condition, builder)
             if fn is None:
                 # scan fallback stamps rows with the probe's timestamp, same
                 # as the pushdown path, so `within` sees consistent times

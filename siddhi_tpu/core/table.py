@@ -9,6 +9,7 @@ the PK fast path mirrors IndexOperator.
 
 from __future__ import annotations
 
+import itertools
 from typing import Any, Callable, Optional
 
 from ..query_api import (
@@ -72,13 +73,71 @@ class TableMatchResolver(VariableResolver):
         raise KeyError(f"cannot resolve '{var.attribute}' in table condition")
 
 
+class StoreExpression:
+    """Store-visitable condition tree (the analog of the reference's
+    ``ExpressionBuilder``/``ExpressionVisitor`` output handed to record
+    stores, ``table/record/ExpressionBuilder.java``). Nodes:
+
+    - ``('attribute', name)`` — a table column
+    - ``('constant', value)`` — a literal
+    - ``('param', name)`` — a streaming-side value, resolved per lookup and
+      passed in ``condition_params``
+    - ``('compare', op, lhs, rhs)`` — op in ``== != < <= > >=``
+    - ``('and'|'or', lhs, rhs)``, ``('not', sub)``
+    - ``('math', op, lhs, rhs)`` — op in ``+ - * / %``
+
+    Stores walk the tree with :meth:`visit` or translate it to their native
+    query language (e.g. a SQL WHERE clause).
+    """
+
+    def __init__(self, node: tuple):
+        self.node = node
+
+    def visit(self, visitor) -> Any:
+        """visitor: object with ``attribute(name)``, ``constant(value)``,
+        ``param(name)``, ``compare(op, l, r)``, ``logical(op, l, r)``,
+        ``negate(sub)``, ``math(op, l, r)`` — called bottom-up."""
+        return _visit_store_expr(self.node, visitor)
+
+    def __repr__(self):
+        return f"StoreExpression({self.node!r})"
+
+
+def _visit_store_expr(node: tuple, v) -> Any:
+    kind = node[0]
+    if kind == "attribute":
+        return v.attribute(node[1])
+    if kind == "constant":
+        return v.constant(node[1])
+    if kind == "param":
+        return v.param(node[1])
+    if kind == "compare":
+        return v.compare(node[1], _visit_store_expr(node[2], v),
+                         _visit_store_expr(node[3], v))
+    if kind in ("and", "or"):
+        return v.logical(kind, _visit_store_expr(node[1], v),
+                         _visit_store_expr(node[2], v))
+    if kind == "not":
+        return v.negate(_visit_store_expr(node[1], v))
+    if kind == "math":
+        return v.math(node[1], _visit_store_expr(node[2], v),
+                      _visit_store_expr(node[3], v))
+    raise ValueError(f"unknown store-expression node {kind!r}")
+
+
 class CompiledTableCondition:
-    """condition fn + optional primary-key fast path."""
+    """condition fn + optional primary-key fast path + optional store-
+    pushdown form."""
 
     def __init__(self, fn: Callable[[TableMatchFrame], bool],
-                 pk_extractor: Optional[Callable[[list], Any]] = None):
+                 pk_extractor: Optional[Callable[[list], Any]] = None,
+                 store_expr: Optional[StoreExpression] = None,
+                 param_fns: Optional[dict] = None):
         self.fn = fn
         self.pk_extractor = pk_extractor    # out_data -> pk value
+        self.store_expr = store_expr        # pushdown tree (None: host-only)
+        self.param_fns = param_fns or {}    # param name -> fn(frame) -> value
+        self._store_compiled: dict = {}     # per-table compiled handle cache
 
 
 class Table:
@@ -244,8 +303,15 @@ class InMemoryTable(Table):
 class AbstractRecordTable(Table):
     """External store SPI (reference ``record/AbstractRecordTable.java:57``).
 
-    Subclass and implement the ``record_*`` hooks to back a table with an external
-    store; register via the extension registry under ``store:<type>``.
+    Subclass and implement the ``record_*`` hooks to back a table with an
+    external store; register via the extension registry under
+    ``store:<type>``. Condition pushdown (the queryable-record analog,
+    ``AbstractQueryableRecordTable.java:99``): when a lookup condition
+    converts to a :class:`StoreExpression`, it is offered ONCE to
+    :meth:`record_compile_condition`; a store returning a non-None handle
+    receives it (plus per-lookup parameter values) in ``record_find`` and
+    must return pre-filtered rows. Stores that return None — the default —
+    fall back to the exhaustive scan with host-side filtering.
     """
 
     extension_kind = "store"
@@ -256,23 +322,87 @@ class AbstractRecordTable(Table):
     def record_add(self, rows: list[list]) -> None:
         raise NotImplementedError
 
-    def record_find(self, condition_params: dict) -> list[list]:
+    def record_compile_condition(self, store_expr: StoreExpression):
+        """Translate a condition to a store-native form (e.g. a SQL WHERE
+        template). None (default) = no pushdown; exhaustive scan."""
+        return None
+
+    def record_find(self, condition_params: dict,
+                    compiled_condition=None) -> list[list]:
         raise NotImplementedError
 
-    def record_delete(self, condition_params: dict) -> int:
+    def record_delete(self, condition_params: dict,
+                      compiled_condition=None) -> int:
         raise NotImplementedError
 
-    def record_update(self, condition_params: dict, values: dict) -> int:
+    def record_update(self, condition_params: dict, values: dict,
+                      compiled_condition=None) -> int:
         raise NotImplementedError
 
     def add(self, rows, ts: int = 0) -> None:
         self.record_add(rows)
 
+    def all_events(self, ts: int = 0) -> list[StreamEvent]:
+        return [StreamEvent(ts, list(r)) for r in self.record_find({})]
+
+    def _pushdown(self, cond) -> tuple:
+        """(compiled_condition | None, params dict) for this lookup."""
+        if cond is None or cond.store_expr is None:
+            return None, {}
+        key = id(self)
+        if key not in cond._store_compiled:
+            cond._store_compiled[key] = \
+                self.record_compile_condition(cond.store_expr)
+        return cond._store_compiled[key], cond.param_fns
+
+    def _params(self, param_fns: dict, out_data, ts: int) -> dict:
+        frame = TableMatchFrame(None, out_data, ts)
+        return {name: fn(frame) for name, fn in param_fns.items()}
+
     def find(self, cond, out_data, ts: int = 0) -> list[list]:
+        compiled, param_fns = self._pushdown(cond)
+        if compiled is not None:
+            # the store pre-filters; rows come back final
+            return self.record_find(self._params(param_fns, out_data, ts),
+                                    compiled)
         rows = self.record_find({})
         if cond is None:
             return rows
         return [r for r in rows if cond.fn(TableMatchFrame(r, out_data, ts))]
+
+    def delete(self, cond, out_data, ts: int = 0) -> int:
+        compiled, param_fns = self._pushdown(cond)
+        if compiled is not None:
+            return self.record_delete(
+                self._params(param_fns, out_data, ts), compiled)
+        raise NotImplementedError(
+            f"store table '{self.id}': delete requires condition pushdown "
+            f"(record_compile_condition returned None)")
+
+    def update(self, cond, out_data, setters, ts: int = 0) -> int:
+        compiled, param_fns = self._pushdown(cond)
+        if compiled is not None:
+            # set values are computed ONCE per operation — row-dependent set
+            # expressions (e.g. `set T.price = T.price + 1`) would need
+            # per-row evaluation the record SPI can't express; surface that
+            # instead of writing one wrong value to every matched row
+            values = {}
+            for pos, value_fn in setters:
+                name = self.definition.attributes[pos].name
+                try:
+                    values[name] = value_fn(
+                        TableMatchFrame(None, out_data, ts))
+                except Exception:       # noqa: BLE001 — row ref blew up
+                    raise NotImplementedError(
+                        f"store table '{self.id}': set expression for "
+                        f"'{name}' references table columns — per-row set "
+                        f"expressions are not expressible through the "
+                        f"record-store SPI") from None
+            return self.record_update(
+                self._params(param_fns, out_data, ts), values, compiled)
+        raise NotImplementedError(
+            f"store table '{self.id}': update requires condition pushdown "
+            f"(record_compile_condition returned None)")
 
 
 class CacheTable(Table):
@@ -463,6 +593,102 @@ class CacheTable(Table):
         self._complete = False
 
 
+def build_store_tree(on_condition: Expression, classify, build_param):
+    """AST → (StoreExpression node, param extractor fns) or (None, {}).
+
+    ``classify(var)`` returns ``('attribute', name)`` for table columns,
+    ``'param'`` for streaming-side refs, or ``'bail'`` when resolution is
+    ambiguous; ``build_param(expr)`` returns an extractor fn or None. Any
+    unconvertible sub-expression aborts the whole pushdown (the reference
+    falls back to ExhaustiveCollectionExecutor there too)."""
+    from ..query_api import (
+        And as _And, Compare as _Compare, Constant as _Constant,
+        MathExpr as _MathExpr, Minus as _Minus, Not as _Not, Or as _Or,
+        Variable as _Variable,
+    )
+    from ..query_api.expression import CompareOp as _CmpOp, MathOp as _MathOp
+
+    cmp_ops = {_CmpOp.EQ: "==", _CmpOp.NEQ: "!=", _CmpOp.LT: "<",
+               _CmpOp.LE: "<=", _CmpOp.GT: ">", _CmpOp.GE: ">="}
+    math_ops = {_MathOp.ADD: "+", _MathOp.SUB: "-", _MathOp.MUL: "*",
+                _MathOp.DIV: "/", _MathOp.MOD: "%"}
+    params: dict = {}
+    counter = itertools.count()
+
+    def walk(expr):
+        if isinstance(expr, _Constant):
+            return ("constant", expr.value)
+        if isinstance(expr, _Variable):
+            kind = classify(expr)
+            if kind == "bail":
+                return None
+            if isinstance(kind, tuple) and kind[0] == "attribute":
+                return kind
+            # streaming-side value: becomes a per-lookup parameter
+            val_fn = build_param(expr)
+            if val_fn is None:
+                return None
+            name = f"p{next(counter)}"
+            params[name] = val_fn
+            return ("param", name)
+        if isinstance(expr, _Compare):
+            left, right = walk(expr.left), walk(expr.right)
+            if left is None or right is None:
+                return None
+            return ("compare", cmp_ops[expr.op], left, right)
+        if isinstance(expr, (_And, _Or)):
+            left, right = walk(expr.left), walk(expr.right)
+            if left is None or right is None:
+                return None
+            return ("and" if isinstance(expr, _And) else "or", left, right)
+        if isinstance(expr, _Not):
+            sub = walk(expr.expr)
+            return None if sub is None else ("not", sub)
+        if isinstance(expr, _MathExpr):
+            left, right = walk(expr.left), walk(expr.right)
+            if left is None or right is None:
+                return None
+            return ("math", math_ops[expr.op], left, right)
+        if isinstance(expr, _Minus):
+            sub = walk(expr.expr)
+            return None if sub is None else \
+                ("math", "-", ("constant", 0), sub)
+        return None                 # functions / in-table / is-null etc.
+
+    node = walk(on_condition)
+    if node is None:
+        return None, {}
+    return node, params
+
+
+def _build_store_expression(table_def, on_condition: Expression,
+                            out_names: list[str], out_types: list[DataType],
+                            app_context):
+    """Table-lookup flavor: table refs by id/bare-name, params resolve
+    against the matching event (TableMatchFrame)."""
+
+    def classify(var):
+        if var.stream_id == table_def.id or (
+                var.stream_id is None
+                and var.attribute not in out_names
+                and var.attribute in table_def.attribute_names):
+            if var.attribute not in table_def.attribute_names:
+                return "bail"
+            return ("attribute", var.attribute)
+        return "param"
+
+    def build_param(expr):
+        ob = ExecutorBuilder(
+            TableMatchResolver(table_def, out_names, out_types), app_context)
+        try:
+            val_fn, _ = ob.build(expr)
+        except Exception:           # noqa: BLE001 — unresolvable → no pushdown
+            return None
+        return val_fn
+
+    return build_store_tree(on_condition, classify, build_param)
+
+
 def compile_table_condition(table: Table, on_condition: Optional[Expression],
                             out_names: list[str], out_types: list[DataType],
                             app_context) -> Optional[CompiledTableCondition]:
@@ -471,6 +697,19 @@ def compile_table_condition(table: Table, on_condition: Optional[Expression],
     resolver = TableMatchResolver(table.definition, out_names, out_types)
     builder = ExecutorBuilder(resolver, app_context)
     fn, _ = builder.build(on_condition)
+
+    # store pushdown form (only meaningful for record tables, but cheap and
+    # side-effect-free to build here for any table)
+    store_expr = None
+    param_fns: dict = {}
+    record_backed = isinstance(table, AbstractRecordTable) or (
+        isinstance(table, CacheTable)
+        and isinstance(table.backing, AbstractRecordTable))
+    if record_backed:
+        node, param_fns = _build_store_expression(
+            table.definition, on_condition, out_names, out_types, app_context)
+        if node is not None:
+            store_expr = StoreExpression(node)
 
     # PK fast path: `T.pk == <expr-over-out>` at top level of an AND chain.
     # A bare variable named like the PK only counts as the table side when the
@@ -487,7 +726,7 @@ def compile_table_condition(table: Table, on_condition: Optional[Expression],
                 app_context)
             val_fn, _ = out_builder.build(eq)
             pk_extractor = lambda out: val_fn(TableMatchFrame(None, out))  # noqa: E731
-    return CompiledTableCondition(fn, pk_extractor)
+    return CompiledTableCondition(fn, pk_extractor, store_expr, param_fns)
 
 
 def _find_pk_equality(expr: Expression, table_id: str, pk_name: str,

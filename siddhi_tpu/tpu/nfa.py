@@ -25,10 +25,11 @@ binds; standalone ``not X for t`` carries a per-slot arrival clock — expiry is
 evaluated in a pre-pass on the next arriving event (host timers fire before
 event delivery, so observable timing matches under the event-driven clock).
 Still host-only: final count states, element-level ``within``, absent without
-``for``, patterns starting with absent, logical/absent directly after a count
-state or inside sequences, sibling-alias references inside a logical state,
-and `e[k]` indexing beyond first/last. An OR output referencing the unmatched
-side's alias emits that side's zero value (host emits null).
+``for``, patterns starting with absent, logical/absent/count inside
+sequences, logical/absent directly after a count state, sibling-alias
+references inside a logical state, and `e[k]` indexing beyond first/last.
+Outputs referencing an OR state's unmatched side, an absent branch, or a
+zero-occurrence count emit NULL via carried validity flags (host parity).
 """
 
 from __future__ import annotations
@@ -233,6 +234,7 @@ class _NFAResolver:
         self.nfa = nfa
         self.current = current_state
         self.current_alias = current_alias
+        self.touched: list = []        # (state, variant) bound refs resolved
 
     def resolve(self, var: Variable) -> tuple[str, DataType]:
         nfa = self.nfa
@@ -283,6 +285,7 @@ class _NFAResolver:
                     raise DeviceCompileError("e[k] indexing needs host path")
             variant = f"b{q}_{var.attribute}"
         nfa.referenced.add((q, variant, t))
+        self.touched.append((q, variant))
         return variant, t
 
     def encode_string(self, key: str, value: str) -> int:
@@ -402,6 +405,8 @@ class DeviceNFACompiler:
         resolver = _NFAResolver(self, None)
         self.used_cols = set(self.used_ev_cols)
         for (q, key, t) in self.referenced:
+            if key.endswith("__set") or key.endswith("__has"):
+                continue               # synthetic null-tracking flags
             self.used_cols.add(resolver._bound_to_merged(key))
         # kernel selection: stream-state chains with `every` take the blocked
         # batch-parallel kernel (sequential depth S, not B — nfa_block.py);
@@ -475,10 +480,30 @@ class DeviceNFACompiler:
         # logical/absent finals emit from slot-bound values (possibly with no
         # candidate event at all), so bare/candidate references must not bind
         out_ctx = final if self.states[final].kind == "stream" else None
+        # per-output null dependencies: an output referencing an OR state's
+        # unmatched side / an absent branch / a zero-min count's bindings is
+        # NULL when that side never bound — a zero VALUE is legal data, so a
+        # carried boolean flag travels with the partial instead (host parity;
+        # formerly a documented divergence)
+        self.out_null_deps: list[set] = []
         for oa in attrs:
             resolver = _NFAResolver(self, out_ctx)
             fn, t = compile_expression(oa.expr, resolver)
+            deps = set()
+            for (q, key) in resolver.touched:
+                if key.startswith(f"b{q}x"):        # logical branch binding
+                    bi = int(key[len(f"b{q}x"):].split("_", 1)[0])
+                    st = self.states[q]
+                    if st.logical_type == "or" or st.branches[bi].is_absent:
+                        deps.add((q, f"b{q}x{bi}__set"))
+                elif self.states[q].kind == "count" \
+                        and self.states[q].min_count == 0:
+                    deps.add((q, f"b{q}__has"))
             self.out_specs.append((oa.name, fn, t))
+            self.out_null_deps.append(deps)
+        for deps in self.out_null_deps:
+            for (q, flag) in deps:
+                self.referenced.add((q, flag, DataType.BOOL))
 
     # ------------------------------------------------------------------ state
     def init_state(self) -> dict:
@@ -529,6 +554,7 @@ class DeviceNFACompiler:
         always_seed = self.states[0].ends_every
         every_end = self.every_end
         out_specs = self.out_specs
+        out_null_deps = self.out_null_deps
         referenced = sorted(self.referenced)
         n_out = len(out_specs)
 
@@ -615,6 +641,10 @@ class DeviceNFACompiler:
 
             out_mask = jnp.zeros((2, C), jnp.bool_)
             out_cols = [jnp.zeros((2, C), _JNP[t]) for (_, _, t) in out_specs]
+            # per-output null masks (OR-unmatched side / absent branch /
+            # zero-occurrence count refs emit NULL, not the zero value)
+            out_nulls = [jnp.zeros((2, C), jnp.bool_) if out_null_deps[oi]
+                         else None for oi in range(n_out)]
             touched = {s: jnp.zeros((C,), jnp.bool_) for s in range(S)}
 
             def emit_rows(out_mask, out_cols, n_match, mask, row, emit_env):
@@ -625,6 +655,16 @@ class DeviceNFACompiler:
                         out_cols[oi].dtype)
                     out_cols[oi] = out_cols[oi].at[row].set(
                         jnp.where(mask, val, out_cols[oi][row]))
+                    if out_null_deps[oi]:
+                        nm = jnp.zeros((C,), jnp.bool_)
+                        for (q, flag) in sorted(out_null_deps[oi]):
+                            got = emit_env.get(flag)
+                            if got is None:      # flag not carried → unbound
+                                nm = jnp.ones((C,), jnp.bool_)
+                            else:
+                                nm = nm | ~jnp.broadcast_to(got, (C,))
+                        out_nulls[oi] = out_nulls[oi].at[row].set(
+                            jnp.where(mask, nm, out_nulls[oi][row]))
                 return out_mask, out_cols, \
                     n_match + jnp.sum(mask.astype(jnp.int64))
 
@@ -711,10 +751,13 @@ class DeviceNFACompiler:
                     sid = self.compiled.alias_defs[br.alias].id
                     for (q, key, t) in referenced:
                         if q == s and key.startswith(f"b{s}x{bi}_"):
-                            attr = key[len(f"b{s}x{bi}_"):]
-                            mk = self.merged.col_key(sid, attr)
                             base = into[key] if into is not None else \
                                 jnp.zeros((C,), _JNP[t])
+                            if key == f"b{s}x{bi}__set":
+                                values[key] = mask | base
+                                continue
+                            attr = key[len(f"b{s}x{bi}_"):]
+                            mk = self.merged.col_key(sid, attr)
                             values[key] = jnp.where(
                                 mask, ev["cols"][mk].astype(_JNP[t]), base)
 
@@ -941,6 +984,8 @@ class DeviceNFACompiler:
                                 first_ext,
                                 ev["cols"][mk].astype(slots[key].dtype),
                                 slots[key])
+                        elif q == s and key == f"b{s}__has":
+                            new_slots[key] = slots[key] | ext
                     if st.max_count != -1:
                         new_slots["closed"] = new_slots["closed"] | (
                             new_slots["count"] >= st.max_count)
@@ -1027,6 +1072,11 @@ class DeviceNFACompiler:
                     seed_vals = {}
                     for (q, key, t) in referenced:
                         if q == 0:
+                            if key == "b0__has":
+                                # count state 0 seeds with its first
+                                # occurrence already bound
+                                seed_vals[key] = jnp.ones((C,), jnp.bool_)
+                                continue
                             attr = key[len("b0_"):]
                             for pref in ("first_", "last_"):
                                 if attr.startswith(pref):
@@ -1082,6 +1132,8 @@ class DeviceNFACompiler:
             ys = {"mask": out_mask, "ts": ev_ts}
             for oi, (name, _, _) in enumerate(out_specs):
                 ys[name] = out_cols[oi]
+                if out_nulls[oi] is not None:
+                    ys[f"null__{name}"] = out_nulls[oi]
             return new_carry, ys
 
         def step(state, cols, tag, ts, ts_base, nvalid):
@@ -1128,10 +1180,17 @@ class DeviceNFACompiler:
         dec = {}
         for (name, fn, t) in self.out_specs:
             dec[name] = t
+        nulls = {name: np.asarray(ys[f"null__{name}"])
+                 for (name, _, t) in self.out_specs
+                 if f"null__{name}" in ys}
         idx = np.argwhere(mask)
         for b, srci, c in idx:
             row = []
             for (name, _, t) in self.out_specs:
+                nm = nulls.get(name)
+                if nm is not None and nm[b, srci, c]:
+                    row.append(None)
+                    continue
                 v = cols[name][b, srci, c]
                 row.append(_decode_scalar(self, name, v, t))
             rows.append(row)

@@ -14,9 +14,9 @@ code) ported from:
 Each case drives the public API (DSL string → runtime → send → assert) under
 the deterministic playback clock; the reference's ``Thread.sleep`` timing
 becomes explicit event-timestamp gaps. Every case also attempts the compiled
-device path and checks parity when the query is device-compilable (cases
-whose expected rows contain nulls skip device parity: the device NFA's
-unmatched-side zero-value divergence is documented at nfa.py).
+device path and checks parity when the query is device-compilable — including
+null-bearing outputs, which the device kernel reproduces via carried validity
+flags (OR-unmatched sides / absent branches / zero-occurrence counts).
 """
 
 import pytest
@@ -750,6 +750,25 @@ insert into OutputStream;
 ]
 
 
+def test_every_zero_min_count_alone_does_not_recurse():
+    """`every e1=S[..]<0:1>` as the whole pattern: a bare re-seed at a final
+    zero-min count node must wait for an event, not emit-and-reseed forever
+    (regression: RecursionError at start())."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    define stream S (price double);
+    from every e1=S[price>20]<0:1> select e1[0].price as p insert into Out;
+    """, playback=True)
+    out = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: out.extend(e.data[0] for e in evs)))
+    rt.start()
+    rt.input_handler("S").send([25.0], timestamp=1000)
+    rt.input_handler("S").send([30.0], timestamp=1100)
+    m.shutdown()
+    assert out == [25.0, 30.0]
+
+
 # the app "starts" at START; each seq entry's gap (default 100ms) elapses
 # BEFORE its send — mirrors the reference's runtime.start(); Thread.sleep(gap);
 # send() shape (absent-pattern waiting clocks are armed at start time)
@@ -823,10 +842,8 @@ def test_reference_corpus(app, seq, expect, end, no_device):
         assert _rows_match(rows, expect), f"host rows: {rows}"
 
     # device parity (best-effort: host-only shapes raise DeviceCompileError;
-    # null-bearing outputs diverge by design — device emits zero values)
-    has_null = (not isinstance(expect, int)) and \
-        any(v is None for r in expect for v in r)
-    if no_device or end or has_null:
+    # null outputs decode via the kernel's carried validity flags)
+    if no_device or end:
         return
     drows = _run_device(app, seq)
     if drows is None:

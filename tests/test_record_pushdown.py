@@ -1,0 +1,176 @@
+"""Record-store condition pushdown: the ExpressionBuilder/ExpressionVisitor
+analog (reference ``AbstractQueryableRecordTable.java:99``).
+
+A test store translates the StoreExpression to a Python predicate (a stand-in
+for a SQL WHERE clause), receives per-lookup parameter values, and returns
+pre-filtered rows — the engine must not re-scan. Stores that decline
+pushdown fall back to the exhaustive scan with host-side filtering.
+"""
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.table import AbstractRecordTable, StoreExpression
+
+
+class _BaseStore(AbstractRecordTable):
+    def __init__(self, definition, app_context):
+        super().__init__(definition, app_context)
+        self.rows: list[list] = []
+        self.find_calls: list = []          # (params, had_compiled)
+        self.compiled_exprs: list = []
+
+    def record_add(self, rows):
+        self.rows.extend(list(r) for r in rows)
+
+
+class PushdownStore(_BaseStore):
+    """Compiles the StoreExpression into a row-predicate factory."""
+
+    def record_compile_condition(self, store_expr: StoreExpression):
+        self.compiled_exprs.append(store_expr)
+        attrs = {a.name: i for i, a in enumerate(self.definition.attributes)}
+
+        class V:                           # → fn(row, params) evaluator tree
+            def attribute(self, name):
+                return lambda row, p, i=attrs[name]: row[i]
+
+            def constant(self, value):
+                return lambda row, p: value
+
+            def param(self, name):
+                return lambda row, p: p[name]
+
+            def compare(self, op, lf, rf):
+                import operator
+                o = {"==": operator.eq, "!=": operator.ne,
+                     "<": operator.lt, "<=": operator.le,
+                     ">": operator.gt, ">=": operator.ge}[op]
+                return lambda row, p: o(lf(row, p), rf(row, p))
+
+            def logical(self, op, lf, rf):
+                if op == "and":
+                    return lambda row, p: lf(row, p) and rf(row, p)
+                return lambda row, p: lf(row, p) or rf(row, p)
+
+            def negate(self, sf):
+                return lambda row, p: not sf(row, p)
+
+            def math(self, op, lf, rf):
+                import operator
+                o = {"+": operator.add, "-": operator.sub,
+                     "*": operator.mul, "/": operator.truediv,
+                     "%": operator.mod}[op]
+                return lambda row, p: o(lf(row, p), rf(row, p))
+
+        return store_expr.visit(V())
+
+    def record_find(self, condition_params, compiled_condition=None):
+        self.find_calls.append((dict(condition_params),
+                                compiled_condition is not None))
+        if compiled_condition is None:
+            return [list(r) for r in self.rows]
+        return [list(r) for r in self.rows
+                if compiled_condition(r, condition_params)]
+
+    def record_delete(self, condition_params, compiled_condition=None):
+        victims = [r for r in self.rows
+                   if compiled_condition(r, condition_params)]
+        for r in victims:
+            self.rows.remove(r)
+        return len(victims)
+
+
+class ScanOnlyStore(_BaseStore):
+    """Declines pushdown (default record_compile_condition)."""
+
+    def record_find(self, condition_params, compiled_condition=None):
+        self.find_calls.append((dict(condition_params),
+                                compiled_condition is not None))
+        return [list(r) for r in self.rows]
+
+
+APP = """
+define stream S (sym string, qty int);
+@store(type='{kind}')
+define table T (sym string, price double);
+from S join T on T.sym == S.sym and T.price > 10.0
+select S.sym as sym, S.qty as qty, T.price as price insert into O;
+"""
+
+
+def _run(kind, cls):
+    m = SiddhiManager()
+    m.set_extension(f"store:{kind}", cls)
+    rt = m.create_siddhi_app_runtime(APP.format(kind=kind), playback=True)
+    store = rt.ctx.tables["T"]
+    store.record_add([["a", 5.0], ["a", 20.0], ["b", 30.0], ["c", 15.0]])
+    got = []
+    rt.add_callback("O", StreamCallback(
+        lambda evs: got.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    rt.input_handler("S").send(["a", 7], timestamp=1000)
+    rt.input_handler("S").send(["b", 9], timestamp=1100)
+    m.shutdown()
+    return store, got
+
+
+def test_pushdown_store_receives_condition_and_params():
+    store, got = _run("pushdb", PushdownStore)
+    assert sorted(got) == [("a", 7, 20.0), ("b", 9, 30.0)]
+    # exactly one compile, one find per lookup, all pushed down
+    assert len(store.compiled_exprs) == 1
+    node = store.compiled_exprs[0].node
+    assert node[0] == "and"
+    assert len(store.find_calls) == 2
+    for params, had in store.find_calls:
+        assert had, "store did not receive the compiled condition"
+        assert list(params.values()) in (["a"], ["b"])
+
+
+def test_scan_only_store_falls_back_to_host_filter():
+    store, got = _run("scandb", ScanOnlyStore)
+    assert sorted(got) == [("a", 7, 20.0), ("b", 9, 30.0)]
+    assert all(not had for _, had in store.find_calls)
+
+
+def test_unsupported_condition_falls_back():
+    """A function call in the condition cannot be pushed down."""
+    app = """
+    define stream S (sym string);
+    @store(type='pushdb2')
+    define table T (sym string, price double);
+    from S join T on T.sym == convert(S.sym, 'string')
+    select S.sym as sym, T.price as price insert into O;
+    """
+    m = SiddhiManager()
+    m.set_extension("store:pushdb2", PushdownStore)
+    rt = m.create_siddhi_app_runtime(app, playback=True)
+    store = rt.ctx.tables["T"]
+    store.record_add([["a", 1.0], ["b", 2.0]])
+    got = []
+    rt.add_callback("O", StreamCallback(
+        lambda evs: got.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    rt.input_handler("S").send(["b"], timestamp=1000)
+    m.shutdown()
+    assert got == [("b", 2.0)]
+    assert store.compiled_exprs == []        # nothing pushable
+    assert all(not had for _, had in store.find_calls)
+
+
+def test_on_demand_query_pushes_down():
+    m = SiddhiManager()
+    m.set_extension("store:pushdb3", PushdownStore)
+    rt = m.create_siddhi_app_runtime("""
+    define stream S (sym string);
+    @store(type='pushdb3')
+    define table T (sym string, price double);
+    from S select sym insert into Dummy;
+    """, playback=True)
+    store = rt.ctx.tables["T"]
+    store.record_add([["a", 5.0], ["b", 30.0], ["c", 50.0]])
+    rt.start()
+    rows = rt.query("from T on price > 10.0 select sym, price")
+    assert sorted(tuple(e.data) for e in rows) == [("b", 30.0), ("c", 50.0)]
+    assert len(store.compiled_exprs) >= 1
+    assert store.find_calls[-1][1]
+    m.shutdown()

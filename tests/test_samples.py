@@ -1,0 +1,23 @@
+"""Every sample must stay runnable (they double as documentation of the
+public API surface — reference siddhi-samples)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+SAMPLES_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "samples")
+SAMPLES = sorted(f for f in os.listdir(SAMPLES_DIR)
+                 if f.endswith(".py") and not f.startswith("_"))
+
+
+@pytest.mark.parametrize("name", SAMPLES)
+def test_sample_runs(name):
+    env = {**os.environ, "N_EVENTS": "20000", "JAX_PLATFORMS": "cpu"}
+    p = subprocess.run(
+        [sys.executable, os.path.join(SAMPLES_DIR, name)],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=SAMPLES_DIR)
+    assert p.returncode == 0, f"{name} failed:\n{p.stderr[-2000:]}"
