@@ -284,6 +284,13 @@ class AggregationRuntime:
                 self._dirty[duration].add(bs)
             bucket = buckets.setdefault(bs, {})
             state = bucket.get(key)
+            if state is None and self.persist_stores:
+                # read-through: a bucket reopening after restart/purge must
+                # resume from its persisted state — a fresh zero state would
+                # clobber the history on the next flush (last-wins append-log)
+                state = self._load_persisted_state(duration, bs, key)
+                if state is not None:
+                    bucket[key] = state
             if state is None:
                 state = {
                     "aggs": {
@@ -382,6 +389,21 @@ class AggregationRuntime:
         drains its CUD queue)."""
         for duration in self.persist_stores:
             self._flush_duration(duration)
+
+    def _load_persisted_state(self, duration, bs: int, key):
+        """Newest persisted state for one (bucket, key), or None."""
+        store = self.persist_stores.get(duration)
+        if store is None:
+            return None
+        key_repr = repr(key)
+        blob = None
+        for row_bs, row_key, row_blob in store.record_find({}):
+            if int(row_bs) == bs and row_key == key_repr:
+                blob = row_blob                 # append order: last wins
+        if blob is None:
+            return None
+        _, state = self._decode_state(blob)
+        return state
 
     def _persisted_rows(self, duration, start=None, end=None) -> dict:
         """{(bucket_ts, key_repr): (key, state)} — newest version wins.

@@ -80,6 +80,15 @@ class BufferedEventsTracker:
             return 0
 
 
+# shared back-references every element holds — following them would charge
+# the whole application graph to each element's gauge (and re-count it per
+# element)
+_SHARED_ATTRS = frozenset({
+    "app_context", "siddhi_context", "ctx", "runtime", "scheduler",
+    "next", "callback", "callbacks", "query_callbacks",
+})
+
+
 def _deep_size(obj, seen: set, depth: int = 0) -> int:
     """Retained-size estimate (reference SiddhiMemoryUsageMetric walks the
     object graph). Device arrays report their on-device byte size."""
@@ -98,7 +107,9 @@ def _deep_size(obj, seen: set, depth: int = 0) -> int:
         for v in obj:
             size += _deep_size(v, seen, depth + 1)
     elif hasattr(obj, "__dict__"):
-        size += _deep_size(obj.__dict__, seen, depth + 1)
+        pruned = {k: v for k, v in obj.__dict__.items()
+                  if k not in _SHARED_ATTRS}
+        size += _deep_size(pruned, seen, depth + 1)
     return size
 
 

@@ -73,6 +73,21 @@ class TableMatchResolver(VariableResolver):
         raise KeyError(f"cannot resolve '{var.attribute}' in table condition")
 
 
+class _RowDependentSet(Exception):
+    """Probe signal: a set expression touched a table column."""
+
+
+class _RaisingRow:
+    """Row stand-in whose every column access raises — used to detect
+    row-dependent set expressions before a record-store update."""
+
+    def __init__(self, table_id: str):
+        self._table_id = table_id
+
+    def __getitem__(self, i):
+        raise _RowDependentSet(self._table_id)
+
+
 class StoreExpression:
     """Store-visitable condition tree (the analog of the reference's
     ``ExpressionBuilder``/``ExpressionVisitor`` output handed to record
@@ -383,21 +398,26 @@ class AbstractRecordTable(Table):
         compiled, param_fns = self._pushdown(cond)
         if compiled is not None:
             # set values are computed ONCE per operation — row-dependent set
-            # expressions (e.g. `set T.price = T.price + 1`) would need
-            # per-row evaluation the record SPI can't express; surface that
-            # instead of writing one wrong value to every matched row
+            # expressions (e.g. `set T.a = T.b`) would need per-row
+            # evaluation the record SPI can't express. Probe each setter
+            # with a row that RAISES on column access (a None row would let
+            # None-tolerant expressions slip through and silently corrupt
+            # every matched row).
             values = {}
             for pos, value_fn in setters:
                 name = self.definition.attributes[pos].name
                 try:
-                    values[name] = value_fn(
-                        TableMatchFrame(None, out_data, ts))
-                except Exception:       # noqa: BLE001 — row ref blew up
+                    value_fn(TableMatchFrame(_RaisingRow(self.id), out_data,
+                                             ts))
+                except _RowDependentSet:
                     raise NotImplementedError(
                         f"store table '{self.id}': set expression for "
                         f"'{name}' references table columns — per-row set "
                         f"expressions are not expressible through the "
                         f"record-store SPI") from None
+                except Exception:       # noqa: BLE001 — unrelated probe
+                    pass                # failure: let the real eval decide
+                values[name] = value_fn(TableMatchFrame(None, out_data, ts))
             return self.record_update(
                 self._params(param_fns, out_data, ts), values, compiled)
         raise NotImplementedError(

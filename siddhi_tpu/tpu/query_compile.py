@@ -201,6 +201,24 @@ class CompiledStreamQuery:
                     self.time_key = key
                     self.window_ms = const_param(1)
                     self.window_n = window_capacity
+                elif h.name == "timeBatch":
+                    # tumbling event-time window; flushes are event-driven on
+                    # device (an arrival at/past the boundary closes the
+                    # bucket — the host does the same inline, plus timers)
+                    if len(h.params) > 1:
+                        raise DeviceCompileError(
+                            "timeBatch start-time parameter takes the host "
+                            "path")
+                    self.window_kind = "timeBatch"
+                    self.window_ms = const_param(0)
+                    self.window_n = window_capacity
+                elif h.name == "session":
+                    if len(h.params) > 1:
+                        raise DeviceCompileError(
+                            "session key / allowedLatency take the host path")
+                    self.window_kind = "session"
+                    self.window_ms = const_param(0)
+                    self.window_n = window_capacity
                 else:
                     raise DeviceCompileError(
                         f"window '{h.name}' has no device kernel yet")
@@ -218,9 +236,11 @@ class CompiledStreamQuery:
                 raise DeviceCompileError("group key must be string/int")
             self.group_keys.append(key)
             self.group_key_types.append(kt)
-        if self.group_keys and self.window_kind == "lengthBatch":
+        if self.group_keys and self.window_kind in (
+                "lengthBatch", "timeBatch", "session"):
             raise DeviceCompileError(
-                "group-by with lengthBatch windows takes the host path")
+                f"group-by with {self.window_kind} windows takes the host "
+                f"path")
 
         # select list
         self.specs: list[_Spec] = []
@@ -306,7 +326,8 @@ class CompiledStreamQuery:
         AF, AI = len(self.fagg_idx), len(self.iagg_idx)
         AS = len(self.sagg_idx)
         state: dict[str, Any] = {}
-        windowed = self.window_kind in ("length", "lengthBatch", "time")
+        windowed = self.window_kind in ("length", "lengthBatch", "time",
+                                        "timeBatch", "session")
         if windowed:
             state["tail_fvals"] = jnp.zeros((AF, N), dtype=FACC)
             state["tail_ivals"] = jnp.zeros((AI, N), dtype=_IACC)
@@ -322,12 +343,17 @@ class CompiledStreamQuery:
             state["window_drops"] = jnp.zeros((), dtype=jnp.int64)
             state["last_ts"] = jnp.asarray(_TS_NEG, dtype=jnp.int64)
             state["ts_regressions"] = jnp.zeros((), dtype=jnp.int64)
-        if self.window_kind == "lengthBatch":
+        if self.window_kind in ("lengthBatch", "timeBatch", "session"):
             state["rem_count"] = jnp.zeros((), dtype=jnp.int32)
             state["rem_ts"] = jnp.zeros((N,), dtype=jnp.int64)
             for i in self.value_idx:
                 state[f"rem_proj_{i}"] = jnp.zeros(
                     (N,), dtype=_JNP_DTYPES[self.specs[i].dtype])
+        if self.window_kind == "timeBatch":
+            state["batch_base"] = jnp.asarray(_TS_NEG, dtype=jnp.int64)
+        if self.window_kind in ("timeBatch", "session"):
+            state["window_drops"] = jnp.zeros((), dtype=jnp.int64)
+            state["ts_regressions"] = jnp.zeros((), dtype=jnp.int64)
         if self.group_keys and windowed:
             # windowed group-by carries no per-key sums — aggregates are
             # recomputed from window contents; only the bucket id per tail
@@ -529,6 +555,14 @@ class CompiledStreamQuery:
                                      iagg_idx, magg_idx, sagg_idx, m_ismin,
                                      proj_c, av_f, av_i, av_s, av_m, ones_c,
                                      cts, k, N, B, finish)
+
+            if window_kind in ("timeBatch", "session"):
+                cts_pos = compact(ts, fill=jnp.asarray(_TS_POS, jnp.int64))
+                return _segmented_batch(state, value_idx, fagg_idx, iagg_idx,
+                                        magg_idx, sagg_idx, m_ismin, proj_c,
+                                        av_f, av_i, av_s, av_m, ones_c,
+                                        cts_pos, k, N, B, finish,
+                                        window_kind, window_ms)
 
             if group_keys:
                 # exact packed key (for collision detection) + bucket id —
@@ -895,6 +929,129 @@ def _length_batch(state, specs, value_idx, fagg_idx, iagg_idx, magg_idx,
     return finish(new_state, sums_f, sums_i, cnts, mins, svars,
                   ovalid=out_valid, ots=zts, proj=zproj,
                   count=full_batches * N)
+
+
+def _segmented_batch(state, value_idx, fagg_idx, iagg_idx, magg_idx,
+                     sagg_idx, m_ismin, proj_c, av_f, av_i, av_s, av_m,
+                     ones_c, cts_pos, k, N, B, finish, mode, window_ms):
+    """timeBatch (tumbling time buckets) and session (gap-separated runs) as
+    one segmented kernel over [remainder + batch] slots.
+
+    - ``timeBatch``: segment id = (ts − base)//duration; only CLOSED buckets
+      (a later bucket's event exists) emit, each slot with running aggregates
+      over its own bucket — the host flushes inline the same way when an
+      event at/past the boundary arrives (``TimeBatchWindow.process``).
+    - ``session``: segments break where the inter-event gap exceeds the gap
+      parameter; every NEW event emits immediately (host SessionWindow passes
+      currents through) with aggregates over its open session so far.
+
+    The open (last) segment carries to the next step, capped at N newest
+    events with ``window_drops`` counting evictions.
+    """
+    r = state["rem_count"]
+    M = N + B
+    total = r + k
+    zm_mask = jnp.concatenate([jnp.arange(N) < r, jnp.arange(B) < k])
+    zrank = jnp.cumsum(zm_mask.astype(jnp.int32)) - 1
+    zpos = jnp.where(zm_mask, zrank, M - 1)
+
+    def zc(x_rem, x_batch, fill=None):
+        x = jnp.concatenate([x_rem, x_batch])
+        f = jnp.zeros((), x.dtype) if fill is None else fill
+        out = jnp.full((M,), f, dtype=x.dtype)
+        return out.at[zpos].set(jnp.where(zm_mask, x, f), mode="drop")
+
+    z_f = jax.vmap(zc)(state["tail_fvals"], av_f) if len(fagg_idx) \
+        else jnp.zeros((0, M), FACC)
+    z_i = jax.vmap(zc)(state["tail_ivals"], av_i) if len(iagg_idx) \
+        else jnp.zeros((0, M), _IACC)
+    z_s = jax.vmap(zc)(state["tail_svals"], av_s) if len(sagg_idx) \
+        else jnp.zeros((0, M), FACC)
+    zm = {i: zc(state[f"tail_m{i}"], av_m[i],
+                fill=_ident(av_m[i].dtype, m_ismin[i])) for i in magg_idx}
+    # padding slots carry +inf timestamps: they sort after every real event
+    # and land in their own far-future segment
+    zts = zc(state["rem_ts"], cts_pos, fill=jnp.asarray(_TS_POS, jnp.int64))
+    zproj = {i: zc(state[f"rem_proj_{i}"], proj_c[i]) for i in value_idx}
+    zo = zc(jnp.where(jnp.arange(N) < r, state["tail_ones"], 0), ones_c)
+
+    j2 = jnp.arange(M)
+    last_idx = jnp.clip(total - 1, 0, M - 1)
+    # segments need nondecreasing time: out-of-order arrivals are clamped to
+    # the running max (counted — same loud policy as the sliding time window;
+    # the host buckets by arrival within the open bucket, which this matches)
+    zts_m = jax.lax.cummax(zts)
+    regressions = jnp.sum(((zts_m > zts) & (j2 < total)).astype(jnp.int64))
+    if mode == "timeBatch":
+        armed = state["batch_base"] > _TS_NEG
+        base = jnp.where(armed, state["batch_base"], zts_m[0])
+        seg = (zts_m - base) // jnp.int64(window_ms)
+        seg_last = seg[last_idx]
+        out_valid = (j2 < total) & (seg < seg_last)
+        open_mask = (j2 < total) & (seg == seg_last)
+    else:                                   # session
+        prev_ts = jnp.concatenate([zts_m[:1], zts_m[:-1]])
+        # a gap of EXACTLY the parameter closes the session (host timer fires
+        # at last_ts + gap before the arrival is processed)
+        brk = ((zts_m - prev_ts) >= window_ms).at[0].set(False)
+        seg = jnp.cumsum(brk.astype(jnp.int64))
+        seg_last = seg[last_idx]
+        out_valid = (j2 >= r) & (j2 < total)      # currents pass through once
+        open_mask = (j2 < total) & (seg == seg_last)
+
+    seg_start = jnp.searchsorted(seg, seg, side="left")
+    sums_f = _range_sums(z_f, seg_start, j2)
+    sums_i = _range_sums(z_i, seg_start, j2)
+    cso = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(zo)])
+    cnts = (cso[j2 + 1] - cso[seg_start]).astype(jnp.int64)
+    mins = {i: _range_reduce(zm[i], seg_start, j2, m_ismin[i])
+            for i in magg_idx}
+    svars = _window_svars(z_s, zo, seg_start, j2, cnts, k, N, M)
+
+    # carry the open segment, capped at the N NEWEST events
+    open_count = jnp.sum(open_mask.astype(jnp.int32))
+    rem_n = jnp.minimum(open_count, N)
+    dropped = (open_count - rem_n).astype(jnp.int64)
+    # slice start can exceed M - N (dynamic_slice would silently clamp and
+    # misalign) — pad the slab so a length-N slice fits at any start ≤ M
+    slice_from = jnp.maximum(total - rem_n, 0)
+
+    def rem_slice(row):
+        padded = jnp.concatenate(
+            [row, jnp.zeros((N,), row.dtype)])
+        return jax.lax.dynamic_slice(padded, (slice_from,), (N,))
+
+    keep = jnp.arange(N) < rem_n
+    new_state = {**state, "rem_count": rem_n.astype(jnp.int32),
+                 "window_drops": state["window_drops"] + dropped,
+                 "ts_regressions": state["ts_regressions"] + regressions}
+    new_state["tail_fvals"] = jnp.where(
+        keep[None, :], jax.vmap(rem_slice)(z_f), 0.0) if len(fagg_idx) \
+        else state["tail_fvals"]
+    new_state["tail_ivals"] = jnp.where(
+        keep[None, :], jax.vmap(rem_slice)(z_i), 0) if len(iagg_idx) \
+        else state["tail_ivals"]
+    new_state["tail_svals"] = jnp.where(
+        keep[None, :], jax.vmap(rem_slice)(z_s), 0.0) if len(sagg_idx) \
+        else state["tail_svals"]
+    for i in magg_idx:
+        ident = _ident(zm[i].dtype, m_ismin[i])
+        new_state[f"tail_m{i}"] = jnp.where(keep, rem_slice(zm[i]), ident)
+    new_state["tail_ones"] = jnp.where(keep, rem_slice(zo), 0)
+    # carry the monotonized time so segmentation stays consistent across
+    # steps (emitted rows keep their original timestamps)
+    new_state["rem_ts"] = jnp.where(keep, rem_slice(zts_m), 0)
+    for i in value_idx:
+        z_p = zproj[i]
+        new_state[f"rem_proj_{i}"] = jnp.where(
+            keep, rem_slice(z_p), jnp.zeros((), z_p.dtype))
+    if mode == "timeBatch":
+        new_state["batch_base"] = jnp.where(
+            total > 0, base, state["batch_base"])
+
+    count = jnp.sum(out_valid.astype(jnp.int32))
+    return finish(new_state, sums_f, sums_i, cnts, mins, svars,
+                  ovalid=out_valid, ots=zts, proj=zproj, count=count)
 
 
 def _materialize(specs, value_idx, fagg_idx, iagg_idx, magg_idx, sagg_idx,

@@ -86,6 +86,31 @@ def test_out_of_order_reopen_last_version_wins():
     m2.shutdown()
 
 
+def test_reopened_bucket_resumes_from_persisted_state():
+    """An event landing in an already-persisted bucket (after restart) must
+    resume that bucket's state, not clobber it with a fresh zero state."""
+    SharedStore.DATA.clear()
+    m1, r1 = _mk()
+    r1.input_handler("S").send(["a", 30.0], timestamp=1_000)
+    m1.shutdown()                      # bucket 1000 persisted: total=30
+
+    m2, r2 = _mk()
+    r2.input_handler("S").send(["a", 5.0], timestamp=1_200)   # same bucket
+    rows = r2.query("from AvgPrice within 0L, 10000L per 'seconds' "
+                    "select AGG_TIMESTAMP, sym, total")
+    got = sorted(tuple(e.data) for e in rows)
+    assert (1000, "a", 35.0) in got, got
+    m2.shutdown()
+
+    # and the store's newest version reflects the merged state
+    m3, r3 = _mk()
+    rows = r3.query("from AvgPrice within 0L, 10000L per 'seconds' "
+                    "select AGG_TIMESTAMP, sym, total")
+    got = sorted(tuple(e.data) for e in rows)
+    assert (1000, "a", 35.0) in got, got
+    m3.shutdown()
+
+
 def test_aggregation_join_reads_persisted_history():
     SharedStore.DATA.clear()
     m1, r1 = _mk()

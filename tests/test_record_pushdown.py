@@ -157,6 +157,57 @@ def test_unsupported_condition_falls_back():
     assert all(not had for _, had in store.find_calls)
 
 
+def test_row_dependent_set_expression_rejected():
+    """`set T.a = T.b` cannot be expressed through the record SPI — it must
+    raise, not silently write None/one value to every matched row."""
+    import pytest
+
+    class UpdStore(PushdownStore):
+        def record_update(self, condition_params, values, compiled_condition=None):
+            n = 0
+            for r in self.rows:
+                if compiled_condition(r, condition_params):
+                    for name, v in values.items():
+                        r[self.definition.attribute_position(name)] = v
+                    n += 1
+            return n
+
+    m = SiddhiManager()
+    m.set_extension("store:upddb", UpdStore)
+    rt = m.create_siddhi_app_runtime("""
+    define stream S (sym string);
+    @store(type='upddb')
+    define table T (sym string, a double, b double);
+    from S select sym update T set T.a = T.b on T.sym == sym;
+    """, playback=True)
+    store = rt.ctx.tables["T"]
+    store.record_add([["x", 1.0, 99.0]])
+    rt.start()
+    errors = []
+    rt.set_exception_listener(errors.append)
+    rt.input_handler("S").send(["x"], timestamp=1000)
+    m.shutdown()
+    # the row is untouched and the error surfaced
+    assert store.rows == [["x", 1.0, 99.0]]
+    assert errors and isinstance(errors[0], NotImplementedError)
+
+    # constant / stream-side sets still work
+    m2 = SiddhiManager()
+    m2.set_extension("store:upddb2", UpdStore)
+    rt2 = m2.create_siddhi_app_runtime("""
+    define stream S (sym string, nv double);
+    @store(type='upddb2')
+    define table T (sym string, a double, b double);
+    from S select sym, nv update T set T.a = S.nv on T.sym == sym;
+    """, playback=True)
+    store2 = rt2.ctx.tables["T"]
+    store2.record_add([["x", 1.0, 99.0]])
+    rt2.start()
+    rt2.input_handler("S").send(["x", 7.5], timestamp=1000)
+    m2.shutdown()
+    assert store2.rows == [["x", 7.5, 99.0]]
+
+
 def test_on_demand_query_pushes_down():
     m = SiddhiManager()
     m.set_extension("store:pushdb3", PushdownStore)

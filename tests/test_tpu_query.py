@@ -246,7 +246,7 @@ def test_unsupported_falls_back_cleanly():
     with pytest.raises(DeviceCompileError):
         DeviceStreamRuntime("""
         define stream S (v long);
-        from S#window.session(1 sec) select sum(v) as s insert into O;
+        from S#window.sort(5, v) select sum(v) as s insert into O;
         """)
     with pytest.raises(DeviceCompileError):
         DeviceStreamRuntime("""
@@ -546,4 +546,140 @@ def test_group_by_windowed_minmax_falls_back():
         define stream S (k string, v long);
         from S#window.length(5) select k, min(v) as m
         group by k insert into O;
+        """)
+
+
+# --------------------------------------------- timeBatch / session kernels
+
+def interpreter_run_ts(app, rows_ts, out="O", end_advance=0):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, playback=True)
+    got = []
+    rt.add_callback(out, StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    ih = rt.input_handler("S")
+    for row, ts in rows_ts:
+        ih.send(row, timestamp=ts)
+    if end_advance:
+        rt.advance_time(rows_ts[-1][1] + end_advance)
+    m.shutdown()
+    return [e.data for e in got]
+
+
+def device_run_ts(app, rows_ts, batch_capacity=64, window=64):
+    rt = DeviceStreamRuntime(app, batch_capacity=batch_capacity,
+                             window_capacity=window)
+    got = []
+    rt.add_callback(got.extend)
+    for row, ts in rows_ts:
+        rt.send(row, timestamp=ts)
+    rt.flush()
+    return got
+
+
+def assert_parity_ts(app, rows_ts, batch_capacity=64, window=64,
+                     rel=2e-3, abs_=2e-3):
+    # sums use cumsum differences over the [remainder+batch] slab (dtypes.py
+    # policy: error ~ eps * slab total), so single-element buckets can be off
+    # by ~1e-4 absolute — tolerance reflects the documented f32 sum policy
+    expected = interpreter_run_ts(app, rows_ts)
+    actual = device_run_ts(app, rows_ts, batch_capacity, window)
+    assert len(expected) == len(actual), (expected, actual)
+    for e, a in zip(expected, actual):
+        assert rows_equal(e, a, rel=rel, abs_=abs_), (e, a)
+
+
+APP_TIME_BATCH = """
+define stream S (sym string, price double, vol long);
+from S#window.timeBatch(1 sec)
+select sym, sum(price) as total, count() as c, avg(price) as ap,
+       min(price) as lo
+insert into O;
+"""
+
+APP_SESSION = """
+define stream S (sym string, price double, vol long);
+from S#window.session(1 sec)
+select sym, sum(price) as total, count() as c, max(vol) as hv
+insert into O;
+"""
+
+
+def _ts_rows(n, seed, spread_ms):
+    rng = random.Random(seed)
+    ts = 1000
+    out = []
+    for _ in range(n):
+        ts += rng.randrange(spread_ms)
+        out.append(([rng.choice("ab"), round(rng.uniform(0, 50), 2),
+                     rng.randrange(100)], ts))
+    return out
+
+
+def test_parity_time_batch():
+    # spread crosses many 1s boundaries, incl. multi-bucket steps and gaps;
+    # both engines flush event-driven (the host also inline-flushes when an
+    # arrival passes the boundary)
+    assert_parity_ts(APP_TIME_BATCH, _ts_rows(120, 5, 400))
+
+
+def test_parity_time_batch_small_batches():
+    # buckets span micro-batch boundaries: the open bucket must carry
+    assert_parity_ts(APP_TIME_BATCH, _ts_rows(90, 6, 300), batch_capacity=8)
+
+
+def test_parity_time_batch_sparse():
+    # long empty stretches: several whole buckets between events
+    assert_parity_ts(APP_TIME_BATCH, _ts_rows(40, 7, 3000), batch_capacity=8)
+
+
+def test_parity_session():
+    assert_parity_ts(APP_SESSION, _ts_rows(120, 8, 400))
+
+
+def test_parity_session_small_batches():
+    # open sessions must continue across micro-batch boundaries (capacity
+    # above the largest session — overflow is a separate, counted case)
+    assert_parity_ts(APP_SESSION, _ts_rows(90, 9, 300), batch_capacity=8,
+                     window=128)
+
+
+def test_session_overflow_counts_drops():
+    """An open session larger than the carry capacity drops oldest events —
+    loudly (window_drops), not silently."""
+    rt = DeviceStreamRuntime(APP_SESSION, batch_capacity=8, window_capacity=8)
+    for i in range(40):
+        rt.send(["a", 1.0, i], timestamp=1000 + i)   # one giant session
+    rt.flush()
+    assert int(rt.snapshot_state()["device"]["window_drops"]) > 0
+
+
+def test_parity_session_exact_gap_boundary():
+    # a gap of EXACTLY the parameter closes the session (host timer fires at
+    # last_ts + gap before the arrival is processed)
+    rows = [(["a", 10.0, 1], 1000), (["a", 20.0, 2], 1999),
+            (["a", 30.0, 3], 2999),      # 1000ms after 1999 → new session
+            (["a", 40.0, 4], 3500)]
+    assert_parity_ts(APP_SESSION, rows)
+
+
+def test_time_batch_session_reject_extra_params():
+    with pytest.raises(DeviceCompileError):
+        DeviceStreamRuntime("""
+        define stream S (v double);
+        from S#window.timeBatch(1 sec, 0) select sum(v) as t insert into O;
+        """)
+    with pytest.raises(DeviceCompileError):
+        DeviceStreamRuntime("""
+        define stream S (sym string, v double);
+        from S#window.session(1 sec, sym) select sum(v) as t insert into O;
+        """)
+
+
+def test_parity_group_by_time_batch_falls_back():
+    with pytest.raises(DeviceCompileError):
+        DeviceStreamRuntime("""
+        define stream S (sym string, v double);
+        from S#window.timeBatch(1 sec)
+        select sym, sum(v) as t group by sym insert into O;
         """)
