@@ -232,7 +232,10 @@ class PatternRuntime:
         node = self.c.nodes[node_idx]
         self.pending[node_idx].append(p)
         self._created.add(id(p))
-        if node.kind == "absent" and node.waiting_time_ms is not None:
+        if node.kind in ("absent", "logical") and \
+                node.waiting_time_ms is not None:
+            # absent nodes AND logical nodes with an `... for t` side start
+            # their non-occurrence clock on arrival at the state
             arrival_key = f"absent_arrival_{node.index}"
             p.meta[arrival_key] = now
             fire_at = now + node.waiting_time_ms
@@ -260,7 +263,8 @@ class PatternRuntime:
         if p not in self.pending[nxt]:
             self.pending[nxt].append(p)     # shared reference, per reference semantics
         node = self.c.nodes[nxt]
-        if node.kind == "absent" and node.waiting_time_ms is not None:
+        if node.kind in ("absent", "logical") and \
+                node.waiting_time_ms is not None:
             arrival_key = f"absent_arrival_{node.index}"
             if arrival_key not in p.meta:
                 p.meta[arrival_key] = now
@@ -357,6 +361,26 @@ class PatternRuntime:
             matched = True
             touched.add(id(p))
             if b.is_absent:
+                if node.index == 0 and node.kind == "logical" \
+                        and node.waiting_time_ms is not None:
+                    # start-state `X and/or not Y for t`: the forbidden event
+                    # RESTARTS the wait (reference keeps start states live;
+                    # LogicalAbsentPatternTestCase.testQueryAbsent8_2/10)
+                    arrival_key = f"absent_arrival_{node.index}"
+                    p.meta[arrival_key] = now
+                    p.meta.pop(f"logical_established_{i}", None)
+                    self.app_context.scheduler.notify_at(
+                        now + node.waiting_time_ms,
+                        lambda ts, ni=i, pp=p: self._absent_timer(ni, pp, ts))
+                    return True
+                if node.kind == "logical" \
+                        and node.logical_type == LogicalType.OR:
+                    # `... or not Y for t`: Y's arrival only kills the
+                    # ABSENT alternative — the present side can still match
+                    # later (reference LogicalAbsentPatternTestCase
+                    # testQueryAbsent15)
+                    p.meta[f"logical_absent_dead_{i}"] = True
+                    return True
                 if node.index == 0 and node.kind == "absent" \
                         and node.waiting_time_ms is not None:
                     # start-state absent: the forbidden event RESTARTS the
@@ -444,14 +468,20 @@ class PatternRuntime:
                     adv.meta.pop(f"logical_{i}", None)
                     self._advance(node, adv, now)
                 elif done and absent_other:
-                    # `X and not Y`: wait for Y's non-occurrence timer? The
-                    # reference advances on X if no timer is set (no `for`).
-                    if node.waiting_time_ms is None:
+                    # `X or not Y for t`: X advances immediately (first of
+                    # the two alternatives wins). `X and not Y [for t]`:
+                    # advance if no timer is required, or if the
+                    # non-occurrence was already established; otherwise the
+                    # timer decides later.
+                    established = p.meta.get(f"logical_established_{i}")
+                    if node.logical_type == LogicalType.OR \
+                            or node.waiting_time_ms is None \
+                            or established is not None:
                         self.pending[i].remove(p)
                         adv = p.copy()
                         adv.meta.pop(f"logical_{i}", None)
+                        adv.meta.pop(f"logical_established_{i}", None)
                         self._advance(node, adv, now)
-                    # else: the absent timer decides later
             break
         return matched
 
@@ -516,14 +546,26 @@ class PatternRuntime:
             adv.meta.pop(f"absent_arrival_{node.index}", None)
             self._advance(node, adv, ts)
         elif node.kind == "logical":
-            # `X and not Y for t`: advance iff X matched and Y never arrived
+            if ts < arrival + node.waiting_time_ms:
+                return                   # stale timer (wait was restarted)
+            if p.meta.get(f"logical_absent_dead_{node_idx}"):
+                return                   # forbidden event spoiled the wait
             sides = p.meta.get(f"logical_{node_idx}", set())
             required = [b.alias for b in node.branches if not b.is_absent]
-            if all(a in sides for a in required):
+            if node.logical_type == LogicalType.OR \
+                    or all(a in sides for a in required):
+                # OR: established non-occurrence completes the state with
+                # the present side unbound (null). AND: complete iff the
+                # present side already matched.
                 self.pending[node_idx].remove(p)
                 adv = p.copy()
                 adv.meta.pop(f"logical_{node_idx}", None)
+                adv.meta.pop(f"logical_established_{node_idx}", None)
                 self._advance(node, adv, ts)
+            else:
+                # AND, X not yet bound: remember the establishment so a
+                # later X advances immediately
+                p.meta[f"logical_established_{node_idx}"] = ts
 
     # -- sequence strictness --------------------------------------------------
     def _enforce_strict(self, stream_id: str, event: StreamEvent,

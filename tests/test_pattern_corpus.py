@@ -643,6 +643,224 @@ select e2.symbol as symbol insert into OutputStream;
 """, [("Stream2", ["IBM", 58.7, 100], 1100)],
         1),
 
+    # ---------------- LogicalAbsentPatternTestCase ----------------------
+    _case("labsent1", S3 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] and e3=Stream3[price>30]
+select e1.symbol as symbol1, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.0, 100]), ("Stream3", ["GOOGLE", 35.0, 100])],
+        [["WSO2", "GOOGLE"]]),
+    _case("labsent2", S3 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] and e3=Stream3[price>30]
+select e1.symbol as symbol1, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.0, 100]), ("Stream2", ["IBM", 25.0, 100]),
+      ("Stream3", ["GOOGLE", 35.0, 100])],
+        0),
+    _case("labsent3", S3 + """
+from not Stream1[price>10] and e2=Stream2[price>20] -> e3=Stream3[price>30]
+select e2.symbol as symbol2, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream2", ["IBM", 25.0, 100]), ("Stream3", ["GOOGLE", 35.0, 100])],
+        [["IBM", "GOOGLE"]]),
+    _case("labsent4", S3 + """
+from not Stream1[price>10] and e2=Stream2[price>20] -> e3=Stream3[price>30]
+select e2.symbol as symbol2, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.0, 100]), ("Stream2", ["IBM", 25.0, 100]),
+      ("Stream3", ["GOOGLE", 35.0, 100])],
+        0),
+    _case("labsent5", S3 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+  and e3=Stream3[price>30]
+select e1.symbol as symbol1, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.0, 100]),
+      ("Stream3", ["GOOGLE", 35.0, 100], 1100)],
+        [["WSO2", "GOOGLE"]]),
+    _case("labsent5_1", S3 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+  and e3=Stream3[price>30]
+select e1.symbol as symbol1, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.0, 100]),
+      ("Stream3", ["GOOGLE", 35.0, 100], 500)],
+        1, end=700),
+    _case("labsent5_2", S3 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+  and e3=Stream3[price>30]
+select e1.symbol as symbol1, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.0, 100], 1100),
+      ("Stream3", ["GOOGLE", 35.0, 100])],
+        0),
+    _case("labsent6", S3 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+  and e3=Stream3[price>30]
+select e1.symbol as symbol1, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.0, 100]), ("Stream3", ["GOOGLE", 35.0, 100])],
+        0),
+    _case("labsent7", S3 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+  and e3=Stream3[price>30]
+select e1.symbol as symbol1, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.0, 100]), ("Stream2", ["IBM", 25.0, 100]),
+      ("Stream3", ["GOOGLE", 35.0, 100])],
+        0, end=2100),
+    _case("labsent8", S3 + """
+from not Stream1[price>10] for 1 sec and e2=Stream2[price>20]
+  -> e3=Stream3[price>30]
+select e2.symbol as symbol2, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream2", ["IBM", 25.0, 100], 1100),
+      ("Stream3", ["GOOGLE", 35.0, 100])],
+        [["IBM", "GOOGLE"]]),
+    _case("labsent8_1", S3 + """
+from not Stream1[price>10] for 1 sec and e2=Stream2[price>20]
+  -> e3=Stream3[price>30]
+select e2.symbol as symbol2, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream2", ["IBM", 25.0, 100]),
+      ("Stream3", ["GOOGLE", 35.0, 100], 1100)],
+        1),
+    _case("labsent8_2", S3 + """
+from not Stream1[price>10] for 1 sec and e2=Stream2[price>20]
+  -> e3=Stream3[price>30]
+select e2.symbol as symbol2, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.0, 100], 500), ("Stream2", ["IBM", 25.0, 100], 600),
+      ("Stream3", ["GOOGLE", 35.0, 100])],
+        0),
+    _case("labsent9", S3 + """
+from not Stream1[price>10] for 1 sec and e2=Stream2[price>20]
+  -> e3=Stream3[price>30]
+select e2.symbol as symbol2, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream2", ["IBM", 25.0, 100]), ("Stream3", ["GOOGLE", 35.0, 100])],
+        0, end=1100),
+    _case("labsent10", S3 + """
+from not Stream1[price>10] for 1 sec and e2=Stream2[price>20]
+  -> e3=Stream3[price>30]
+select e2.symbol as symbol2, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.0, 100]), ("Stream2", ["IBM", 25.0, 100], 1100),
+      ("Stream3", ["GOOGLE", 35.0, 100])],
+        1),
+    _case("labsent11", S3 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+  or e3=Stream3[price>30]
+select e1.symbol as symbol1, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.0, 100]), ("Stream3", ["GOOGLE", 35.0, 100])],
+        [["WSO2", "GOOGLE"]]),
+    _case("labsent12", S3 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+  or e3=Stream3[price>30]
+select e1.symbol as symbol1, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.0, 100]), ("Stream3", ["GOOGLE", 35.0, 100])],
+        1, end=1100),
+    _case("labsent15", S3 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+  or e3=Stream3[price>30]
+select e1.symbol as symbol1, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.0, 100]), ("Stream2", ["IBM", 25.0, 100]),
+      ("Stream3", ["GOOGLE", 35.0, 100])],
+        [["WSO2", "GOOGLE"]], end=2000),
+    _case("labsent13", S3 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+  or e3=Stream3[price>30]
+select e1.symbol as symbol1, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.0, 100])],
+        [["WSO2", None]], end=1100),
+    _case("labsent14", S3 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+  or e3=Stream3[price>30]
+select e1.symbol as symbol1, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.0, 100])],
+        0),
+    _case("labsent16", S3 + """
+from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+  or e3=Stream3[price>30]
+select e1.symbol as symbol1, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 15.0, 100]), ("Stream2", ["IBM", 25.0, 100])],
+        0, end=1100),
+    _case("labsent17", S3 + """
+from not Stream1[price>10] for 1 sec or e2=Stream2[price>20]
+  -> e3=Stream3[price>30]
+select e2.symbol as symbol2, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream2", ["WSO2", 25.0, 100]), ("Stream3", ["GOOGLE", 35.0, 100])],
+        [["WSO2", "GOOGLE"]]),
+    _case("labsent18", S3 + """
+from not Stream1[price>10] for 1 sec or e2=Stream2[price>20]
+  -> e3=Stream3[price>30]
+select e2.symbol as symbol2, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream3", ["GOOGLE", 35.0, 100], 1100)],
+        [[None, "GOOGLE"]]),
+    _case("labsent19", S3 + """
+from not Stream1[price>10] for 1 sec or e2=Stream2[price>20]
+  -> e3=Stream3[price>30]
+select e2.symbol as symbol2, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream3", ["GOOGLE", 35.0, 100])],
+        0),
+
+    # ---------------- EveryAbsent / AbsentWithEvery ---------------------
+    _case("eabsent1", S2 + """
+from e1=Stream1[price>20] -> every not Stream2[price>e1.price] for 1 sec
+select e1.symbol as symbol1 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100])],
+        3, end=3200),
+    _case("eabsent4", S2 + """
+from e1=Stream1[price>20] -> every not Stream2[price>e1.price] for 1 sec
+select e1.symbol as symbol1 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]),
+      ("Stream2", ["IBM", 58.7, 100], 2100)],
+        2, end=1100),
+    _case("eabsent5", S2 + """
+from every not Stream1[price>20] for 1 sec -> e2=Stream2[price>30]
+select e2.symbol as symbol1 insert into OutputStream;
+""", [("Stream2", ["IBM", 58.7, 100], 2100)],
+        2, end=1100),
+    _case("eabsent6", S2 + """
+from e1=Stream1[price>20] -> every not Stream2[price>e1.price] for 1 sec
+select e1.symbol as symbol1 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 58.7, 100])],
+        0, end=1100),
+    _case("eabsent7", S2 + """
+from e1=Stream1[price>20] -> every not Stream2[price>e1.price] for 1 sec
+select e1.symbol as symbol1 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 50.7, 100])],
+        2, end=2100),
+    _case("eabsent10", S2 + """
+from every not Stream1[price>20] for 1 sec -> e2=Stream2[price>30]
+select e2.symbol as symbol insert into OutputStream;
+""", [("Stream1", ["WSO2", 25.6, 100]), ("Stream1", ["WSO2", 25.6, 100], 500),
+      ("Stream1", ["WSO2", 25.6, 100], 500), ("Stream2", ["IBM", 58.7, 100], 500)],
+        0),
+    _case("awevery1", S2B + """
+from every e1=Stream1[price>20] -> not Stream2[price1>e1.price] for 1 sec
+select e1.symbol as symbol insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["GOOG", 55.6, 100])],
+        2, end=1100),
+    _case("awevery2", S2B + """
+from every e1=Stream1[price>20] -> not Stream2[price1>e1.price] for 1 sec
+select e1.symbol as symbol insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["GOOG", 55.6, 100]),
+      ("Stream2", ["IBM", 55.7, 100])],
+        0, end=1100),
+    _case("awevery3", S3 + """
+from every e1=Stream1[price>20] -> not Stream2[price>e1.price] for 1 sec
+  -> e3=Stream3[price>e1.price]
+select e1.symbol as symbol1, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["GOOG", 55.6, 100]),
+      ("Stream3", ["IBM", 55.7, 100], 1100)],
+        2),
+    _case("awevery4", S2 + """
+from not Stream1[price>10] for 1 sec -> every e2=Stream2[price>20]
+select e2.symbol as symbol insert into OutputStream;
+""", [("Stream2", ["WSO2", 55.6, 100], 1100),
+      ("Stream2", ["GOOG", 55.6, 100])],
+        2),
+    _case("awevery5", S2 + """
+from not Stream1[price>10] for 1 sec -> every e2=Stream2[price>20]
+select e2.symbol as symbol insert into OutputStream;
+""", [("Stream1", ["IBM", 55.7, 100]), ("Stream2", ["WSO2", 55.6, 100]),
+      ("Stream2", ["GOOG", 55.6, 100])],
+        0),
+    _case("awevery6", S3 + """
+from e1=Stream1[price>20] -> not Stream2[price>e1.price] for 1 sec
+  -> every e3=Stream3[price>e1.price]
+select e1.symbol as symbol1, e3.symbol as symbol3 insert into OutputStream;
+""", [("Stream1", ["WSO2", 55.6, 100]), ("Stream3", ["GOOG", 55.7, 100], 1100),
+      ("Stream3", ["IBM", 55.8, 100])],
+        2),
+
     # ---------------- SequenceTestCase ----------------------------------
     _case("seq1", S2 + """
 from e1=Stream1[price>20], e2=Stream2[price>e1.price]
