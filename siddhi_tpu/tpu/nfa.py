@@ -24,7 +24,8 @@ scopes starting at state 0, stream-level ``within``. Logical ``and``/``or``
 binds; standalone ``not X for t`` carries a per-slot arrival clock — expiry is
 evaluated in a pre-pass on the next arriving event (host timers fire before
 event delivery, so observable timing matches under the event-driven clock).
-Still host-only: final count states, element-level ``within``, absent without
+Still host-only: final count states, element-level ``within`` outside
+stream-chain patterns (the blocked kernel handles it there), absent without
 ``for``, patterns starting with absent, logical/absent/count inside
 sequences, logical/absent directly after a count state, sibling-alias
 references inside a logical state, and `e[k]` indexing beyond first/last.
@@ -205,6 +206,7 @@ class _DevState:
     min_count: int = 1
     max_count: int = 1
     ends_every: bool = False     # reseed scope [0..index]
+    within_ms: Optional[int] = None        # element-level within
 
     # single-branch conveniences (stream/count states)
     @property
@@ -338,12 +340,11 @@ class DeviceNFACompiler:
         self.alias_branch: dict[str, tuple[int, int]] = {}   # alias → (state, branch)
         self.referenced: set[tuple[int, str, DataType]] = set()
         nodes = self.compiled.nodes
+        has_element_within = any(n.within_ms is not None for n in nodes)
         for node in nodes:
             if node.kind not in ("stream", "count", "logical", "absent"):
                 raise DeviceCompileError(
                     f"'{node.kind}' states need the host path")
-            if node.within_ms is not None:
-                raise DeviceCompileError("element-level within needs host path")
             if node.reseed_to not in (None, 0):
                 raise DeviceCompileError("`every` scope must start the pattern")
             if node.kind == "logical" and node.waiting_time_ms is not None:
@@ -379,6 +380,7 @@ class DeviceNFACompiler:
                 waiting_ms=node.waiting_time_ms,
                 min_count=node.min_count, max_count=node.max_count,
                 ends_every=node.reseed_to == 0,
+                within_ms=node.within_ms,
             )
             self.states.append(st)
             for bi, b in enumerate(node.branches):
@@ -413,6 +415,12 @@ class DeviceNFACompiler:
         # count/logical/absent states use the per-event scan
         from .nfa_block import blocked_eligible
         self.blocked = blocked_eligible(self)
+        if has_element_within and not self.blocked:
+            # the blocked kernel masks per-state gaps on its grids; the scan
+            # kernel's tables don't carry last-bind times
+            raise DeviceCompileError(
+                "element-level within outside stream-chain patterns needs "
+                "the host path")
         self._step = jax.jit(self._make_step(), donate_argnums=(0,))
 
     def _compile_predicates(self, ist: StateInputStream) -> None:

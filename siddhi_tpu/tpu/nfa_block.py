@@ -35,8 +35,9 @@ finds a SUPERSET of the per-event kernel's matches (closer to the host
 oracle, which never drops); with no pressure the two are identical.
 
 Scope: every state ``kind == 'stream'``, ``every`` scope = whole pattern
-(``always_seed``) or absent entirely with S == 1; patterns and sequences;
-stream-level ``within``. Count/logical/absent states use the per-event scan
+(``always_seed``); patterns and sequences; stream-level ``within`` AND
+element-level ``within`` (per-state gap masks against the previous
+element's bind time). Count/logical/absent states use the per-event scan
 kernel (``nfa.py``).
 
 Reference semantics: ``StreamPreStateProcessor.processAndReturn``
@@ -75,12 +76,18 @@ def block_init_state(nfa: "DeviceNFACompiler") -> dict:
     [old slots (already ordered), creations (born ascending)]. Drop-newest
     truncation is therefore just "keep the first C survivors"."""
     C = nfa.C
+    has_ew = any(st.within_ms is not None for st in nfa.states)
     tables = {}
     for s in range(1, nfa.S):
         fields = {
             "valid": jnp.zeros((C,), jnp.bool_),
             "first_ts": jnp.full((C,), -1, jnp.int64),
         }
+        if has_ew:
+            # time of the binding that brought the partial here (element-
+            # level `within` measures gaps between consecutive elements) —
+            # carried only when some state needs it
+            fields["last_ts"] = jnp.full((C,), -1, jnp.int64)
         for (q, key, t) in nfa.referenced:
             if q < s:
                 fields[key] = jnp.zeros((C,), _JNP[t])
@@ -112,6 +119,7 @@ def make_block_step(nfa: "DeviceNFACompiler"):
     # exact growth is [B, sC+B], fine for realistic S — but long patterns
     # (large S) can opt in via ``DeviceNFACompiler.creation_cap``.
     K = getattr(nfa, "creation_cap", None)
+    has_ew = any(st.within_ms is not None for st in states)
 
     def binding_keys(s: int) -> list:
         """Referenced bound-value keys carried by a partial AT state s."""
@@ -193,18 +201,23 @@ def make_block_step(nfa: "DeviceNFACompiler"):
                 "bind": {k: cp(v, jnp.zeros((), v.dtype))
                          for k, v in cre["bind"].items()},
             }
+            if "last_ts" in cre:
+                out["last_ts"] = cp(cre["last_ts"], jnp.int64(-1))
             dropped = jnp.maximum(
                 jnp.sum(ex.astype(jnp.int64)) - K, 0)
             return out, dropped
 
         # creations entering state 1
-        creations, dropped = compact({
+        cre0 = {
             "exists": gate0,
             "born": jidx,                                  # batch position
             "vb": vidx,                                    # vidx[born]
             "first_ts": ts,
             "bind": new_binding_cols(0, cols),             # b0_* [B]
-        })
+        }
+        if has_ew:
+            cre0["last_ts"] = ts
+        creations, dropped = compact(cre0)
         drops = drops + dropped
 
         out_mask = out_j = out_ts = None
@@ -224,6 +237,8 @@ def make_block_step(nfa: "DeviceNFACompiler"):
                 [jnp.zeros((C,), jnp.int32), creations["vb"]])
             cand_first = jnp.concatenate(
                 [tbl["first_ts"], creations["first_ts"]])
+            cand_last = jnp.concatenate(
+                [tbl["last_ts"], creations["last_ts"]]) if has_ew else None
             cand_bind = {}
             for key in binding_keys(s):
                 dt = key_dtype(key)
@@ -244,6 +259,10 @@ def make_block_step(nfa: "DeviceNFACompiler"):
                 grid = grid & jnp.broadcast_to(pred, (B, P))
             if within is not None:
                 grid = grid & ((ts[:, None] - cand_first[None, :]) <= within)
+            if st.within_ms is not None:
+                # element-level: the gap since the PREVIOUS element's bind
+                grid = grid & ((ts[:, None] - cand_last[None, :])
+                               <= st.within_ms)
             if is_seq:
                 grid = grid & (vidx[:, None] == cand_vb[None, :] + 1)
             else:
@@ -271,20 +290,28 @@ def make_block_step(nfa: "DeviceNFACompiler"):
                     if key in cand_bind:
                         nbind[key] = cand_bind[key]
                 nbind.update(new_binding_cols(s, cols, idx=jstar))
-                creations, dropped = compact({
+                cre_n = {
                     "exists": adv,
                     "born": jstar,
                     "vb": vidx[jstar],
                     "first_ts": jnp.where(cand_first >= 0, cand_first,
                                           ts[jstar]),
                     "bind": nbind,
-                })
+                }
+                if has_ew:
+                    cre_n["last_ts"] = ts[jstar]
+                creations, dropped = compact(cre_n)
                 drops = drops + dropped
 
             # ---- survivors → new table s (truncate to C, drop-newest) ----
             surv = cand_exists & ~adv
             if within is not None:
                 surv = surv & ((ts_last - cand_first) <= within)
+            if st.within_ms is not None:
+                # an element-window that lapsed against the newest event can
+                # never match again (monotonic time) — prune, or dead
+                # partials wedge the keep-oldest slots (review finding)
+                surv = surv & ((ts_last - cand_last) <= st.within_ms)
             if is_seq:
                 # strict continuity: survive only if no valid event followed
                 surv = surv & (cand_vb == n_valid)
@@ -302,6 +329,8 @@ def make_block_step(nfa: "DeviceNFACompiler"):
                     surv, mode="drop"),
                 "first_ts": pack(cand_first, jnp.int64(-1)),
             }
+            if has_ew:
+                ntbl["last_ts"] = pack(cand_last, jnp.int64(-1))
             for key in binding_keys(s):
                 ntbl[key] = pack(cand_bind[key],
                                  jnp.zeros((), key_dtype(key)))

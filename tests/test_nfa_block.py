@@ -208,3 +208,58 @@ def test_blocked_snapshot_roundtrip():
     rt.flush()
     rt2.flush()
     assert_rows_match(out1, out2)
+
+
+def test_element_within_on_device():
+    """Element-level `within` (gap between consecutive elements) runs on the
+    blocked kernel; the scan kernel still rejects it."""
+    app = """
+    define stream S (v double);
+    from every e1=S[v > 10.0] -> e2=S[v > e1.v] within 1 sec
+      -> e3=S[v > e2.v]
+    select e1.v as a, e2.v as b, e3.v as c insert into O;
+    """
+    # e2 must arrive within 1s of e1's bind; e3 is unconstrained
+    events = [("S", [11.0], 1000), ("S", [12.0], 1500),   # gap 500: ok
+              ("S", [20.0], 9000),                         # e3 for chain 1;
+                                                           # also seeds
+              ("S", [30.0], 11000),                        # >1s after 20.0:
+                                                           # can't be ITS e2
+              ("S", [31.0], 11200)]                        # e2 for 30-seed
+    host = oracle(app, events)
+    rt = DeviceNFARuntime(app, slot_capacity=16, batch_capacity=4)
+    assert rt.compiler.blocked
+    rows = []
+    rt.add_callback(rows.extend)
+    for sid, row, ts in events:
+        rt.send(sid, row, ts)
+    rt.flush()
+    assert_rows_match(host, rows)
+    assert [11.0, 12.0, 20.0] in [list(r) for r in rows]
+    # the 20-seed's e2 window expired before 30.0 arrived
+    assert not any(r[:2] == [20.0, 30.0] for r in rows)
+
+    # dead partials whose element window lapsed must be pruned, not wedge
+    # the keep-oldest slots (review finding): C=4, 8 seeds expire unmatched,
+    # then a fresh seed must still match
+    rt2 = DeviceNFARuntime(app, slot_capacity=4, batch_capacity=4)
+    rows2 = []
+    rt2.add_callback(rows2.extend)
+    for i in range(8):
+        rt2.send("S", [100.0 + i], 20000 + i * 3000)   # each window lapses
+    rt2.send("S", [200.0], 60000)
+    rt2.send("S", [201.0], 60100)     # within 1s: e2
+    rt2.send("S", [202.0], 60200)     # e3 → match
+    rt2.flush()
+    assert [200.0, 201.0, 202.0] in [list(r) for r in rows2]
+
+    # non-chain shape (logical state) with element within still falls back
+    import pytest as _pytest
+    from siddhi_tpu.tpu.expr_compile import DeviceCompileError as _DCE
+    with _pytest.raises(_DCE):
+        DeviceNFARuntime("""
+        define stream A (v double);
+        define stream B (v double);
+        from (e1=A[v>1.0] and e2=B[v>1.0]) within 1 sec -> e3=A[v>2.0]
+        select e3.v as c insert into O;
+        """)
