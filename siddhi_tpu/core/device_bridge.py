@@ -22,12 +22,13 @@ from typing import Optional
 from ..query_api import (
     InsertIntoStream,
     JoinInputStream,
+    OutputEventsFor,
     Query,
     SingleInputStream,
     StateInputStream,
 )
 from ..query_api.annotation import find_annotation
-from .event import EventType, StreamEvent
+from .event import Event, EventType, StreamEvent
 
 log = logging.getLogger("siddhi_tpu.device")
 
@@ -205,12 +206,33 @@ class _DeviceRTBase:
         self.deliver(self.process(b), b.get("last_ts"))
 
 
+class _LimiterSink:
+    """Terminal processor behind the bridge's host-side rate limiter."""
+
+    def __init__(self, bridge: "DeviceQueryBridge"):
+        self.bridge = bridge
+
+    def process(self, events: list[StreamEvent]) -> None:
+        self.bridge._emit(events)
+
+
 class DeviceQueryBridge:
     """Junction subscriber feeding a compiled device query; outputs re-enter the
-    engine through the query's output junction."""
+    engine through the query's output junction.
+
+    Output rate limiting (``output [all|first|last] every ...`` /
+    ``output snapshot``) runs host-side on the decoded device rows — the
+    limiters are sequential post-selector processors in the reference
+    (``query/output/ratelimit/OutputRateLimiter.java:43``) and their
+    semantics don't depend on chunking, so the same host classes apply
+    verbatim after device decode. Device-emitted events carry the batch
+    timestamp, so time-driven limiters key off that (documented divergence
+    from per-event host timestamps, consistent with the device path's
+    output stamping)."""
 
     def __init__(self, kind: str, runtime, app_context, stream_ids: list[str],
-                 output_junction, query_name: str, async_mode: bool = False):
+                 output_junction, query_name: str, async_mode: bool = False,
+                 output_rate=None):
         self.kind = kind                  # 'stream' | 'nfa' | 'join'
         self.runtime = runtime            # DeviceStreamRuntime | DeviceNFARuntime
         self.app_context = app_context
@@ -221,6 +243,11 @@ class DeviceQueryBridge:
         self._on_rows_accepts_ts = True     # deliver() passes the batch ts
         runtime.add_callback(self._on_rows)
         self._out_ts = 0
+        self.rate_limiter = None
+        if output_rate is not None:
+            from .ratelimit import build_rate_limiter
+            self.rate_limiter = build_rate_limiter(output_rate, app_context)
+            self.rate_limiter.next = _LimiterSink(self)
         self.driver = None
         if async_mode:
             self.driver = AsyncDeviceDriver(runtime, app_context)
@@ -257,16 +284,60 @@ class DeviceQueryBridge:
         # async delivery passes the source batch's last event time; the
         # producer-side _out_ts may already have advanced past it
         ts = self._out_ts if emit_ts is None else emit_ts
+        events = [StreamEvent(ts, row, EventType.CURRENT) for row in rows]
+        if self.rate_limiter is not None:
+            self.rate_limiter.process(events)   # → _LimiterSink → _emit
+        else:
+            self._emit(events)
+
+    def _emit(self, events: list[StreamEvent]) -> None:
+        if not events:
+            return
         if self.query_callbacks:
-            from .event import Event
-            evs = [Event(ts, row) for row in rows]
+            ts = events[-1].timestamp
+            evs = [Event(e.timestamp, e.data) for e in events]
             for cb in self.query_callbacks:
                 cb.receive(ts, evs, None)
         if self.output_junction is None:
             return
-        for row in rows:
-            self.output_junction.send_event(
-                StreamEvent(ts, row, EventType.CURRENT))
+        for e in events:
+            self.output_junction.send_event(e)
+
+
+def _input_single_streams(ist) -> list[SingleInputStream]:
+    """Every SingleInputStream reachable from a query input (join sides,
+    pattern/sequence stream elements) — for whole-surface audits."""
+    out: list[SingleInputStream] = []
+    if isinstance(ist, SingleInputStream):
+        out.append(ist)
+    elif isinstance(ist, JoinInputStream):
+        out.extend([ist.left, ist.right])
+    elif isinstance(ist, StateInputStream):
+        from ..query_api.execution import (
+            AbsentStreamStateElement,
+            CountStateElement,
+            EveryStateElement,
+            LogicalStateElement,
+            NextStateElement,
+            StreamStateElement,
+        )
+
+        def walk(el) -> None:
+            if isinstance(el, (StreamStateElement, AbsentStreamStateElement)):
+                out.append(el.stream)
+            elif isinstance(el, NextStateElement):
+                walk(el.first)
+                walk(el.next)
+            elif isinstance(el, EveryStateElement):
+                walk(el.inner)
+            elif isinstance(el, LogicalStateElement):
+                walk(el.first)
+                walk(el.second)
+            elif isinstance(el, CountStateElement):
+                walk(el.stream)
+
+        walk(ist.state)
+    return out
 
 
 def try_build_device_query(query: Query, app_context, stream_defs: dict,
@@ -310,9 +381,45 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
 
     target = None
     try:
+        # ---- full Query-surface audit: anything the device compilers do not
+        # model must raise DeviceCompileError (→ host fallback) here, never
+        # silently drop semantics (reference surface: Query.java — selector
+        # order-by/limit/offset QuerySelector.java:44, output_rate
+        # OutputRateLimiter.java:43, fault/inner streams, events_for).
+        sel = query.selector
+        if sel is not None and (sel.order_by or sel.limit is not None
+                                or sel.offset is not None):
+            raise DeviceCompileError(
+                "order by / limit / offset take the host path (device "
+                "micro-batch chunking would change their per-chunk "
+                "semantics)")
+        if query.output_rate is not None:
+            from ..query_api import EventOutputRate
+            if not isinstance(query.output_rate, EventOutputRate):
+                # time/snapshot limiters key off per-event output timestamps,
+                # which device batching coarsens to the batch timestamp —
+                # host fallback preserves exact semantics
+                raise DeviceCompileError(
+                    "time/snapshot output rate limiting takes the host path")
+            if isinstance(query.input_stream, JoinInputStream):
+                # host join selectors can feed EXPIRED events into the
+                # limiter; the device join emits CURRENT rows only
+                raise DeviceCompileError(
+                    "output rate limiting on joins takes the host path")
         if not isinstance(query.output_stream, InsertIntoStream):
             raise DeviceCompileError(
                 "device path handles insert-into-stream outputs only")
+        if query.output_stream.events_for != OutputEventsFor.CURRENT_EVENTS:
+            raise DeviceCompileError(
+                "insert into ... for expired/all events takes the host path "
+                "(device kernels emit CURRENT rows only)")
+        if query.output_stream.is_fault_stream:
+            raise DeviceCompileError("fault-stream outputs take the host path")
+        for s in _input_single_streams(query.input_stream):
+            if s.is_fault_stream or s.is_inner_stream:
+                raise DeviceCompileError(
+                    "fault / partition-inner input streams take the host "
+                    "path")
         tid = query.output_stream.target_id
         if tid in app_context.tables or tid in app_context.named_windows:
             raise DeviceCompileError(
@@ -328,6 +435,14 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                 raise DeviceCompileError(f"undefined stream '{ist.stream_id}'")
             compiled = CompiledStreamQuery(query, d, batch_capacity=batch,
                                            window_capacity=window_cap)
+            if query.output_rate is not None and \
+                    compiled.window_kind is not None:
+                # host rate limiters count the window's EXPIRED events too
+                # (selector → limiter → events_for filter); device kernels
+                # emit CURRENT rows only, so the counts would diverge
+                raise DeviceCompileError(
+                    "output rate limiting on windowed queries takes the "
+                    "host path")
 
             class _StreamRT(_DeviceRTBase):
                 def __init__(self):
@@ -379,7 +494,8 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
             rt = _StreamRT()
             bridge = DeviceQueryBridge("stream", rt, app_context,
                                        [ist.stream_id], target, name,
-                                       async_mode=async_mode)
+                                       async_mode=async_mode,
+                                       output_rate=query.output_rate)
             bridge.output_schema = ([s.name for s in compiled.specs],
                                     [s.dtype for s in compiled.specs])
         elif isinstance(ist, StateInputStream):
@@ -400,7 +516,8 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
             rt = _NFART()
             bridge = DeviceQueryBridge("nfa", rt, app_context,
                                        compiler.compiled.stream_ids, target,
-                                       name, async_mode=async_mode)
+                                       name, async_mode=async_mode,
+                                       output_rate=query.output_rate)
             bridge.output_schema = ([n for n, _, _ in compiler.out_specs],
                                     [t for _, _, t in compiler.out_specs])
         elif isinstance(ist, JoinInputStream):
@@ -452,7 +569,7 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
             bridge = DeviceQueryBridge(
                 "join", rt, app_context,
                 [compiled.left_id, compiled.right_id], target, name,
-                async_mode=async_mode)
+                async_mode=async_mode, output_rate=query.output_rate)
             bridge.output_schema = ([n for (n, _, t, _) in compiled.out_specs],
                                     [t for (n, _, t, _) in compiled.out_specs])
         else:
@@ -476,23 +593,35 @@ class _BridgeState:
         self.bridge = bridge
 
     def snapshot_state(self):
+        limiter = self.bridge.rate_limiter
         if self.bridge.driver is None:
             self.bridge.flush()
-            return self.bridge.runtime.snapshot_state()
+            st = self.bridge.runtime.snapshot_state()
+            if limiter is None:
+                return st
+            return {"rt": st, "limiter": limiter.snapshot_state()}
         # async mode: SiddhiAppRuntime._pre_snapshot already flushed + paused
         # the driver (flushing here would deadlock — we hold root_lock and
         # the worker's delivery phase needs it). Events that raced in between
         # the pre-drain and this lock acquisition sit in the builder / driver
         # queue — checkpoint them as staged batches so the cut is consistent
         # with the host-side state walked under the same lock.
-        return {
+        st = {
             "rt": self.bridge.runtime.snapshot_state(),
             "staged": self.bridge.driver.snapshot_staged(),
             "builder": self.bridge.runtime.builder.snapshot(),
         }
+        if limiter is not None:
+            st["limiter"] = limiter.snapshot_state()
+        return st
 
     def restore_state(self, state):
         if isinstance(state, dict) and "rt" in state:
+            if self.bridge.rate_limiter is not None and "limiter" in state:
+                self.bridge.rate_limiter.restore_state(state["limiter"])
+            if "staged" not in state:       # sync-mode shape with a limiter
+                self.bridge.runtime.restore_state(state["rt"])
+                return
             # async-mode snapshot shape — also restorable into a runtime
             # whose async opt-in was removed: staged batches are stepped
             # synchronously instead of re-queued

@@ -179,6 +179,13 @@ class PatternCompiler:
         for h in stream.handlers:
             if isinstance(h, _F):
                 filter_ast = h.expr if filter_ast is None else _And(filter_ast, h.expr)
+            else:
+                # loud, not silent: windows / stream functions on pattern
+                # stream elements aren't modelled by this NFA (reference
+                # allows them via SingleInputStreamParser.java:83)
+                raise ValueError(
+                    f"pattern stream '{sid}': handler {type(h).__name__} "
+                    f"is not supported inside pattern/sequence elements")
         b = Branch(stream_id=sid, alias=alias)
         self._filters.append((b, filter_ast))
         return b

@@ -274,10 +274,18 @@ class _NFAResolver:
             elif var.stream_index in (None, _LAST):
                 variant = f"b{q}_last_{var.attribute}"
             else:
-                # e2[1], e2[2], ... would silently alias to `last` — the
-                # fixed-width match tables keep only first/last bindings
-                raise DeviceCompileError(
-                    "count e[k] indexing beyond first/last needs host path")
+                # e2[k]: the slot table carries one bound column per
+                # statically-referenced occurrence index (+ a set flag for
+                # NULL when the count never reached k+1) — the reference
+                # keeps the whole occurrence list per partial
+                # (StreamPreStateProcessor pending StateEvents)
+                k = var.stream_index
+                if not isinstance(k, int) or k < 0 or k > _MAX_OCC_INDEX:
+                    raise DeviceCompileError(
+                        f"count e[k] index {k!r} out of device range "
+                        f"(0..{_MAX_OCC_INDEX})")
+                variant = f"b{q}_occ{k}_{var.attribute}"
+                nfa.referenced.add((q, f"b{q}_occ{k}__set", DataType.BOOL))
         elif nfa.states[q].kind == "logical":
             variant = f"b{q}x{bi}_{var.attribute}"
         else:
@@ -464,6 +472,10 @@ class DeviceNFACompiler:
             for h in stream.handlers:
                 if isinstance(h, Filter):
                     ast = h.expr if ast is None else And(ast, h.expr)
+                else:           # windows / stream functions inside a pattern
+                    raise DeviceCompileError(
+                        f"pattern stream handler "
+                        f"{type(h).__name__} needs the host path")
             return ast
 
         walk(ist.state)

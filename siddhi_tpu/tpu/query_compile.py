@@ -904,7 +904,11 @@ def _length_batch(state, specs, value_idx, fagg_idx, iagg_idx, magg_idx,
 
     rem_n = total - full_batches * N
     def rem_slice(row):
-        return jax.lax.dynamic_slice(row, (full_batches * N,), (N,))
+        # start can exceed M-N (e.g. batch capacity < N): pad so the slice
+        # never clamps back into emitted slots — padded values land past
+        # rem_n and are masked by `keep` below
+        padded = jnp.concatenate([row, jnp.zeros((N,), row.dtype)])
+        return jax.lax.dynamic_slice(padded, (full_batches * N,), (N,))
     keep = jnp.arange(N) < rem_n
     new_state = {**state, "rem_count": rem_n.astype(jnp.int32)}
     new_state["tail_fvals"] = jnp.where(
