@@ -313,30 +313,7 @@ def _input_single_streams(ist) -> list[SingleInputStream]:
     elif isinstance(ist, JoinInputStream):
         out.extend([ist.left, ist.right])
     elif isinstance(ist, StateInputStream):
-        from ..query_api.execution import (
-            AbsentStreamStateElement,
-            CountStateElement,
-            EveryStateElement,
-            LogicalStateElement,
-            NextStateElement,
-            StreamStateElement,
-        )
-
-        def walk(el) -> None:
-            if isinstance(el, (StreamStateElement, AbsentStreamStateElement)):
-                out.append(el.stream)
-            elif isinstance(el, NextStateElement):
-                walk(el.first)
-                walk(el.next)
-            elif isinstance(el, EveryStateElement):
-                walk(el.inner)
-            elif isinstance(el, LogicalStateElement):
-                walk(el.first)
-                walk(el.second)
-            elif isinstance(el, CountStateElement):
-                walk(el.stream)
-
-        walk(ist.state)
+        out.extend(ist.single_streams())
     return out
 
 
@@ -509,7 +486,11 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                     self.builder = MergedBatchBuilder(
                         compiler.merged, batch, stream_defs,
                         used_cols=compiler.used_cols)
-                    self.state = compiler.init_state()
+                    # absent-start seeds arm their clock at the app's start
+                    # time (host: seed placed at start() on the playback
+                    # clock)
+                    self.state = compiler.init_state(
+                        app_context.current_time())
                     self.callback = None
                     self.driver = None
 

@@ -165,14 +165,15 @@ class StateInputStream:
     state: StateElement
     within: Optional[Expression] = None
 
-    def stream_ids(self) -> list[str]:
-        out: list[str] = []
+    def single_streams(self) -> "list[SingleInputStream]":
+        """Every SingleInputStream under the state tree, in walk order —
+        THE walk for whole-surface audits (keep element-kind dispatch here
+        so new StateElement kinds extend one place)."""
+        out: list[SingleInputStream] = []
 
         def walk(el: StateElement) -> None:
-            if isinstance(el, StreamStateElement):
-                out.append(el.stream.stream_id)
-            elif isinstance(el, AbsentStreamStateElement):
-                out.append(el.stream.stream_id)
+            if isinstance(el, (StreamStateElement, AbsentStreamStateElement)):
+                out.append(el.stream)
             elif isinstance(el, NextStateElement):
                 walk(el.first)
                 walk(el.next)
@@ -185,12 +186,15 @@ class StateInputStream:
                 walk(el.stream)
 
         walk(self.state)
+        return out
+
+    def stream_ids(self) -> list[str]:
         seen: set[str] = set()
         uniq = []
-        for s in out:
-            if s not in seen:
-                seen.add(s)
-                uniq.append(s)
+        for s in self.single_streams():
+            if s.stream_id not in seen:
+                seen.add(s.stream_id)
+                uniq.append(s.stream_id)
         return uniq
 
 

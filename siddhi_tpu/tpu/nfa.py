@@ -53,6 +53,24 @@ from .batch import StringDictionary
 from .dtypes import JNP as _JNP, NP as _NP
 from .expr_compile import DeviceCompileError, compile_expression
 
+# Highest statically-referenced occurrence index `e[k]` a count state carries
+# on device. Each referenced k costs one bound column + set flag per slot; the
+# reference keeps the whole occurrence list per partial
+# (StreamPreStateProcessor pending StateEvents), so any k is legal there —
+# larger indexes fall back to the host path.
+_MAX_OCC_INDEX = 15
+
+
+def _occ_flag(q: int, k: int) -> str:
+    """Bound flag for occurrence k of count state q ("flag" appended to the
+    digits with no '#', so it can't collide with a value key's '#attr')."""
+    return f"b{q}#occ{k}flag"
+
+
+def _has_flag(q: int) -> str:
+    """"at least one occurrence" flag for a zero-min count state."""
+    return f"b{q}#has"
+
 
 # ---------------------------------------------------------------------------
 # merged multi-stream batches
@@ -207,6 +225,7 @@ class _DevState:
     max_count: int = 1
     ends_every: bool = False     # reseed scope [0..index]
     within_ms: Optional[int] = None        # element-level within
+    reseed_to: Optional[int] = None        # every-scope start this state ends
 
     # single-branch conveniences (stream/count states)
     @property
@@ -268,11 +287,14 @@ class _NFAResolver:
             raise DeviceCompileError(f"unknown attribute '{var.attribute}'")
         t = d.attribute_type(var.attribute)
         if nfa.states[q].kind == "count":
+            # count variants use '#' separators — '#' cannot occur in an
+            # attribute identifier, so names like "occupancy" or "last_x"
+            # can never collide with the variant markers
             from ..query_api.expression import LAST_INDEX as _LAST
             if var.stream_index == 0:
-                variant = f"b{q}_first_{var.attribute}"
+                variant = f"b{q}#first#{var.attribute}"
             elif var.stream_index in (None, _LAST):
-                variant = f"b{q}_last_{var.attribute}"
+                variant = f"b{q}#last#{var.attribute}"
             else:
                 # e2[k]: the slot table carries one bound column per
                 # statically-referenced occurrence index (+ a set flag for
@@ -284,8 +306,8 @@ class _NFAResolver:
                     raise DeviceCompileError(
                         f"count e[k] index {k!r} out of device range "
                         f"(0..{_MAX_OCC_INDEX})")
-                variant = f"b{q}_occ{k}_{var.attribute}"
-                nfa.referenced.add((q, f"b{q}_occ{k}__set", DataType.BOOL))
+                variant = f"b{q}#occ{k}#{var.attribute}"
+                nfa.referenced.add((q, _occ_flag(q, k), DataType.BOOL))
         elif nfa.states[q].kind == "logical":
             variant = f"b{q}x{bi}_{var.attribute}"
         else:
@@ -313,19 +335,49 @@ class _NFAResolver:
         return dic.encode(value)
 
     def _bound_to_merged(self, key: str) -> str:
-        # b{q}[x{bi}][_first|_last]_{attr}
+        # b{q}x{bi}_{attr} | b{q}_{attr} | b{q}#first|last|occ{k}#{attr}
         body = key[1:]
+        if "#" in body:                             # count variant
+            q_str, rest = body.split("#", 1)
+            if rest.startswith("first#"):
+                rest = rest[len("first#"):]
+            elif rest.startswith("last#"):
+                rest = rest[len("last#"):]
+            elif rest.startswith("occ"):            # occ{k}#{attr}
+                rest = rest.split("#", 1)[1]
+            alias = self.nfa.states[int(q_str)].alias
+            sid = self.nfa.compiled.alias_defs[alias].id
+            return self.nfa.merged.col_key(sid, rest)
         q_str, rest = body.split("_", 1)
         if "x" in q_str:
             q_part, bi_part = q_str.split("x")
             alias = self.nfa.states[int(q_part)].branches[int(bi_part)].alias
         else:
-            for pref in ("first_", "last_"):
-                if rest.startswith(pref):
-                    rest = rest[len(pref):]
             alias = self.nfa.states[int(q_str)].alias
         sid = self.nfa.compiled.alias_defs[alias].id
         return self.nfa.merged.col_key(sid, rest)
+
+
+def _null_strict(e) -> bool:
+    """True if a NULL input anywhere makes the whole expression falsy —
+    i.e. the expression is built only of comparisons/math/AND over
+    variables and constants (host executors propagate null through math
+    and evaluate null comparisons/conjunctions to false)."""
+    from ..query_api.expression import (
+        And,
+        Compare,
+        Constant,
+        MathExpr,
+        Minus,
+        Variable,
+    )
+    if isinstance(e, (Variable, Constant)):
+        return True
+    if isinstance(e, (Compare, And, MathExpr)):
+        return _null_strict(e.left) and _null_strict(e.right)
+    if isinstance(e, Minus):
+        return _null_strict(e.expr)
+    return False
 
 
 class DeviceNFACompiler:
@@ -353,29 +405,46 @@ class DeviceNFACompiler:
             if node.kind not in ("stream", "count", "logical", "absent"):
                 raise DeviceCompileError(
                     f"'{node.kind}' states need the host path")
-            if node.reseed_to not in (None, 0):
-                raise DeviceCompileError("`every` scope must start the pattern")
-            if node.kind == "logical" and node.waiting_time_ms is not None:
+            if node.reseed_to not in (None, 0) and node.kind != "stream":
+                # mid-pattern scope-end reseeds are implemented only at the
+                # stream-state advance site
                 raise DeviceCompileError(
-                    "`and not X for t` needs the host path")
-            if node.kind == "absent":
-                if node.waiting_time_ms is None:
-                    raise DeviceCompileError(
-                        "absent without `for` needs the host path")
-                if node.index == 0:
-                    raise DeviceCompileError(
-                        "pattern starting with absent needs the host path")
+                    "mid-pattern `every` ending at a non-stream state needs "
+                    "the host path")
+            if node.kind == "logical" and node.waiting_time_ms is not None \
+                    and self.is_sequence:
+                raise DeviceCompileError(
+                    "`and/or not X for t` in sequences needs the host path")
+            if node.kind == "absent" and node.waiting_time_ms is None:
+                raise DeviceCompileError(
+                    "absent without `for` needs the host path")
             if node.kind in ("logical", "absent") and node.index > 0 \
                     and nodes[node.index - 1].kind == "count":
+                # the count-prev eligibility source exists only for
+                # immediate-advance logical shapes (no per-slot wait state
+                # to carry on the shared count partial): `X and not Y`
+                # without `for`, or a pure OR
+                has_absent = any(b.is_absent for b in node.branches)
+                lt = node.logical_type.value if node.logical_type else None
+                immediate = (
+                    node.kind == "logical"
+                    and node.waiting_time_ms is None
+                    and ((lt == "and" and has_absent)
+                         or (lt == "or" and not has_absent)))
+                if not immediate:
+                    raise DeviceCompileError(
+                        "logical/absent after a count state needs the host "
+                        "path")
+            if node.kind == "count" and node.index > 0 \
+                    and nodes[node.index - 1].kind == "count":
+                # only the stream-state advance path pulls eligible partials
+                # out of a count table — back-to-back counts have no advance
+                # edge on device
                 raise DeviceCompileError(
-                    "logical/absent after a count state needs the host path")
-            if node.kind in ("logical", "absent", "count") and self.is_sequence:
+                    "count directly after a count state needs the host path")
+            if node.kind == "absent" and self.is_sequence:
                 raise DeviceCompileError(
-                    "logical/absent/count in sequences needs the host path")
-            if node.kind == "logical" and node.index == 0 and \
-                    any(b.is_absent for b in node.branches):
-                raise DeviceCompileError(
-                    "pattern starting with `X and not Y` needs the host path")
+                    "absent in sequences needs the host path")
             branches = [
                 _DevBranch(stream_idx=self.merged.stream_index[b.stream_id],
                            alias=b.alias, is_absent=b.is_absent)
@@ -389,12 +458,20 @@ class DeviceNFACompiler:
                 min_count=node.min_count, max_count=node.max_count,
                 ends_every=node.reseed_to == 0,
                 within_ms=node.within_ms,
+                reseed_to=node.reseed_to,
             )
             self.states.append(st)
             for bi, b in enumerate(node.branches):
                 self.alias_branch[b.alias] = (node.index, bi)
-        if self.states[-1].kind == "count":
-            raise DeviceCompileError("final count state needs the host path")
+        final = self.states[-1]
+        if final.kind == "count" and len(self.states) >= 2 \
+                and self.states[-2].kind in ("logical", "absent") \
+                and final.min_count == 0:
+            # zero-min final counts emit at ARRIVAL; only the stream-advance
+            # and seed paths implement that emit
+            raise DeviceCompileError(
+                "logical/absent into a zero-min final count needs the host "
+                "path")
 
         self.S = len(self.states)
         self.always_seed = self.states[0].ends_every and self.S == 1 or \
@@ -402,6 +479,35 @@ class DeviceNFACompiler:
         # group-every: scope end j > 0 → seeds replenished on state j advance
         self.every_end = next(
             (s.index for s in self.states if s.ends_every), None)
+        if self.is_sequence and self.every_end not in (None, 0):
+            # strict kills inside a group `every (...)` scope must return the
+            # scope seed (host _reseed_on_expiry); the kernel's seed counter
+            # only models state-0 scopes
+            raise DeviceCompileError(
+                "group `every` scopes in sequences need the host path")
+        # mid-pattern `every` scopes [r..k], r > 0: the scope-end advance
+        # re-places a clone at p{r} (scope bindings cleared) that becomes
+        # visible on the NEXT event (host `_created` skip)
+        self.reseed_targets = sorted({st.reseed_to for st in self.states
+                                      if st.reseed_to not in (None, 0)})
+        for r in self.reseed_targets:
+            if self.states[r].kind != "stream":
+                raise DeviceCompileError(
+                    "mid-pattern `every` starting at a non-stream state "
+                    "needs the host path")
+        if self.is_sequence and self.reseed_targets:
+            raise DeviceCompileError(
+                "mid-pattern `every` in sequences needs the host path")
+        s0 = self.states[0]
+        # absent-start / `X and-or not Y`-start patterns carry a PRE-PLACED
+        # seed slot (host places one partial at start(); its non-occurrence
+        # clock begins at the runtime start time)
+        self.preseeded = s0.kind == "absent" or (
+            s0.kind == "logical" and any(b.is_absent for b in s0.branches))
+        if self.preseeded and self.every_end not in (None, 0):
+            raise DeviceCompileError(
+                "group `every` over an absent-start scope needs the host "
+                "path")
 
         # compile predicates (after alias map ready) from the original ASTs
         self.used_ev_cols: set[str] = set()
@@ -415,7 +521,9 @@ class DeviceNFACompiler:
         resolver = _NFAResolver(self, None)
         self.used_cols = set(self.used_ev_cols)
         for (q, key, t) in self.referenced:
-            if key.endswith("__set") or key.endswith("__has"):
+            if key.endswith("__set") or key == _has_flag(q) or \
+                    (key.startswith(f"b{q}#occ") and key.endswith("flag")
+                     and "#" not in key[len(f"b{q}#occ"):]):
                 continue               # synthetic null-tracking flags
             self.used_cols.add(resolver._bound_to_merged(key))
         # kernel selection: stream-state chains with `every` take the blocked
@@ -488,7 +596,54 @@ class DeviceNFACompiler:
                 else:
                     resolver = _NFAResolver(self, s.index, b.alias)
                     fn, _ = compile_expression(ast, resolver)
-                    b.predicate = fn
+                    b.predicate = self._guard_predicate(ast, fn,
+                                                        resolver.touched)
+
+    def _guard_predicate(self, ast, fn, touched):
+        """Null-guard a predicate whose refs may be unbound at eval time.
+
+        The host evaluates comparisons over NULL to false (executor null
+        propagation); the device carries ZEROS in unbound slot fields, so a
+        null-strict predicate is ANDed with per-slot "bound" flags instead
+        (zero-min count bindings, ``e[k]`` occurrences). Shapes where NULL
+        does not simply poison the result (or/not/isNull/functions) over
+        such refs — and refs whose flags aren't carried (OR/absent sides)
+        — fall back to the host path."""
+        flags: set[tuple[int, str]] = set()
+        for (q, key) in touched:
+            st = self.states[q]
+            if key.startswith(f"b{q}x"):
+                bi = int(key[len(f"b{q}x"):].split("_", 1)[0])
+                if st.logical_type == "or" or st.branches[bi].is_absent:
+                    raise DeviceCompileError(
+                        "predicate referencing an OR/absent side needs the "
+                        "host path")
+            elif key.startswith(f"b{q}#occ"):
+                k = int(key[len(f"b{q}#occ"):].split("#", 1)[0])
+                flags.add((q, _occ_flag(q, k)))
+            elif st.kind == "absent":
+                raise DeviceCompileError(
+                    "predicate referencing an absent alias needs the host "
+                    "path")
+            elif st.kind == "count" and st.min_count == 0:
+                flags.add((q, _has_flag(q)))
+        if not flags:
+            return fn
+        if not _null_strict(ast):
+            raise DeviceCompileError(
+                "non-null-strict predicate over possibly-unbound bindings "
+                "needs the host path")
+        for (q, flag) in flags:
+            self.referenced.add((q, flag, DataType.BOOL))
+        guard_keys = tuple(sorted(flag for (_, flag) in flags))
+
+        def guarded(env, _fn=fn, _keys=guard_keys):
+            r = _fn(env)
+            for fkey in _keys:
+                r = r & env[fkey]
+            return r
+
+        return guarded
 
     def _compile_output(self, query: Query) -> None:
         sel = query.selector
@@ -516,9 +671,13 @@ class DeviceNFACompiler:
                     st = self.states[q]
                     if st.logical_type == "or" or st.branches[bi].is_absent:
                         deps.add((q, f"b{q}x{bi}__set"))
+                elif key.startswith(f"b{q}#occ"):
+                    # e[k] is NULL when the count never reached k+1
+                    k = int(key[len(f"b{q}#occ"):].split("#", 1)[0])
+                    deps.add((q, _occ_flag(q, k)))
                 elif self.states[q].kind == "count" \
                         and self.states[q].min_count == 0:
-                    deps.add((q, f"b{q}__has"))
+                    deps.add((q, _has_flag(q)))
             self.out_specs.append((oa.name, fn, t))
             self.out_null_deps.append(deps)
         for deps in self.out_null_deps:
@@ -526,38 +685,55 @@ class DeviceNFACompiler:
                 self.referenced.add((q, flag, DataType.BOOL))
 
     # ------------------------------------------------------------------ state
-    def init_state(self) -> dict:
+    def init_state(self, start_ts: int = 0) -> dict:
         if self.blocked:
             from .nfa_block import block_init_state
             return block_init_state(self)
         C, S = self.C, self.S
         pend = {}
         for s in range(S):
+            st = self.states[s]
             fields: dict[str, Any] = {
                 "valid": jnp.zeros((C,), jnp.bool_),
                 # -1 = unset: ts 0 is a legal event time (same sentinel rule
                 # as arrive_ts below)
                 "first_ts": jnp.full((C,), -1, jnp.int64),
             }
-            if self.states[s].kind == "count":
+            if st.kind == "count":
                 fields["count"] = jnp.zeros((C,), jnp.int32)
                 fields["closed"] = jnp.zeros((C,), jnp.bool_)
-            if self.states[s].kind == "logical" and \
-                    self.states[s].logical_type == "and":
-                for bi in range(len(self.states[s].branches)):
+            if st.kind == "logical" and st.logical_type == "and":
+                for bi in range(len(st.branches)):
                     fields[f"done{bi}"] = jnp.zeros((C,), jnp.bool_)
-            if self.states[s].kind == "absent":
+            if st.kind == "logical" and st.logical_type == "or":
+                # `X or not Y [for t]`: Y's arrival kills only the absent
+                # ALTERNATIVE, not the partial
+                for bi, br in enumerate(st.branches):
+                    if br.is_absent:
+                        fields[f"absdead{bi}"] = jnp.zeros((C,), jnp.bool_)
+            if st.kind == "absent" or (st.kind == "logical" and
+                                       st.waiting_ms is not None):
                 # -1 = unarmed: ts 0 is a legal event time, so 0 cannot be
                 # the "no arrival yet" sentinel (advisor round-1 finding)
                 fields["arrive_ts"] = jnp.full((C,), -1, jnp.int64)
+            if s in self.reseed_targets:
+                # clones placed by a scope-end advance, invisible until the
+                # next event (host `_created` skip)
+                fields["fresh"] = jnp.zeros((C,), jnp.bool_)
             for (q, key, t) in self.referenced:
-                if q < s or (q == s and self.states[s].kind in
-                             ("count", "logical")):
+                if q < s or (q == s and st.kind in ("count", "logical")):
                     fields[key] = jnp.zeros((C,), _JNP[t])
+            if s == 0 and self.preseeded:
+                # the host places ONE partial at start(); its non-occurrence
+                # clock starts at the runtime start time
+                fields["valid"] = fields["valid"].at[0].set(True)
+                if "arrive_ts" in fields:
+                    fields["arrive_ts"] = fields["arrive_ts"].at[0].set(
+                        start_ts)
             pend[f"p{s}"] = fields
         return {
             "pending": pend,
-            "seeds": jnp.array(1, jnp.int64),
+            "seeds": jnp.array(0 if self.preseeded else 1, jnp.int64),
             "drops": jnp.array(0, jnp.int64),
             "matches": jnp.array(0, jnp.int64),
         }
@@ -577,6 +753,11 @@ class DeviceNFACompiler:
         out_null_deps = self.out_null_deps
         referenced = sorted(self.referenced)
         n_out = len(out_specs)
+
+        def _clocked(stx) -> bool:
+            """State whose slots carry a non-occurrence clock."""
+            return stx.kind == "absent" or (
+                stx.kind == "logical" and stx.waiting_ms is not None)
 
         def bound_keys_for(level: int):
             st = states[level]
@@ -651,6 +832,34 @@ class DeviceNFACompiler:
                     slots["valid"] = slots["valid"] & alive
                     pend[f"p{s}"] = slots
 
+            # zero-min count scope start: maintain a pre-seeded EMPTY partial
+            # (count=0, no first-bind time) whenever a seed is available —
+            # the successor's eligibility path (count >= min == 0) then
+            # advances it with zero occurrences, matching the host's
+            # "immediately eligible at the successor" rule
+            # (core/pattern.py). Extensions bind occurrences in place, so
+            # the ordinary seed path is disabled for this state below.
+            if states[0].kind == "count" and states[0].min_count == 0:
+                # gate on "no OPEN instance": the host reseeds a count scope
+                # only when the active instance closes (maxes out) or
+                # advances — never while one is still absorbing events
+                # (CountPreStateProcessor max-reach reseed)
+                p0 = pend["p0"]
+                has_open = jnp.any(p0["valid"] & ~p0["closed"])
+                want = ev_ok & ~has_open & (
+                    jnp.array(True) if always_seed else seeds > 0)
+                ins_mask = jnp.zeros((C,), jnp.bool_).at[0].set(want)
+                new0, dropped0, replenish_ins = insert(
+                    p0, ins_mask, {},
+                    jnp.full((C,), -1, jnp.int64),
+                    jnp.zeros((C,), jnp.int32))
+                pend["p0"] = new0
+                drops = drops + dropped0.astype(jnp.int64)
+                if not always_seed:
+                    seeds = seeds - want.astype(jnp.int64)
+            else:
+                replenish_ins = None
+
             # seeds available to THIS event: replenishments from scope
             # completions during this event become usable only on the NEXT
             # event (the reference re-seeds via the post-state processor,
@@ -666,6 +875,10 @@ class DeviceNFACompiler:
             out_nulls = [jnp.zeros((2, C), jnp.bool_) if out_null_deps[oi]
                          else None for oi in range(n_out)]
             touched = {s: jnp.zeros((C,), jnp.bool_) for s in range(S)}
+            if replenish_ins is not None:
+                # a partial placed this event is exempt from sequence strict
+                # kill until the NEXT event (host `_created` set)
+                touched[0] = touched[0] | replenish_ins
 
             def emit_rows(out_mask, out_cols, n_match, mask, row, emit_env):
                 """Accumulate matched slots into output row `row`."""
@@ -688,17 +901,53 @@ class DeviceNFACompiler:
                 return out_mask, out_cols, \
                     n_match + jnp.sum(mask.astype(jnp.int64))
 
-            # ---- absent expiry pre-pass: host timers fire BEFORE the event
-            # is delivered, so established non-occurrences advance first (the
-            # arriving event can then match the successor state). Ascending
-            # order lets a partial hop a chain of expired absents in one step.
-            for s in [i for i, stx in enumerate(states) if stx.kind == "absent"]:
+            # ---- expiry pre-pass (absent + logical-`for` states): host
+            # timers fire BEFORE the event is delivered, so established
+            # non-occurrences advance first (the arriving event can then
+            # match the successor state). Ascending order lets a partial hop
+            # a chain of expired absents in one step. An always-seed start
+            # state re-arms instead of dying (host reseeds during the
+            # advance); several establishments inside ONE inter-event gap
+            # collapse to a single advance per event (documented divergence:
+            # the host fires one timer per `for` interval).
+            for s in [i for i, stx in enumerate(states)
+                      if stx.kind == "absent" or
+                      (stx.kind == "logical" and stx.waiting_ms is not None)]:
                 st = states[s]
                 slots = pend[f"p{s}"]
-                adv = slots["valid"] & ev_ok & (slots["arrive_ts"] >= 0) & \
+                estab = slots["valid"] & ev_ok & (slots["arrive_ts"] >= 0) & \
                     (ev_ts >= slots["arrive_ts"] + st.waiting_ms)
+                if st.kind == "absent":
+                    adv = estab
+                elif st.logical_type == "and":
+                    # AND: advance only partials whose present side bound
+                    adv = estab
+                    for bi, br in enumerate(st.branches):
+                        if not br.is_absent:
+                            adv = adv & slots[f"done{bi}"]
+                else:
+                    # OR: established non-occurrence completes the state
+                    # with the present side unbound (NULL) — unless the
+                    # forbidden event spoiled the wait
+                    adv = estab
+                    for bi, br in enumerate(st.branches):
+                        if br.is_absent:
+                            adv = adv & ~slots[f"absdead{bi}"]
                 ns = dict(slots)
-                ns["valid"] = ns["valid"] & ~adv
+                if s == 0 and always_seed:
+                    # re-arm the start seed: clock jumps to the established
+                    # boundary, binding state resets (host places a fresh
+                    # seed during the advance, usable by THIS event)
+                    ns["arrive_ts"] = jnp.where(
+                        adv, slots["arrive_ts"] + st.waiting_ms,
+                        slots["arrive_ts"])
+                    ns["first_ts"] = jnp.where(adv, -1, slots["first_ts"])
+                    for key in list(ns):
+                        if key.startswith(("done", "absdead", "b0")):
+                            ns[key] = jnp.where(
+                                adv, jnp.zeros((C,), ns[key].dtype), ns[key])
+                else:
+                    ns["valid"] = ns["valid"] & ~adv
                 pend[f"p{s}"] = ns
                 touched[s] = touched[s] | adv
                 n_adv = jnp.sum(adv.astype(jnp.int64))
@@ -711,10 +960,10 @@ class DeviceNFACompiler:
                         out_mask, out_cols, n_match, adv, 0, emit_env)
                 else:
                     values = {key: slots[key] for (q, key, t) in referenced
-                              if key in slots and q < s}
-                    if states[s + 1].kind == "absent":
+                              if key in slots and q <= s}
+                    if _clocked(states[s + 1]):
                         # the successor's non-occurrence clock starts at THIS
-                        # absent's established expiry time, not at the event
+                        # state's established expiry time, not at the event
                         # that surfaced it — host chains timers back-to-back
                         values["arrive_ts"] = (
                             slots["arrive_ts"] + st.waiting_ms).astype(jnp.int64)
@@ -754,15 +1003,29 @@ class DeviceNFACompiler:
                         else jnp.broadcast_to(br.predicate(env), (C,))
                     bm.append(slots["valid"] & g & p_)
                 if absent_bis:
-                    # `X and not Y`: Y's arrival kills the partial
-                    kill = jnp.zeros((C,), jnp.bool_)
+                    ymatch = jnp.zeros((C,), jnp.bool_)
                     for bi in absent_bis:
-                        kill = kill | bm[bi]
+                        ymatch = ymatch | bm[bi]
                     ns = dict(slots)
-                    ns["valid"] = ns["valid"] & ~kill
+                    if s == 0 and st.waiting_ms is not None:
+                        # start-state `X and/or not Y for t`: the forbidden
+                        # event RESTARTS the wait (host keeps start states
+                        # live; LogicalAbsentPatternTestCase
+                        # testQueryAbsent8_2/10); bindings are kept
+                        ns["arrive_ts"] = jnp.where(
+                            ymatch, ev_ts, slots["arrive_ts"])
+                    elif st.logical_type == "or":
+                        # `X or not Y [for t]`: Y kills only the absent
+                        # ALTERNATIVE — the present side can still match
+                        # (testQueryAbsent15)
+                        for bi in absent_bis:
+                            ns[f"absdead{bi}"] = ns[f"absdead{bi}"] | bm[bi]
+                    else:
+                        # `X and not Y`: Y's arrival kills the partial
+                        ns["valid"] = ns["valid"] & ~ymatch
                     pend[f"p{s}"] = ns
-                    touched[s] = touched[s] | kill
-                    bm = [m & ~kill for m in bm]
+                    touched[s] = touched[s] | ymatch
+                    bm = [m & ~ymatch for m in bm]
                     slots = pend[f"p{s}"]
 
                 def side_bind(values, bi, mask, into=None):
@@ -781,6 +1044,19 @@ class DeviceNFACompiler:
                             values[key] = jnp.where(
                                 mask, ev["cols"][mk].astype(_JNP[t]), base)
 
+                def rearm0(ns, advance):
+                    """Reseed a pre-placed start slot in place (host places a
+                    fresh seed during the scope-completion advance)."""
+                    if "arrive_ts" in ns:
+                        ns["arrive_ts"] = jnp.where(
+                            advance, ev_ts, ns["arrive_ts"])
+                    ns["first_ts"] = jnp.where(advance, -1, ns["first_ts"])
+                    for key in list(ns):
+                        if key.startswith(("done", "absdead", "b0")):
+                            ns[key] = jnp.where(
+                                advance, jnp.zeros((C,), ns[key].dtype),
+                                ns[key])
+
                 if st.logical_type == "and" and not absent_bis:
                     # both sides must arrive (any order) — and ONE event may
                     # satisfy both (reference LogicalPatternTestCase
@@ -798,6 +1074,28 @@ class DeviceNFACompiler:
                     advance, adv_src = complete, ns
                     values = {key: ns[key] for (q, key, t) in referenced
                               if key in ns and q <= s}
+                elif st.logical_type == "and" and st.waiting_ms is not None:
+                    # `X and not Y for t`: X binds and waits for the
+                    # established non-occurrence (host: the timer decides
+                    # later) — unless already established, then X advances
+                    # immediately
+                    bi0 = pres[0]
+                    m0 = bm[bi0]
+                    estab_now = slots["valid"] & (slots["arrive_ts"] >= 0) & \
+                        (ev_ts >= slots["arrive_ts"] + st.waiting_ms)
+                    advance = m0 & estab_now
+                    ns = dict(slots)
+                    ns[f"done{bi0}"] = ns[f"done{bi0}"] | m0
+                    side_bind(ns, bi0, m0, into=ns)
+                    touched[s] = touched[s] | m0
+                    adv_src = dict(ns)          # post-bind, pre-reset
+                    values = {key: adv_src[key] for (q, key, t) in referenced
+                              if key in adv_src and q <= s}
+                    if s == 0 and always_seed:
+                        rearm0(ns, advance)
+                    else:
+                        ns["valid"] = ns["valid"] & ~advance
+                    pend[f"p{s}"] = ns
                 else:
                     # OR — or `X and not Y` (present match advances)
                     m0 = bm[pres[0]]
@@ -806,7 +1104,10 @@ class DeviceNFACompiler:
                     advance = m0 | m1
                     touched[s] = touched[s] | advance
                     ns = dict(slots)
-                    ns["valid"] = ns["valid"] & ~advance
+                    if s == 0 and always_seed and absent_bis:
+                        rearm0(ns, advance)
+                    else:
+                        ns["valid"] = ns["valid"] & ~advance
                     pend[f"p{s}"] = ns
                     adv_src = slots
                     values = {key: slots[key] for (q, key, t) in referenced
@@ -828,7 +1129,7 @@ class DeviceNFACompiler:
                     out_mask, out_cols, n_match = emit_rows(
                         out_mask, out_cols, n_match, advance, 0, emit_env)
                 else:
-                    if states[s + 1].kind == "absent":
+                    if _clocked(states[s + 1]):
                         values["arrive_ts"] = jnp.broadcast_to(
                             ev_ts, (C,)).astype(jnp.int64)
                     new_tgt, dropped, inserted = insert(
@@ -840,9 +1141,72 @@ class DeviceNFACompiler:
                 if every_end == s:
                     seeds = seeds + n_adv
 
-                # ---- seeding at a logical state 0 (no absent branches here;
-                # rejected at compile time)
-                if s == 0:
+                # ---- eligible candidates from a min-reached PREV count
+                # (host shares the partial into this state's pending via
+                # _make_eligible; immediate-advance shapes only — gated at
+                # compile time)
+                if s > 0 and states[s - 1].kind == "count" and \
+                        st.waiting_ms is None:
+                    prev = pend[f"p{s-1}"]
+                    env_p = env_for(s - 1, ev)
+                    elig = prev["valid"] & (
+                        prev["count"] >= states[s - 1].min_count)
+                    bmp = []
+                    for br in st.branches:
+                        g = ev_ok & (ev_tag == br.stream_idx)
+                        p_ = jnp.ones((C,), jnp.bool_) if br.predicate is None \
+                            else jnp.broadcast_to(br.predicate(env_p), (C,))
+                        bmp.append(elig & g & p_)
+                    if absent_bis:
+                        # `X and not Y`: Y kills the shared partial
+                        killp = jnp.zeros((C,), jnp.bool_)
+                        for bi in absent_bis:
+                            killp = killp | bmp[bi]
+                        np1 = dict(prev)
+                        np1["valid"] = np1["valid"] & ~killp
+                        pend[f"p{s-1}"] = np1
+                        touched[s - 1] = touched[s - 1] | killp
+                        bmp = [m & ~killp for m in bmp]
+                        prev = np1
+                    m0p = bmp[pres[0]]
+                    m1p = (bmp[pres[1]] & ~m0p) if len(pres) > 1 \
+                        else jnp.zeros((C,), jnp.bool_)
+                    advp = m0p | m1p
+                    touched[s - 1] = touched[s - 1] | advp
+                    np2 = dict(pend[f"p{s-1}"])
+                    np2["valid"] = np2["valid"] & ~advp
+                    pend[f"p{s-1}"] = np2
+                    valuesp = {key: prev[key] for (q, key, t) in referenced
+                               if key in prev and q < s}
+                    side_bind(valuesp, pres[0], m0p)
+                    if len(pres) > 1:
+                        side_bind(valuesp, pres[1], m1p)
+                    first_p = jnp.where(prev["first_ts"] >= 0,
+                                        prev["first_ts"], ev_ts)
+                    if s == S - 1:
+                        emit_env = {f"ev_{k}": ev["cols"][k]
+                                    for k in ev["cols"]}
+                        for (q, key, t) in referenced:
+                            if key in valuesp:
+                                emit_env[key] = valuesp[key]
+                            elif key in prev:
+                                emit_env[key] = prev[key]
+                        out_mask, out_cols, n_match = emit_rows(
+                            out_mask, out_cols, n_match, advp, 1, emit_env)
+                    else:
+                        if _clocked(states[s + 1]):
+                            valuesp["arrive_ts"] = jnp.broadcast_to(
+                                ev_ts, (C,)).astype(jnp.int64)
+                        new_tgt, dropped, inserted = insert(
+                            pend[f"p{s+1}"], advp, valuesp, first_p,
+                            jnp.zeros((C,), jnp.int32))
+                        pend[f"p{s+1}"] = new_tgt
+                        touched[s + 1] = touched[s + 1] | inserted
+                        drops = drops + dropped.astype(jnp.int64)
+
+                # ---- seeding at a logical state 0 (absent-bearing logicals
+                # are PRE-seeded at init and re-armed in place instead)
+                if s == 0 and not absent_bis:
                     env0 = {f"ev_{k}": ev["cols"][k] for k in ev["cols"]}
                     # AND seeds linger half-bound, so `every` must NOT seed on
                     # each event (host keeps ONE seed, rebinding sides, until
@@ -897,7 +1261,7 @@ class DeviceNFACompiler:
                             cvals = {key: seed_vals[key]
                                      for key in seed_vals
                                      if not key.startswith("done")}
-                            if states[1].kind == "absent":
+                            if _clocked(states[1]):
                                 cvals["arrive_ts"] = jnp.broadcast_to(
                                     ev_ts, (C,)).astype(jnp.int64)
                             newc, droppedc, insertedc = insert(
@@ -935,7 +1299,7 @@ class DeviceNFACompiler:
                         else:
                             ins_mask = jnp.zeros((C,), jnp.bool_).at[0].set(
                                 can_any)
-                            if states[1].kind == "absent":
+                            if _clocked(states[1]):
                                 seed_vals["arrive_ts"] = jnp.broadcast_to(
                                     ev_ts, (C,)).astype(jnp.int64)
                             new1, dropped, inserted = insert(
@@ -950,11 +1314,21 @@ class DeviceNFACompiler:
 
                 return pend, seeds, drops, n_match, out_mask, out_cols
 
+            # openness of a state-0 count BEFORE this event's extensions,
+            # fires, and advances: a slot this event consumes frees its scope
+            # seed on the NEXT event only (host reseeds post-event)
+            count0_open_pre = None
+            if states[0].kind == "count":
+                p0pre = pend["p0"]
+                count0_open_pre = jnp.any(p0pre["valid"] & ~p0pre["closed"])
+
             for s in range(S - 1, -1, -1):
                 st = states[s]
                 if st.kind == "absent":
                     # expiry ran in the pre-pass; here the forbidden event
-                    # kills still-waiting partials
+                    # kills still-waiting partials — except on a START
+                    # state, where it RESTARTS the wait (host keeps start
+                    # states live; AbsentPatternTestCase.testQueryAbsent6/8)
                     br = st.branches[0]
                     g = ev_ok & (ev_tag == br.stream_idx)
                     env = env_for(s, ev)
@@ -963,7 +1337,11 @@ class DeviceNFACompiler:
                     cur = pend[f"p{s}"]
                     kill = cur["valid"] & g & p_
                     ns = dict(cur)
-                    ns["valid"] = ns["valid"] & ~kill
+                    if s == 0:
+                        ns["arrive_ts"] = jnp.where(
+                            kill, ev_ts, cur["arrive_ts"])
+                    else:
+                        ns["valid"] = ns["valid"] & ~kill
                     pend[f"p{s}"] = ns
                     touched[s] = touched[s] | kill
                     continue
@@ -984,37 +1362,78 @@ class DeviceNFACompiler:
                     first_ext = ext & (slots["count"] == 0)
                     new_slots = dict(slots)
                     new_slots["count"] = slots["count"] + ext.astype(jnp.int32)
+                    # a pre-seeded empty partial (zero-min count scope start)
+                    # has no first-bind time until its first occurrence
+                    new_slots["first_ts"] = jnp.where(
+                        first_ext & (slots["first_ts"] < 0), ev_ts,
+                        slots["first_ts"])
                     # update bound values for extended slots: last on every
                     # extension, first only on the 0→1 transition (slots
                     # inserted with count=0 have no binding yet — reference
                     # e1[0] refs; CountPatternTestCase.testQuery9)
                     for (q, key, t) in referenced:
-                        if q == s and key.startswith(f"b{s}_last_"):
-                            attr = key[len(f"b{s}_last_"):]
+                        if q == s and key.startswith(f"b{s}#last#"):
+                            attr = key[len(f"b{s}#last#"):]
                             mk = self.merged.col_key(
                                 self.compiled.alias_defs[st.alias].id, attr)
                             new_slots[key] = jnp.where(
                                 ext, ev["cols"][mk].astype(slots[key].dtype),
                                 slots[key])
-                        elif q == s and key.startswith(f"b{s}_first_"):
-                            attr = key[len(f"b{s}_first_"):]
+                        elif q == s and key.startswith(f"b{s}#first#"):
+                            attr = key[len(f"b{s}#first#"):]
                             mk = self.merged.col_key(
                                 self.compiled.alias_defs[st.alias].id, attr)
                             new_slots[key] = jnp.where(
                                 first_ext,
                                 ev["cols"][mk].astype(slots[key].dtype),
                                 slots[key])
-                        elif q == s and key == f"b{s}__has":
+                        elif q == s and key.startswith(f"b{s}#occ"):
+                            # e[k]: this extension is occurrence index
+                            # `old count` (0-based, predicate-gated)
+                            rest = key[len(f"b{s}#occ"):]
+                            if rest.endswith("flag") and "#" not in rest:
+                                hit = ext & (slots["count"] == int(rest[:-4]))
+                                new_slots[key] = slots[key] | hit
+                            else:
+                                kstr, attr = rest.split("#", 1)
+                                hit = ext & (slots["count"] == int(kstr))
+                                mk = self.merged.col_key(
+                                    self.compiled.alias_defs[st.alias].id,
+                                    attr)
+                                new_slots[key] = jnp.where(
+                                    hit,
+                                    ev["cols"][mk].astype(slots[key].dtype),
+                                    slots[key])
+                        elif q == s and key == _has_flag(s):
                             new_slots[key] = slots[key] | ext
                     if st.max_count != -1:
                         new_slots["closed"] = new_slots["closed"] | (
                             new_slots["count"] >= st.max_count)
+                    if s == S - 1:
+                        # final count: emit ONCE at min-reach and consume
+                        # (host rule; reference CountPatternTestCase
+                        # .testQuery13 — further extensions don't re-emit)
+                        fire = ext & (new_slots["count"] >= st.min_count)
+                        emit_env = {f"ev_{k}": ev["cols"][k]
+                                    for k in ev["cols"]}
+                        for (q, key, t) in referenced:
+                            if key in new_slots:
+                                emit_env[key] = new_slots[key]
+                        out_mask, out_cols, n_match = emit_rows(
+                            out_mask, out_cols, n_match, fire, 0, emit_env)
+                        new_slots["valid"] = new_slots["valid"] & ~fire
+                        if every_end == s:
+                            seeds = seeds + jnp.sum(fire.astype(jnp.int64))
                     pend[f"p{s}"] = new_slots
                     touched[s] = touched[s] | ext
                 else:
                     # stream state: sources = pending[s] and (if prev is count)
-                    # its eligible slots
-                    sources = [(s, slots["valid"] & pred & gate)]
+                    # its eligible slots; freshly re-placed scope clones are
+                    # invisible this event
+                    cand = slots["valid"] & pred & gate
+                    if "fresh" in slots:
+                        cand = cand & ~slots["fresh"]
+                    sources = [(s, cand)]
                     if s > 0 and states[s - 1].kind == "count":
                         prev = pend[f"p{s-1}"]
                         env_p = env_for(s - 1, ev)
@@ -1041,7 +1460,13 @@ class DeviceNFACompiler:
                                     ev["cols"][mk].astype(_JNP[t]), (C,))
                         first_ts_new = jnp.where(
                             src["first_ts"] >= 0, src["first_ts"], ev_ts)
-                        if s == S - 1:
+                        # a zero-min FINAL count target completes at ARRIVAL:
+                        # the partial is already a match with the count empty
+                        # (host rule; reference SequenceTestCase.testQuery3)
+                        tgt_final_min0 = (
+                            s + 1 == S - 1 and states[S - 1].kind == "count"
+                            and states[S - 1].min_count == 0)
+                        if s == S - 1 or tgt_final_min0:
                             # emit matches
                             emit_env = {f"ev_{k}": ev["cols"][k]
                                         for k in ev["cols"]}
@@ -1050,15 +1475,23 @@ class DeviceNFACompiler:
                                     emit_env[key] = src[key]
                                 elif q == s:
                                     emit_env[key] = values[key]
+                                elif q == S - 1:   # unreached count: NULL
+                                    emit_env[key] = jnp.zeros((C,), _JNP[t])
                             out_mask, out_cols, n_match = emit_rows(
                                 out_mask, out_cols, n_match, matched, src_i,
                                 emit_env)
                             n_adv = jnp.sum(matched.astype(jnp.int64))
+                            if tgt_final_min0 and every_end == S - 1:
+                                # arrival at the zero-min final count also
+                                # completes an `every` scope ending there —
+                                # replenish (the lvl-based site below only
+                                # sees source states)
+                                seeds = seeds + n_adv
                         else:
                             # a count target starts with 0 occurrences (its own
                             # events arrive later via the extension path); an
                             # absent target's non-occurrence clock starts now
-                            if states[s + 1].kind == "absent":
+                            if _clocked(states[s + 1]):
                                 values["arrive_ts"] = jnp.broadcast_to(
                                     ev_ts, (C,)).astype(jnp.int64)
                             new_tgt, dropped, inserted = insert(
@@ -1072,18 +1505,46 @@ class DeviceNFACompiler:
                         src_new = dict(pend[f"p{lvl}"])
                         src_new["valid"] = src_new["valid"] & ~matched
                         pend[f"p{lvl}"] = src_new
+                        # mid-pattern every: the scope-end advance re-places
+                        # a clone at the scope start (pre-scope bindings
+                        # kept, scope bindings cleared, fresh until next
+                        # event — host _do_reseed/_build_seed/_created)
+                        r = states[lvl].reseed_to
+                        if r not in (None, 0):
+                            cvals = {key: src[key]
+                                     for (q, key, t) in referenced
+                                     if key in src and q < r}
+                            cvals["fresh"] = jnp.ones((C,), jnp.bool_)
+                            ts_clone = src["first_ts"] if any(
+                                states[q].kind != "absent"
+                                for q in range(r)) \
+                                else jnp.full((C,), -1, jnp.int64)
+                            newr, droppedr, _insr = insert(
+                                pend[f"p{r}"], matched, cvals, ts_clone,
+                                jnp.zeros((C,), jnp.int32))
+                            pend[f"p{r}"] = newr
+                            drops = drops + droppedr.astype(jnp.int64)
                         # every-scope completion replenishes seeds; the scope
                         # ends either at this stream state (lvl == s) or at the
                         # count state this advance consumed (lvl == s-1)
                         if every_end == lvl:
                             seeds = seeds + n_adv
 
-                # ---- seeding at state 0
-                if s == 0:
+                # ---- seeding at state 0 (zero-min count states are seeded
+                # by the empty-partial replenish pre-pass instead; their
+                # occurrences bind via the extension path)
+                if s == 0 and not (st.kind == "count" and st.min_count == 0):
                     env0 = {f"ev_{k}": ev["cols"][k] for k in ev["cols"]}
                     pred0 = True if st.predicate is None else st.predicate(env0)
                     can_seed = gate & jnp.asarray(pred0) & (
                         jnp.array(True) if always_seed else seeds0 > 0)
+                    if st.kind == "count":
+                        # a count scope re-seeds only when its active
+                        # instance closed or advanced, not per event — and a
+                        # slot this event consumed frees its seed on the
+                        # NEXT event only (host max-reach/advance reseed;
+                        # every+<m:n> parity)
+                        can_seed = can_seed & ~count0_open_pre
                     # seed advances directly into pending[1] (binding ev) or,
                     # for count state 0, into pending[0] with count=1 — count
                     # state 0 extension handled above won't double-fire because
@@ -1092,41 +1553,78 @@ class DeviceNFACompiler:
                     seed_vals = {}
                     for (q, key, t) in referenced:
                         if q == 0:
-                            if key == "b0__has":
+                            if key == _has_flag(0):
                                 # count state 0 seeds with its first
                                 # occurrence already bound
                                 seed_vals[key] = jnp.ones((C,), jnp.bool_)
                                 continue
-                            attr = key[len("b0_"):]
-                            for pref in ("first_", "last_"):
-                                if attr.startswith(pref):
-                                    attr = attr[len(pref):]
+                            if key.startswith("b0#occ"):
+                                # seed binds occurrence 0 only; higher
+                                # indexes arrive via the extension path
+                                rest = key[len("b0#occ"):]
+                                if rest.endswith("flag") and "#" not in rest:
+                                    seed_vals[key] = jnp.full(
+                                        (C,), rest[:-4] == "0", jnp.bool_)
+                                    continue
+                                kstr, attr = rest.split("#", 1)
+                                if kstr != "0":
+                                    seed_vals[key] = jnp.zeros((C,), _JNP[t])
+                                    continue
+                            elif key.startswith(("b0#first#", "b0#last#")):
+                                attr = key.split("#", 2)[2]
+                            else:
+                                attr = key[len("b0_"):]
                             mk = self.merged.col_key(sid, attr)
                             seed_vals[key] = jnp.broadcast_to(
                                 ev["cols"][mk].astype(_JNP[t]), (C,))
                     ins_mask = jnp.zeros((C,), jnp.bool_).at[0].set(can_seed)
                     if st.kind == "count":
-                        new0, dropped, inserted = insert(
-                            pend["p0"], ins_mask, seed_vals,
-                            jnp.broadcast_to(ev_ts, (C,)),
-                            jnp.ones((C,), jnp.int32))
-                        pend["p0"] = new0
-                        touched[0] = touched[0] | inserted
-                        # count 1 may already satisfy min → eligibility handled
-                        # next events; if S == 1 impossible (final must be stream)
-                        drops = drops + dropped.astype(jnp.int64)
-                    else:
-                        if S == 1:
-                            # single-state pattern: immediate match
-                            emit_env = {f"ev_{k}": ev["cols"][k] for k in ev["cols"]}
+                        if S == 1 and st.min_count <= 1:
+                            # single count state with min ≤ 1: the seed's
+                            # first occurrence already reaches min — emit
+                            # once and consume (host min-reach rule)
+                            emit_env = {f"ev_{k}": ev["cols"][k]
+                                        for k in ev["cols"]}
                             for (q, key, t) in referenced:
                                 if q == 0:
-                                    emit_env[key] = seed_vals[key]
+                                    emit_env[key] = seed_vals.get(
+                                        key, jnp.zeros((C,), _JNP[t]))
                             out_mask, out_cols, n_match = emit_rows(
                                 out_mask, out_cols, n_match, ins_mask, 0,
                                 emit_env)
                         else:
-                            if states[1].kind == "absent":
+                            new0, dropped, inserted = insert(
+                                pend["p0"], ins_mask, seed_vals,
+                                jnp.broadcast_to(ev_ts, (C,)),
+                                jnp.ones((C,), jnp.int32))
+                            pend["p0"] = new0
+                            touched[0] = touched[0] | inserted
+                            # count 1 may already satisfy min → eligibility
+                            # handled as later events arrive
+                            drops = drops + dropped.astype(jnp.int64)
+                    else:
+                        seed_final_min0 = (
+                            S == 2 and states[1].kind == "count"
+                            and states[1].min_count == 0)
+                        if S == 1 or seed_final_min0:
+                            # single-state pattern — or a seed arriving at a
+                            # zero-min FINAL count (already complete, count
+                            # empty): immediate match
+                            emit_env = {f"ev_{k}": ev["cols"][k] for k in ev["cols"]}
+                            for (q, key, t) in referenced:
+                                if q == 0:
+                                    emit_env[key] = seed_vals[key]
+                                elif q == 1:        # unreached count: NULL
+                                    emit_env[key] = jnp.zeros((C,), _JNP[t])
+                            out_mask, out_cols, n_match = emit_rows(
+                                out_mask, out_cols, n_match, ins_mask, 0,
+                                emit_env)
+                            if seed_final_min0 and every_end == S - 1:
+                                # the seed's arrival-emit completes the
+                                # `every` scope ending at the final count
+                                seeds = seeds + can_seed.astype(jnp.int64)
+                        else:
+                            if _clocked(states[1]):
                                 seed_vals["arrive_ts"] = jnp.broadcast_to(
                                     ev_ts, (C,)).astype(jnp.int64)
                             new1, dropped, inserted = insert(
@@ -1138,6 +1636,12 @@ class DeviceNFACompiler:
                             drops = drops + dropped.astype(jnp.int64)
                     if not always_seed:
                         seeds = seeds - can_seed.astype(jnp.int64)
+
+            # scope clones become visible from the next event on
+            for r in self.reseed_targets:
+                slots_r = dict(pend[f"p{r}"])
+                slots_r["fresh"] = jnp.zeros((C,), jnp.bool_)
+                pend[f"p{r}"] = slots_r
 
             # sequence strictness: untouched partials die on any event
             if is_seq:
@@ -1239,7 +1743,8 @@ class DeviceNFARuntime:
     """Micro-batching front end over a compiled NFA."""
 
     def __init__(self, app_or_text, slot_capacity: int = 64,
-                 batch_capacity: int = 1024, query_index: int = 0):
+                 batch_capacity: int = 1024, query_index: int = 0,
+                 start_time: int = 0):
         from ..compiler import parse as _parse
         app = _parse(app_or_text) if isinstance(app_or_text, str) else app_or_text
         query = app.queries[query_index]
@@ -1248,7 +1753,10 @@ class DeviceNFARuntime:
         self.builder = MergedBatchBuilder(
             self.compiler.merged, batch_capacity, dict(app.stream_definitions),
             used_cols=self.compiler.used_cols)
-        self.state = self.compiler.init_state()
+        # absent-start patterns arm their non-occurrence clock at the
+        # runtime start time (host: seed placed at start() with the playback
+        # clock's current value)
+        self.state = self.compiler.init_state(start_time)
         self.callback: Optional[Callable[[list[list]], None]] = None
         self.driver = None          # AsyncDeviceDriver when @async device mode
 

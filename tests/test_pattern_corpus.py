@@ -220,6 +220,41 @@ select e1[0].price as price1_0, e1[1].price as price1_1, e2.price as price2
 insert into OutputStream;
 """, [("Stream2", ["IBM", 45.7, 100])],
         [[None, None, 45.7]]),
+    # every + <m:n>: a count scope re-seeds only when its active instance
+    # closes or advances — each extension must NOT start a phantom instance
+    # (found by device-vs-host probing; host reseed lives on the count node)
+    _case("count7b", S2 + """
+from every e1=Stream1[price>20]<2:5> -> e2=Stream2[price>20]
+select e1[0].price as price1_0, e1[1].price as price1_1, e2.price as price2
+insert into OutputStream;
+""", [("Stream1", ["A", 25.0, 100]), ("Stream1", ["B", 30.0, 100]),
+      ("Stream1", ["C", 31.0, 100]), ("Stream2", ["X", 45.7, 100])],
+        [[25.0, 30.0, 45.7]]),
+    _case("count7c", S2 + """
+from every e1=Stream1[price>20]<0:2> -> e2=Stream2[price>20]
+select e1[0].price as price1_0, e1[1].price as price1_1, e2.price as price2
+insert into OutputStream;
+""", [("Stream1", ["A", 25.0, 100]), ("Stream1", ["B", 30.0, 100]),
+      ("Stream2", ["X", 45.0, 100]), ("Stream2", ["Y", 50.0, 100])],
+        [[25.0, 30.0, 45.0], [None, None, 45.0], [None, None, 50.0]]),
+    # group-every ending at a zero-min FINAL count: each arrival-emit must
+    # replenish the scope seed (found by device-vs-host review probing)
+    _case("count7d", S2 + """
+from every (e1=Stream1[price>20] -> e2=Stream2[price>20]<0:2>)
+select e1.price as price1, e2[0].price as price2 insert into OutputStream;
+""", [("Stream1", ["A", 21.0, 100]), ("Stream2", ["B", 30.0, 100]),
+      ("Stream1", ["C", 22.0, 100]), ("Stream2", ["D", 31.0, 100]),
+      ("Stream1", ["E", 23.0, 100])],
+        [[21.0, None], [22.0, None], [23.0, None]]),
+    # `every` over a FINAL count: the instance consumed by an event frees
+    # its seed only on the NEXT event — no phantom overlapping instances
+    # (found by device-vs-host review probing)
+    _case("count7e", S1 + """
+from every e1=Stream1[price>20]<2:3>
+select e1[0].price as p0, e1[1].price as p1 insert into OutputStream;
+""", [("Stream1", ["A", 21.0, 100]), ("Stream1", ["B", 22.0, 100]),
+      ("Stream1", ["C", 23.0, 100]), ("Stream1", ["D", 24.0, 100])],
+        [[21.0, 22.0], [23.0, 24.0]]),
     _case("count8", S2 + """
 from e1=Stream1[price>20]<0:5> -> e2=Stream2[price>e1[0].price]
 select e1[0].price as price1_0, e1[1].price as price1_1, e2.price as price2
@@ -1016,7 +1051,8 @@ def _run_device(app, seq):
     from siddhi_tpu.tpu.expr_compile import DeviceCompileError
     from siddhi_tpu.tpu.nfa import DeviceNFARuntime
     try:
-        rt = DeviceNFARuntime(app, slot_capacity=32, batch_capacity=32)
+        rt = DeviceNFARuntime(app, slot_capacity=32, batch_capacity=32,
+                              start_time=START)
     except DeviceCompileError:
         return None
     rows = []
@@ -1049,6 +1085,29 @@ def _rows_match(got, want, tol=0.0):
             elif a != b:
                 return False
     return True
+
+
+def test_device_compilable_floor():
+    """Pin the device NFA's corpus coverage so regressions FAIL instead of
+    silently falling back to host (VERDICT r3 weak #6). Raise the floor when
+    scope grows; never lower it."""
+    from siddhi_tpu.compiler import parse
+    from siddhi_tpu.tpu.expr_compile import DeviceCompileError
+    from siddhi_tpu.tpu.nfa import DeviceNFACompiler
+
+    ok = total = 0
+    for p in CASES:
+        app, seq, expect, end, no_device = p.values
+        if end:                    # timer-driven cases never take the device path
+            continue
+        total += 1
+        try:
+            a = parse(app)
+            DeviceNFACompiler(a.queries[0], dict(a.stream_definitions), 8, 8)
+            ok += 1
+        except DeviceCompileError:
+            pass
+    assert ok >= 104, f"device NFA corpus coverage regressed: {ok}/{total}"
 
 
 @pytest.mark.parametrize("app,seq,expect,end,no_device", CASES)

@@ -167,18 +167,59 @@ def test_unsupported_patterns_fall_back():
         define stream A (v long); define stream B (v long); define stream C (v long);
         from e1=A -> e2=B and e3=C[v > e2.v] select e1.v as v insert into O;
         """)
-    # pattern starting with absent
-    with pytest.raises(DeviceCompileError):
-        DeviceNFARuntime("""
-        define stream A (v long); define stream B (v long);
-        from not A for 1 sec -> e2=B select e2.v as v insert into O;
-        """)
-    # sequences with logical states
+    # absent states inside sequences (strict continuity × non-occurrence)
     with pytest.raises(DeviceCompileError):
         DeviceNFARuntime("""
         define stream A (v long); define stream B (v long); define stream C (v long);
-        from every e1=A, e2=B and e3=C select e1.v as v insert into O;
+        from every e1=A, not B for 1 sec, e3=C select e1.v as v insert into O;
         """)
+    # non-null-strict predicate over a possibly-unbound binding (e1[2] may
+    # be NULL; `or` is not null-strict, so host null semantics apply)
+    with pytest.raises(DeviceCompileError):
+        DeviceNFARuntime("""
+        define stream A (v long); define stream B (v long);
+        from e1=A<0:5> -> e2=B[v > e1[0].v or v < 0]
+        select e2.v as v insert into O;
+        """)
+    # back-to-back counts: no device advance edge between count tables
+    with pytest.raises(DeviceCompileError):
+        DeviceNFARuntime("""
+        define stream A (v long); define stream B (v long);
+        from e1=A<1:2> -> e2=B<1:3>
+        select e1[0].v as a, e2[0].v as b insert into O;
+        """)
+
+
+def test_count_variant_keys_tolerate_marker_like_attribute_names():
+    """Attributes named 'occupancy'/'last_x' must not collide with the
+    count-variant key markers (keys use '#', illegal in identifiers)."""
+    rt = DeviceNFARuntime("""
+    define stream A (occupancy long);
+    define stream B (v long);
+    from e1=A[occupancy>0]<2:5> -> e2=B[v>e1[1].occupancy]
+    select e1[0].occupancy as o0, e1[1].occupancy as o1, e2.v as v
+    insert into O;
+    """, slot_capacity=8, batch_capacity=8)
+    rows = []
+    rt.add_callback(rows.extend)
+    for i, (sid, row) in enumerate([("A", [3]), ("A", [4]), ("B", [9])]):
+        rt.send(sid, row, 1000 + i * 100)
+    rt.flush()
+    assert rows == [[3, 4, 9]]
+    # attribute ENDING in 'flag' referenced only via e[k]: must not be
+    # misclassified as a synthetic occurrence flag (used_cols skip)
+    rt = DeviceNFARuntime("""
+    define stream A (myflag long);
+    define stream B (v long);
+    from e1=A[myflag>0]<2:5> -> e2=B[v>0]
+    select e1[1].myflag as o1, e2.v as v insert into O;
+    """, slot_capacity=8, batch_capacity=8)
+    rows = []
+    rt.add_callback(rows.extend)
+    for i, (sid, row) in enumerate([("A", [3]), ("A", [4]), ("B", [9])]):
+        rt.send(sid, row, 1000 + i * 100)
+    rt.flush()
+    assert rows == [[4, 9]]
 
 
 # ---------------------------------------------------------------- logical/absent
