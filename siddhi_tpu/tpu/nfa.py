@@ -159,6 +159,35 @@ class MergedBatchBuilder:
         self._ts[i] = ts
         self._n += 1
 
+    def append_many(self, stream_id: str, attr_cols: dict, ts,
+                    start: int = 0) -> int:
+        """Bulk-append pre-encoded column arrays (string columns already
+        dictionary codes — see ``StringDictionary.encode_array``). Copies
+        rows ``[start, start+take)`` where ``take`` fits the remaining
+        capacity; returns ``take`` (caller emits/flushes and resumes). This
+        replaces the per-event ``append`` loop on the hot ingest path
+        (reference analog: ``StreamJunction.java:279-316`` — the Disruptor
+        existed to make ingest cheap)."""
+        import numpy as _np
+        n_rows = len(ts) - start
+        take = min(n_rows, self.capacity - self._n)
+        if take <= 0:
+            return 0
+        i = self._n
+        si = self.schema.stream_index[stream_id]
+        d = self.stream_defs[stream_id]
+        for a in d.attributes:
+            key = f"s{si}_{a.name}"
+            col = self._cols.get(key)
+            src = attr_cols.get(a.name)
+            if col is None or src is None:
+                continue
+            col[i:i + take] = src[start:start + take]
+        self._tag[i:i + take] = si
+        self._ts[i:i + take] = _np.asarray(ts)[start:start + take]
+        self._n += take
+        return take
+
     def emit(self) -> dict:
         n = self._n
         base = int(self._ts[:n].min()) if n else 0
@@ -382,7 +411,8 @@ def _null_strict(e) -> bool:
 
 class DeviceNFACompiler:
     def __init__(self, query: Query, stream_defs: dict[str, StreamDefinition],
-                 slot_capacity: int = 64, batch_capacity: int = 1024):
+                 slot_capacity: int = 64, batch_capacity: int = 1024,
+                 creation_cap: Optional[int] = None):
         ist = query.input_stream
         if not isinstance(ist, StateInputStream):
             raise DeviceCompileError("not a pattern/sequence query")
@@ -531,6 +561,11 @@ class DeviceNFACompiler:
         # count/logical/absent states use the per-event scan
         from .nfa_block import blocked_eligible
         self.blocked = blocked_eligible(self)
+        # blocked-kernel creation budget: compacts per-batch creations to K
+        # entries, capping every stage grid at [B, C+K] instead of the
+        # quadratic [B, C+B] (measured: the quadratic term dominates the
+        # step at B >= 1024; overflow drops are counted, drop-newest)
+        self.creation_cap = creation_cap
         if has_element_within and not self.blocked:
             # the blocked kernel masks per-state gaps on its grids; the scan
             # kernel's tables don't carry last-bind times

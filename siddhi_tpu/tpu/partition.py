@@ -22,6 +22,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..compiler import parse as _parse
+from .expr_compile import DeviceCompileError
 from .nfa import DeviceNFACompiler, MergedBatchBuilder
 
 
@@ -30,6 +31,90 @@ def _hash_key(v) -> int:
     # stable across processes (hash() randomization would break resumed
     # checkpoints whose lane assignment must match)
     return zlib.crc32(str(v).encode()) & 0x7FFFFFFF
+
+
+def _inject_key_equality(query, key_attr: str):
+    """Per-KEY pattern semantics on shared lanes.
+
+    A lane owns every key hashing to it, so the lane-local NFA sees several
+    keys' events interleaved — the reference's ``partition with`` clones
+    state PER KEY (``PartitionStreamReceiver.java:82-117``). Equivalent
+    device semantics: every state after the first carries an implicit
+    ``key == e1.key`` filter, so a partial only advances on its own key's
+    events. (Found by the bench oracle cross-check: without this, rising
+    chains stitched across different device ids.)
+
+    Sequences (strict continuity is per-key) and patterns whose first state
+    binds no alias (absent/logical starts) can't be expressed this way —
+    they take the host path.
+    """
+    import copy
+
+    from ..query_api import (
+        Compare,
+        CompareOp,
+        CountStateElement,
+        EveryStateElement,
+        Filter,
+        LogicalStateElement,
+        NextStateElement,
+        StateInputStream,
+        StateInputStreamType,
+        StreamStateElement,
+        Variable,
+    )
+
+    ist = query.input_stream
+    if not isinstance(ist, StateInputStream):
+        return query
+    if ist.type == StateInputStreamType.SEQUENCE:
+        raise DeviceCompileError(
+            "partitioned sequences need per-key strictness (host path)")
+    query = copy.deepcopy(query)
+    ist = query.input_stream
+
+    elements: list = []
+
+    def walk(el):
+        if isinstance(el, NextStateElement):
+            walk(el.first)
+            walk(el.next)
+        elif isinstance(el, EveryStateElement):
+            walk(el.inner)
+        elif isinstance(el, (StreamStateElement, CountStateElement,
+                             LogicalStateElement)):
+            elements.append(el)
+        else:
+            raise DeviceCompileError(
+                f"partitioned {type(el).__name__} needs the host path")
+
+    walk(ist.state)
+    first = elements[0]
+    if isinstance(first, LogicalStateElement):
+        raise DeviceCompileError(
+            "partitioned pattern starting with a logical state needs the "
+            "host path")
+    stream0 = first.stream if isinstance(first, StreamStateElement) \
+        else first.stream.stream
+    anchor = stream0.alias
+    if anchor is None:
+        raise DeviceCompileError(
+            "partitioned pattern needs an alias on its first state")
+
+    def constrain(stream):
+        stream.handlers.append(Filter(Compare(
+            Variable(key_attr), CompareOp.EQ,
+            Variable(key_attr, stream_id=anchor))))
+
+    for el in elements[1:]:
+        if isinstance(el, StreamStateElement):
+            constrain(el.stream)
+        elif isinstance(el, CountStateElement):
+            constrain(el.stream.stream)
+        else:                       # logical: both branches
+            for sub in (el.first, el.second):
+                constrain(sub.stream)
+    return query
 
 
 class PartitionedNFARuntime:
@@ -45,7 +130,8 @@ class PartitionedNFARuntime:
                  lane_batch: int = 256,
                  mesh: Optional[Mesh] = None,
                  axis: str = "p",
-                 query_index: int = 0):
+                 query_index: int = 0,
+                 creation_cap: Optional[int] = None):
         app = _parse(app_or_text) if isinstance(app_or_text, str) else app_or_text
         # partition queries may live inside a `partition with` block
         if app.queries:
@@ -57,8 +143,12 @@ class PartitionedNFARuntime:
         self.lane_batch = lane_batch
         self.mesh = mesh
         self.axis = axis
+        # per-key semantics on shared lanes: every later state carries an
+        # implicit `key == e1.key` filter (see _inject_key_equality)
+        query = _inject_key_equality(query, key_attr)
         self.compiler = DeviceNFACompiler(
-            query, dict(app.stream_definitions), slot_capacity, lane_batch)
+            query, dict(app.stream_definitions), slot_capacity, lane_batch,
+            creation_cap=creation_cap)
         self.stream_defs = dict(app.stream_definitions)
         self.builders = [
             MergedBatchBuilder(self.compiler.merged, lane_batch,
@@ -214,6 +304,124 @@ class PartitionedNFARuntime:
         b.append(stream_id, row, timestamp)
         if b.full:
             self.flush()
+
+    def encode_columns(self, stream_id: str, cols: dict) -> dict:
+        """Dictionary-encode string columns on their DISTINCT values (the
+        per-event ``encode`` loop is the measured pack bottleneck)."""
+        from ..query_api.definition import DataType
+        d = self.stream_defs[stream_id]
+        si = self.compiler.merged.stream_index[stream_id]
+        enc = {}
+        for a in d.attributes:
+            v = cols.get(a.name)
+            if v is None:
+                continue
+            if a.type == DataType.STRING:
+                dic = self.compiler.merged.dictionaries[f"s{si}_{a.name}"]
+                enc[a.name] = dic.encode_array(v)
+            else:
+                enc[a.name] = np.asarray(v)
+        return enc
+
+    def route_lanes(self, keys) -> np.ndarray:
+        """Vectorized key→lane routing: crc32 runs once per DISTINCT key,
+        cached in a sorted lookup (searchsorted per batch — np.unique over
+        the full array is 20× slower for low-cardinality key streams)."""
+        arr = np.asarray(keys)
+        if arr.dtype == object:
+            arr = arr.astype("U")
+        sv = getattr(self, "_route_vals", None)
+        if sv is None:
+            sv = np.array([], dtype=arr.dtype)
+            self._route_vals, self._route_lanes = sv, np.array([], np.int32)
+        pos = np.searchsorted(sv, arr)
+        posc = np.clip(pos, 0, max(sv.size - 1, 0))
+        hit = (sv[posc] == arr) if sv.size else np.zeros(arr.shape, bool)
+        if not hit.all():
+            fresh = np.unique(arr[~hit])
+            fresh_lanes = np.fromiter(
+                ((_hash_key(str(u)) % self.P) for u in fresh),
+                dtype=np.int32, count=len(fresh))
+            allv = np.concatenate([sv, fresh])
+            lanes_all = np.concatenate([self._route_lanes, fresh_lanes])
+            order = np.argsort(allv, kind="stable")
+            self._route_vals = allv[order]
+            self._route_lanes = lanes_all[order]
+            sv = self._route_vals
+            pos = np.searchsorted(sv, arr)
+            posc = np.clip(pos, 0, sv.size - 1)
+        return self._route_lanes[posc]
+
+    def _lanes_for(self, stream_id: str, cols: dict, enc: dict) -> np.ndarray:
+        """Lane array for a bulk send: string keys route via their already-
+        computed dictionary CODES (one code→lane table lookup; no second
+        string search), other key types via the sorted route cache."""
+        from ..query_api.definition import DataType
+        d = self.stream_defs[stream_id]
+        if d.attribute_type(self.key_attr) == DataType.STRING and \
+                self.key_attr in enc:
+            si = self.compiler.merged.stream_index[stream_id]
+            dic = self.compiler.merged.dictionaries[f"s{si}_{self.key_attr}"]
+            tbl = getattr(self, "_lane_by_code", None)
+            if tbl is None:
+                tbl = np.zeros(1, np.int32)
+            if len(tbl) < len(dic):
+                ext = np.fromiter(
+                    ((_hash_key(dic.decode(c)) % self.P)
+                     for c in range(len(tbl), len(dic))),
+                    dtype=np.int32, count=len(dic) - len(tbl))
+                tbl = np.concatenate([tbl, ext])
+                self._lane_by_code = tbl
+            return tbl[enc[self.key_attr]]
+        return self.route_lanes(cols[self.key_attr])
+
+    def partition_columns(self, stream_id: str, cols: dict, timestamps):
+        """The vectorized ingest front half: encode strings per distinct
+        value, route all rows with ONE stable argsort, return per-lane
+        column/timestamp views. ``send_many`` and the bench packer share
+        this path (no duplicate routing logic to drift)."""
+        ts = np.asarray(timestamps, dtype=np.int64)
+        enc = self.encode_columns(stream_id, cols)
+        lanes = self._lanes_for(stream_id, cols, enc)
+        order = np.argsort(lanes, kind="stable")
+        lanes_sorted = lanes[order]
+        enc_sorted = {k: v[order] for k, v in enc.items()}
+        ts_sorted = ts[order]
+        bounds = np.searchsorted(lanes_sorted, np.arange(self.P + 1))
+        lane_cols, lane_ts = [], []
+        for lane in range(self.P):
+            lo, hi = int(bounds[lane]), int(bounds[lane + 1])
+            lane_cols.append({k: v[lo:hi] for k, v in enc_sorted.items()})
+            lane_ts.append(ts_sorted[lo:hi])
+        return lane_cols, lane_ts
+
+    def send_many(self, stream_id: str, cols: dict, timestamps,
+                  decode: bool = False):
+        """Bulk ingest: route with ``partition_columns``, bulk-copy per-lane
+        slices into the wire builders, flushing as lanes fill. ``cols`` maps
+        attribute name to an array of values. Replaces the per-event
+        ``send`` loop on the hot path (reference analog:
+        ``StreamJunction.java:279-316``)."""
+        if getattr(self, "_ning", None) is not None:
+            raise RuntimeError(
+                "native ingress enabled: use ingest_csv(), not send_many()")
+        lane_cols, lane_ts = self.partition_columns(
+            stream_id, cols, timestamps)
+        out: list = []
+        for lane in range(self.P):
+            n = len(lane_ts[lane])
+            if n == 0:
+                continue
+            b = self.builders[lane]
+            pos = 0
+            while pos < n:
+                pos += b.append_many(stream_id, lane_cols[lane],
+                                     lane_ts[lane], start=pos)
+                if b.full:
+                    r = self.flush(decode=decode)
+                    if decode and r:
+                        out.extend(r)
+        return out if decode else None
 
     def flush(self, decode: bool = False):
         if all(len(b) == 0 for b in self.builders):

@@ -212,6 +212,33 @@ class CompiledStreamQuery:
                     self.window_kind = "timeBatch"
                     self.window_ms = const_param(0)
                     self.window_n = window_capacity
+                elif h.name == "externalTimeBatch":
+                    # timeBatch segmented on an event-time ATTRIBUTE — the
+                    # same kernel with the segment clock read from a column
+                    if len(h.params) != 2 or not isinstance(h.params[0],
+                                                            Variable):
+                        raise DeviceCompileError(
+                            "externalTimeBatch start-time/timeout take the "
+                            "host path")
+                    key, kt = resolver.resolve(h.params[0])
+                    if kt not in (DataType.LONG, DataType.INT):
+                        raise DeviceCompileError(
+                            "externalTimeBatch attribute must be long/int")
+                    self.window_kind = "timeBatch"
+                    self.time_key = key
+                    self.window_ms = const_param(1)
+                    self.window_n = window_capacity
+                elif h.name == "timeLength":
+                    # sliding window bounded by BOTH time and count: the
+                    # sliding-time kernel with the live range clamped to the
+                    # newest N events
+                    self.window_kind = "timeLength"
+                    self.window_ms = const_param(0)
+                    self.window_n = const_param(1)
+                elif h.name == "delay":
+                    self.window_kind = "delay"
+                    self.window_ms = const_param(0)
+                    self.window_n = window_capacity
                 elif h.name == "session":
                     if len(h.params) > 1:
                         raise DeviceCompileError(
@@ -308,6 +335,11 @@ class CompiledStreamQuery:
             # per lane — not worth the HBM; host path covers it
             raise DeviceCompileError(
                 "group-by with windowed min/max/stdDev takes the host path")
+        if self.window_kind == "delay" and (self.agg_idx or self.group_keys):
+            # the delay kernel re-times value projections only; aggregates
+            # over a delayed stream keep host semantics
+            raise DeviceCompileError(
+                "aggregates/group-by over a delay window take the host path")
 
         # having: post-filter over materialized output columns (reference
         # ``QuerySelector``'s havingConditionExecutor)
@@ -327,7 +359,7 @@ class CompiledStreamQuery:
         AS = len(self.sagg_idx)
         state: dict[str, Any] = {}
         windowed = self.window_kind in ("length", "lengthBatch", "time",
-                                        "timeBatch", "session")
+                                        "timeBatch", "session", "timeLength")
         if windowed:
             state["tail_fvals"] = jnp.zeros((AF, N), dtype=FACC)
             state["tail_ivals"] = jnp.zeros((AI, N), dtype=_IACC)
@@ -337,18 +369,22 @@ class CompiledStreamQuery:
                 dt = self._mdtype(i)
                 state[f"tail_m{i}"] = jnp.full(
                     (N,), _ident(dt, self.specs[i].kind == "min"), dt)
-        if self.window_kind == "time":
+        if self.window_kind in ("time", "timeLength"):
             # sentinel = long-expired; keeps the concat ts array sorted
             state["tail_ts"] = jnp.full((N,), _TS_NEG, dtype=jnp.int64)
             state["window_drops"] = jnp.zeros((), dtype=jnp.int64)
             state["last_ts"] = jnp.asarray(_TS_NEG, dtype=jnp.int64)
             state["ts_regressions"] = jnp.zeros((), dtype=jnp.int64)
-        if self.window_kind in ("lengthBatch", "timeBatch", "session"):
+        if self.window_kind in ("lengthBatch", "timeBatch", "session",
+                                "delay"):
             state["rem_count"] = jnp.zeros((), dtype=jnp.int32)
             state["rem_ts"] = jnp.zeros((N,), dtype=jnp.int64)
             for i in self.value_idx:
                 state[f"rem_proj_{i}"] = jnp.zeros(
                     (N,), dtype=_JNP_DTYPES[self.specs[i].dtype])
+        if self.window_kind == "delay":
+            state["window_drops"] = jnp.zeros((), dtype=jnp.int64)
+            state["ts_regressions"] = jnp.zeros((), dtype=jnp.int64)
         if self.window_kind == "timeBatch":
             state["batch_base"] = jnp.asarray(_TS_NEG, dtype=jnp.int64)
         if self.window_kind in ("timeBatch", "session"):
@@ -401,6 +437,7 @@ class CompiledStreamQuery:
         magg_idx, sagg_idx = self.magg_idx, self.sagg_idx
         window_kind, N = self.window_kind, max(self.window_n, 1)
         window_ms, time_key = self.window_ms, self.time_key
+        has_agg = bool(self.agg_idx)
         group_keys = list(self.group_keys)
         group_key_types = list(self.group_key_types)
         K = self.K
@@ -483,7 +520,7 @@ class CompiledStreamQuery:
                 return state, {"out": out, "valid": ovalid, "ts": ots,
                                "count": k if count is None else count}
 
-            if window_kind in ("length", "time"):
+            if window_kind in ("length", "time", "timeLength"):
                 if window_kind == "length":
                     z_f, z_i, z_s, zo, zm = _length_concat(
                         state, av_f, av_i, av_s, av_m, magg_idx, ones_c)
@@ -501,6 +538,14 @@ class CompiledStreamQuery:
                         _time_window_bounds(state, av_f, av_i, av_s, av_m,
                                             magg_idx, ones_c, wts, k, N, B,
                                             window_ms)
+                    if window_kind == "timeLength":
+                        # the live range is ALSO bounded by the newest
+                        # window_n events; evicting past the length bound is
+                        # the window's own semantics (host TimeLengthWindow
+                        # pops the oldest), not a capacity overflow — the
+                        # tail is sized to window_n, so un-count the drops
+                        lo = jnp.maximum(lo, j - N + 1)
+                        new_state["window_drops"] = state["window_drops"]
                 if group_keys:
                     # per-key aggregates over the live window range: one-hot
                     # [M,K] cumulative grids; output j reads its own bucket at
@@ -554,15 +599,87 @@ class CompiledStreamQuery:
                 return _length_batch(state, specs, value_idx, fagg_idx,
                                      iagg_idx, magg_idx, sagg_idx, m_ismin,
                                      proj_c, av_f, av_i, av_s, av_m, ones_c,
-                                     cts, k, N, B, finish)
+                                     cts, k, N, B, finish,
+                                     agg_collapse=has_agg)
 
             if window_kind in ("timeBatch", "session"):
-                cts_pos = compact(ts, fill=jnp.asarray(_TS_POS, jnp.int64))
+                # externalTimeBatch reads the segment clock from a column
+                cts_pos = compact(
+                    cols[time_key].astype(jnp.int64),
+                    fill=jnp.asarray(_TS_POS, jnp.int64)) \
+                    if time_key else compact(
+                        ts, fill=jnp.asarray(_TS_POS, jnp.int64))
                 return _segmented_batch(state, value_idx, fagg_idx, iagg_idx,
                                         magg_idx, sagg_idx, m_ismin, proj_c,
                                         av_f, av_i, av_s, av_m, ones_c,
                                         cts_pos, k, N, B, finish,
-                                        window_kind, window_ms)
+                                        window_kind, window_ms,
+                                        agg_collapse=has_agg)
+
+            if window_kind == "delay":
+                # pass-through after a fixed delay: hold rows until the
+                # newest arrival passes held_ts + delay; emitted rows carry
+                # ts = held_ts + delay (the host's timer fires then, before
+                # the surfacing event is processed)
+                r = state["rem_count"]
+                M = N + B
+                total = r + k
+                zm_mask = jnp.concatenate(
+                    [jnp.arange(N) < r, jnp.arange(B) < k])
+                zrank = jnp.cumsum(zm_mask.astype(jnp.int32)) - 1
+                zpos = jnp.where(zm_mask, zrank, M - 1)
+
+                def zc(x_rem, x_batch, fill=None):
+                    x = jnp.concatenate([x_rem, x_batch])
+                    f = jnp.zeros((), x.dtype) if fill is None else fill
+                    outv = jnp.full((M,), f, dtype=x.dtype)
+                    return outv.at[zpos].set(
+                        jnp.where(zm_mask, x, f), mode="drop")
+
+                j2 = jnp.arange(M)
+                zts_raw = zc(state["rem_ts"], cts,
+                             fill=jnp.asarray(_TS_POS, jnp.int64))
+                # monotonize (same loud clamp as every time kernel): the
+                # release mask must be a PREFIX, or a held out-of-order row
+                # gets silently discarded by the newest-N remainder slice
+                zts = jax.lax.cummax(zts_raw)
+                regressions = jnp.sum(((zts > zts_raw) & (j2 < total))
+                                      .astype(jnp.int64))
+                zproj = {i: zc(state[f"rem_proj_{i}"], proj_c[i])
+                         for i in value_idx}
+                newest = jnp.where(
+                    total > 0, zts[jnp.clip(total - 1, 0, M - 1)], _TS_NEG)
+                release = (j2 < total) & (zts + window_ms <= newest)
+                n_rel = jnp.sum(release.astype(jnp.int32))
+                rem_n = jnp.minimum(total - n_rel, N)
+                dropped = (total - n_rel - rem_n).astype(jnp.int64)
+                slice_from = jnp.maximum(total - rem_n, 0)
+
+                def rem_slice(row):
+                    padded = jnp.concatenate(
+                        [row, jnp.zeros((N,), row.dtype)])
+                    return jax.lax.dynamic_slice(padded, (slice_from,), (N,))
+
+                keep = jnp.arange(N) < rem_n
+                new_state = {**state,
+                             "rem_count": rem_n.astype(jnp.int32),
+                             "window_drops": state["window_drops"] + dropped,
+                             "ts_regressions":
+                                 state["ts_regressions"] + regressions}
+                new_state["rem_ts"] = jnp.where(keep, rem_slice(zts), 0)
+                for i in value_idx:
+                    z_p = zproj[i]
+                    new_state[f"rem_proj_{i}"] = jnp.where(
+                        keep, rem_slice(z_p), jnp.zeros((), z_p.dtype))
+                out = {specs[i].name: zproj[i] for i in value_idx}
+                ovalid = release
+                if having_fn is not None:
+                    ovalid = ovalid & jnp.broadcast_to(
+                        having_fn(out), ovalid.shape)
+                return new_state, {"out": out, "valid": ovalid,
+                                   "ts": zts + window_ms,
+                                   "count": jnp.sum(
+                                       release.astype(jnp.int32))}
 
             if group_keys:
                 # exact packed key (for collision detection) + bucket id —
@@ -861,7 +978,7 @@ def _time_window_bounds(state, av_f, av_i, av_s, av_m, magg_idx, ones_c,
 
 def _length_batch(state, specs, value_idx, fagg_idx, iagg_idx, magg_idx,
                   sagg_idx, m_ismin, proj_c, av_f, av_i, av_s, av_m, ones_c,
-                  cts, k, N, B, finish):
+                  cts, k, N, B, finish, agg_collapse=False):
     """Tumbling window: carried remainder (projections + agg args), outputs over
     [N+B] slots covering remainder + current arrivals."""
     r = state["rem_count"]
@@ -901,6 +1018,11 @@ def _length_batch(state, specs, value_idx, fagg_idx, iagg_idx, magg_idx,
 
     full_batches = total // N
     out_valid = (j2 < full_batches * N) & (j2 < total)
+    if agg_collapse:
+        # aggregated batch chunks collapse to ONE row per flush — the last
+        # slot of each completed batch (reference
+        # QuerySelector.processInBatchNoGroupBy:271)
+        out_valid = out_valid & (j2 % N == N - 1)
 
     rem_n = total - full_batches * N
     def rem_slice(row):
@@ -937,7 +1059,8 @@ def _length_batch(state, specs, value_idx, fagg_idx, iagg_idx, magg_idx,
 
 def _segmented_batch(state, value_idx, fagg_idx, iagg_idx, magg_idx,
                      sagg_idx, m_ismin, proj_c, av_f, av_i, av_s, av_m,
-                     ones_c, cts_pos, k, N, B, finish, mode, window_ms):
+                     ones_c, cts_pos, k, N, B, finish, mode, window_ms,
+                     agg_collapse=False):
     """timeBatch (tumbling time buckets) and session (gap-separated runs) as
     one segmented kernel over [remainder + batch] slots.
 
@@ -992,6 +1115,13 @@ def _segmented_batch(state, value_idx, fagg_idx, iagg_idx, magg_idx,
         seg = (zts_m - base) // jnp.int64(window_ms)
         seg_last = seg[last_idx]
         out_valid = (j2 < total) & (seg < seg_last)
+        if agg_collapse:
+            # aggregated batch chunks collapse to ONE row per closed
+            # bucket — its last slot (reference
+            # QuerySelector.processInBatchNoGroupBy:271)
+            nxt = jnp.clip(j2 + 1, 0, M - 1)
+            last_in_seg = (j2 + 1 >= total) | (seg[nxt] != seg)
+            out_valid = out_valid & last_in_seg
         open_mask = (j2 < total) & (seg == seg_last)
     else:                                   # session
         prev_ts = jnp.concatenate([zts_m[:1], zts_m[:-1]])

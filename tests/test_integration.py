@@ -254,4 +254,5 @@ def test_expression_batch_window(manager):
     ih = rt.input_handler("S")
     for i, v in enumerate([4, 5, 6, 2]):
         ih.send([v], timestamp=200 + i)
-    assert [e.data[0] for e in got] == [4, 9]
+    # one aggregated row per flushed batch (reference batch-mode selector)
+    assert [e.data[0] for e in got] == [9]

@@ -154,6 +154,57 @@ def test_partitioned_mesh_parity():
     assert rt.match_count == len(oracle(app, events))
 
 
+def test_partitioned_per_key_semantics_on_shared_lanes():
+    """`partition with` means per-KEY pattern instances. With more keys than
+    lanes, a lane sees several keys interleaved — the implicit
+    `key == e1.key` constraint must stop chains stitching across keys
+    (found by the bench oracle cross-check: device emitted cross-key
+    matches the host never produced)."""
+    from siddhi_tpu import SiddhiManager, StreamCallback
+
+    app = """
+    define stream S (dev string, v double);
+    partition with (dev of S)
+    begin
+    from every e1=S[v > 90.0] -> e2=S[v > e1.v] -> e3=S[v > e2.v]
+    select e1.v as v1, e2.v as v2, e3.v as v3 insert into Alerts;
+    end;
+    """
+    # ONE lane, two keys: interleaved rising values must only match per key
+    rt = PartitionedNFARuntime(app, num_partitions=1, key_attr="dev",
+                               slot_capacity=16, lane_batch=64)
+    seq = [("a", 91.0), ("b", 92.0), ("a", 93.0), ("b", 94.0),
+           ("a", 95.0), ("b", 96.0)]
+    ts = 1000
+    for d, v in seq:
+        rt.send("S", [d, v], ts)
+        ts += 10
+    rt.flush()
+
+    m = SiddhiManager()
+    hrt = m.create_siddhi_app_runtime(app, playback=True)
+    hm = []
+    hrt.add_callback("Alerts", StreamCallback(
+        lambda evs: hm.extend(list(e.data) for e in evs)))
+    hrt.start()
+    ts = 1000
+    for d, v in seq:
+        hrt.input_handler("S").send([d, v], timestamp=ts)
+        ts += 10
+    m.shutdown()
+    assert rt.match_count == len(hm) == 2
+    # sequences can't take the shared-lane path (per-key strictness)
+    with pytest.raises(DeviceCompileError):
+        PartitionedNFARuntime("""
+        define stream S (dev string, v double);
+        partition with (dev of S)
+        begin
+        from every e1=S[v > 0], e2=S[v > e1.v]
+        select e1.v as v1, e2.v as v2 insert into Alerts;
+        end;
+        """, num_partitions=2, key_attr="dev")
+
+
 def test_unsupported_patterns_fall_back():
     # absent without `for` (followed-by semantics) stays on host
     with pytest.raises(DeviceCompileError):

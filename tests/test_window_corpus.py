@@ -1,0 +1,239 @@
+"""Window + join corpus transliterated from the reference test suites
+(VERDICT r3 item 7 — the pattern corpus found seven bugs; this is the same
+treatment for windows/joins).
+
+Assertions (NOT code) ported from:
+
+- ``.../core/query/window/LengthWindowTestCase.java``
+- ``.../core/query/window/LengthBatchWindowTestCase.java``
+- ``.../core/query/window/TimeBatchWindowTestCase.java``
+- ``.../core/query/window/ExternalTimeWindowTestCase.java``
+- ``.../core/query/window/SortWindowTestCase.java``
+- ``.../core/query/join/JoinTestCase.java``
+- ``.../core/query/join/OuterJoinTestCase.java``
+
+Each case drives the public API under the deterministic playback clock;
+``Thread.sleep`` timing becomes explicit event-timestamp gaps, trailing
+sleeps become ``advance_time``. Expectations are (in_count, remove_count)
+through a QueryCallback — the reference's dominant assertion style — or
+explicit in-event rows.
+"""
+
+import pytest
+
+from siddhi_tpu import QueryCallback, SiddhiManager
+
+
+def run_case(app, sends, end=0, start=1000):
+    """sends: (stream, row, gap_ms). Returns (in_events, remove_events)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, playback=True, start_time=start)
+    ins, rems = [], []
+
+    class _CB(QueryCallback):
+        def receive(self, ts, current, expired):
+            if current:
+                ins.extend(list(e.data) for e in current)
+            if expired:
+                rems.extend(list(e.data) for e in expired)
+
+    rt.add_query_callback("q", _CB())
+    rt.start()
+    ts = start
+    for sid, row, gap in sends:
+        ts += gap
+        rt.input_handler(sid).send(list(row), timestamp=ts)
+    if end:
+        rt.advance_time(ts + end)
+    m.shutdown()
+    return ins, rems
+
+
+S_CSE = "define stream cse (symbol string, price double, volume int);\n"
+S_JOIN = (
+    "define stream cse (symbol string, price double, volume int);\n"
+    "define stream twt (user string, tweet string, company string);\n")
+
+
+def _counts(id, app, sends, n_in, n_remove, end=0):
+    return pytest.param(app, sends, n_in, n_remove, end, id=id)
+
+
+CASES = [
+    # ---------------- LengthWindowTestCase --------------------------------
+    # lengthWindowTest1: fewer events than the window — all pass, none expire
+    _counts("length1", S_CSE + """
+@info(name='q') from cse#window.length(4) select symbol, price, volume
+insert all events into out;""",
+            [("cse", ["IBM", 700.0, 0], 10), ("cse", ["WSO2", 60.5, 1], 10)],
+            2, 0),
+    # lengthWindowTest2: 6 events through length(4) — oldest 2 expire
+    _counts("length2", S_CSE + """
+@info(name='q') from cse#window.length(4) select symbol, price, volume
+insert all events into out;""",
+            [("cse", ["s", 1.0, i], 10) for i in range(1, 7)],
+            6, 2),
+    # ---------------- LengthBatchWindowTestCase ---------------------------
+    # lengthBatchWindowTest1: fewer events than the batch — nothing emits
+    _counts("lengthBatch1", S_CSE + """
+@info(name='q') from cse#window.lengthBatch(4) select symbol, price, volume
+insert into out;""",
+            [("cse", ["IBM", 700.0, 0], 10), ("cse", ["WSO2", 60.5, 1], 10)],
+            0, 0),
+    # lengthBatchWindowTest2: 6 events, batch of 4 — one flush of 4 currents
+    _counts("lengthBatch2", S_CSE + """
+@info(name='q') from cse#window.lengthBatch(4) select symbol, price, volume
+insert into out;""",
+            [("cse", ["s", 1.0, i], 10) for i in range(1, 7)],
+            4, 0),
+    # lengthBatchWindowTest3: batch of 2, all events — flushes emit the new
+    # batch as currents and the PREVIOUS batch as expireds
+    _counts("lengthBatch3", S_CSE + """
+@info(name='q') from cse#window.lengthBatch(2) select symbol, price, volume
+insert all events into out;""",
+            [("cse", ["s", 1.0, i], 10) for i in range(1, 7)],
+            6, 4),
+    # ---------------- TimeBatchWindowTestCase -----------------------------
+    # timeWindowBatchTest1: one bucket of 2 → ONE aggregated current row;
+    # the empty next bucket emits ONE aggregated remove row
+    _counts("timeBatch1", S_CSE + """
+@info(name='q') from cse#window.timeBatch(1 sec)
+select symbol, sum(price) as sumPrice, volume insert all events into out;""",
+            [("cse", ["IBM", 700.0, 0], 10), ("cse", ["WSO2", 60.5, 1], 10)],
+            1, 1, end=3000),
+    # timeWindowBatchTest2: three buckets → 3 current rows; final timer-only
+    # flush emits 1 remove row (mixed flush chunks collapse to the current)
+    _counts("timeBatch2", S_CSE + """
+@info(name='q') from cse#window.timeBatch(1 sec)
+select symbol, sum(price) as price insert all events into out;""",
+            [("cse", ["IBM", 700.0, 1], 10), ("cse", ["WSO2", 60.5, 2], 1100),
+             ("cse", ["IBM", 700.0, 3], 10), ("cse", ["WSO2", 60.5, 4], 10),
+             ("cse", ["IBM", 700.0, 5], 1100), ("cse", ["WSO2", 60.5, 6], 10)],
+            3, 1, end=2000),
+    # timeWindowBatchTest3: currents only
+    _counts("timeBatch3", S_CSE + """
+@info(name='q') from cse#window.timeBatch(1 sec)
+select symbol, sum(price) as price insert into out;""",
+            [("cse", ["IBM", 700.0, 1], 10), ("cse", ["WSO2", 60.5, 2], 1100),
+             ("cse", ["IBM", 700.0, 3], 10), ("cse", ["WSO2", 60.5, 4], 10),
+             ("cse", ["IBM", 700.0, 5], 1100), ("cse", ["WSO2", 60.5, 6], 10)],
+            3, 0, end=2000),
+    # timeWindowBatchTest4: expired events only
+    _counts("timeBatch4", S_CSE + """
+@info(name='q') from cse#window.timeBatch(1 sec)
+select symbol, sum(price) as price insert expired events into out;""",
+            [("cse", ["IBM", 700.0, 1], 10), ("cse", ["WSO2", 60.5, 2], 1100),
+             ("cse", ["IBM", 700.0, 3], 10), ("cse", ["WSO2", 60.5, 4], 10),
+             ("cse", ["IBM", 700.0, 5], 1100), ("cse", ["WSO2", 60.5, 6], 10)],
+            0, 3, end=2000),
+    # ---------------- ExternalTimeWindowTestCase --------------------------
+    # externalTimeWindowTest1: 5-sec window over a timestamp attribute;
+    # 5 currents, 4 expire as the attribute clock advances
+    _counts("externalTime1", """
+define stream login (ts long, ip string);
+@info(name='q') from login#window.externalTime(ts, 5 sec)
+select ts, ip insert all events into out;""",
+            [("login", [1366335804341, "192.10.1.3"], 10),
+             ("login", [1366335804342, "192.10.1.4"], 10),
+             ("login", [1366335814341, "192.10.1.5"], 10),
+             ("login", [1366335814345, "192.10.1.6"], 10),
+             ("login", [1366335824341, "192.10.1.7"], 10)],
+            5, 4),
+    # ---------------- SortWindowTestCase ----------------------------------
+    # sortWindowTest1: sort(2, volume asc) keeps the 2 smallest; 5 in, 3 out
+    _counts("sort1", """
+define stream cse (symbol string, price double, volume long);
+@info(name='q') from cse#window.sort(2, volume, 'asc')
+select volume insert all events into out;""",
+            [("cse", ["WSO2", 55.6, 100], 10), ("cse", ["IBM", 75.6, 300], 10),
+             ("cse", ["WSO2", 57.6, 200], 10), ("cse", ["WSO2", 55.6, 20], 10),
+             ("cse", ["WSO2", 57.6, 40], 10)],
+            5, 3),
+    # sortWindowTest2: two sort keys
+    _counts("sort2", """
+define stream cse (symbol string, price int, volume long);
+@info(name='q') from cse#window.sort(2, volume, 'asc', price, 'desc')
+select price, volume insert all events into out;""",
+            [("cse", ["WSO2", 50, 100], 10), ("cse", ["IBM", 20, 100], 10),
+             ("cse", ["WSO2", 40, 50], 10), ("cse", ["WSO2", 100, 20], 10)],
+            4, 2),
+    # ---------------- JoinTestCase ----------------------------------------
+    # joinTest1: time-window join, 2 matched pairs in, 2 expire
+    _counts("join1", S_JOIN + """
+@info(name='q') from cse#window.time(1 sec) join twt#window.time(1 sec)
+on cse.symbol == twt.company
+select cse.symbol as symbol, twt.tweet, cse.price
+insert all events into out;""",
+            [("cse", ["WSO2", 55.6, 100], 10),
+             ("twt", ["User1", "Hello World", "WSO2"], 10),
+             ("cse", ["IBM", 75.6, 100], 10),
+             ("cse", ["WSO2", 57.6, 100], 500)],
+            2, 2, end=3000),
+    # joinTest3: self-join over 500ms windows
+    _counts("join3_self", S_CSE + """
+@info(name='q') from cse#window.time(500) as a join cse#window.time(500) as b
+on a.symbol == b.symbol
+select a.symbol as symbol, a.price as priceA, b.price as priceB
+insert all events into out;""",
+            [("cse", ["IBM", 75.6, 100], 10),
+             ("cse", ["IBM", 78.6, 100], 300)],
+            # pairs: (e1,e1) at t1; (e2,e1),(e1,e2)... reference expects both
+            # cross pairs + self pairs = 4 in events
+            4, 4, end=2000),
+]
+
+
+@pytest.mark.parametrize("app,sends,n_in,n_remove,end", CASES)
+def test_window_corpus_counts(app, sends, n_in, n_remove, end):
+    ins, rems = run_case(app, sends, end)
+    assert len(ins) == n_in, f"in events: {ins}"
+    assert len(rems) == n_remove, f"remove events: {rems}"
+
+
+# ---------------- value-level cases (exact rows) ---------------------------
+
+def test_length_batch_sum_single_row():
+    """lengthBatchWindowTest4: ONE aggregated row per flushed batch, value =
+    the batch's sum."""
+    ins, _ = run_case(S_CSE + """
+@info(name='q') from cse#window.lengthBatch(4)
+select symbol, sum(price) as sumPrice, volume insert into out;""", [
+        ("cse", ["IBM", 10.0, 0], 10), ("cse", ["WSO2", 20.0, 1], 10),
+        ("cse", ["IBM", 30.0, 0], 10), ("cse", ["WSO2", 40.0, 1], 10),
+        ("cse", ["IBM", 50.0, 0], 10), ("cse", ["WSO2", 60.0, 1], 10)])
+    assert len(ins) == 1 and ins[0][1] == 100.0, ins
+
+
+def test_full_outer_join_rows():
+    """OuterJoinTestCase.joinTest1: unmatched sides emit with nulls."""
+    ins, _ = run_case(S_JOIN + """
+@info(name='q') from cse#window.length(3) full outer join twt#window.length(1)
+on cse.symbol == twt.company
+select cse.symbol as symbol, twt.tweet, cse.price
+insert all events into out;""", [
+        ("cse", ["WSO2", 55.6, 100], 10),
+        ("twt", ["User1", "Hello World", "WSO2"], 10),
+        ("cse", ["IBM", 75.6, 100], 10),
+        ("cse", ["WSO2", 57.6, 100], 10)])
+    assert ins == [
+        ["WSO2", None, 55.6],
+        ["WSO2", "Hello World", 55.6],
+        ["IBM", None, 75.6],
+        ["WSO2", "Hello World", 57.6],
+    ], ins
+
+
+def test_right_outer_join_rows():
+    """OuterJoinTestCase.joinTest2: right outer — unmatched right side emits
+    with left nulls."""
+    ins, _ = run_case(S_JOIN + """
+@info(name='q') from cse#window.length(1) right outer join twt#window.length(2)
+on cse.symbol == twt.company
+select cse.symbol as symbol, twt.tweet, cse.price, twt.company
+insert all events into out;""", [
+        ("twt", ["User1", "Hello World", "WSO2"], 10),
+        ("cse", ["WSO2", 55.6, 100], 10)])
+    assert ins == [
+        [None, "Hello World", None, "WSO2"],
+        ["WSO2", "Hello World", 55.6, "WSO2"],
+    ], ins

@@ -39,8 +39,10 @@ def test_length_batch_window(manager):
     ih = rt.input_handler("S")
     for i, v in enumerate([1, 2, 3, 4, 5, 6]):
         ih.send([v], timestamp=100 + i)
-    # batch emits 3 events with running sums 1, 3, 6 then resets
-    assert [e.data[0] for e in got] == [1, 3, 6, 4, 9, 15]
+    # aggregated batch chunks collapse to ONE row per flush (reference
+    # QuerySelector.processInBatchNoGroupBy — lengthBatchWindowTest4 asserts
+    # a single 100.0 row for a 4-event batch)
+    assert [e.data[0] for e in got] == [6, 15]
 
 
 def test_time_window_expiry(manager):
@@ -67,7 +69,8 @@ def test_time_batch_window(manager):
     ih.send([4], timestamp=1130)
     rt.advance_time(1300)           # flush batch 2 by timer
     sums = [e.data[0] for e in got]
-    assert sums == [1, 3, 3, 7]
+    # one aggregated row per closed bucket (reference batch-mode selector)
+    assert sums == [3, 7]
 
 
 def test_time_length_window(manager):
@@ -104,7 +107,7 @@ def test_external_time_batch_window(manager):
     ih.send([1050, 2], timestamp=2)
     ih.send([1120, 3], timestamp=3)
     ih.send([1230, 4], timestamp=4)   # event 4's batch never flushes (no later event)
-    assert [e.data[0] for e in got] == [1, 3, 3]
+    assert [e.data[0] for e in got] == [3, 3]
 
 
 def test_session_window(manager):
@@ -129,7 +132,7 @@ def test_batch_window(manager):
     from siddhi_tpu import Event
     ih.send([Event(100, [1]), Event(100, [2])])
     ih.send([Event(101, [10])])
-    assert [e.data[0] for e in got] == [1, 3, 10]
+    assert [e.data[0] for e in got] == [3, 10]
 
 
 def test_delay_window(manager):
@@ -195,7 +198,7 @@ def test_cron_window(manager):
     ih.send([1], timestamp=0)
     ih.send([2], timestamp=500)
     rt.advance_time(2500)    # cron fires at 2000
-    assert [e.data[0] for e in got] == [1, 3]
+    assert [e.data[0] for e in got] == [3]
 
 
 def test_expression_window_incremental_aggregates_scale():
