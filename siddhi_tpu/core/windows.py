@@ -288,18 +288,6 @@ class TimeBatchWindow(WindowProcessor):
         return list(self.last_batch) + list(self.pending)
 
     def snapshot_state(self) -> dict:
-        return {"pending": [(e.timestamp, list(e.data)) for e in self.pending],
-                "last": [(e.timestamp, list(e.data)) for e in self.last_batch],
-                "armed": self._armed}
-
-    def restore_state(self, state: dict) -> None:
-        self.pending = [StreamEvent(t, d) for t, d in state["pending"]]
-        self.last_batch = [StreamEvent(t, d) for t, d in state["last"]]
-        self._armed = False
-        if state.get("armed"):
-            self._arm(self.app_context.current_time())
-
-    def snapshot_state(self) -> dict:
         return {
             "pending": [(e.timestamp, list(e.data)) for e in self.pending],
             "last": [(e.timestamp, list(e.data)) for e in self.last_batch],

@@ -327,7 +327,16 @@ def test_stream_function_extension(manager):
 
 def test_time_batch_restore_rearms_timer(manager):
     """Review regression: restored timeBatch must flush on time in the new
-    runtime (timer re-armed from restored boundary)."""
+    runtime (timer re-armed from restored boundary).
+
+    Expected output is a SINGLE event with the batch's final running sum:
+    the reference collapses batch chunks to the last row per flush
+    (QuerySelector.processInBatchNoGroupBy keeps only lastEvent;
+    TimeBatchWindowTestCase.testTimeWindowBatch1 pins inEventCount == 1
+    for two events flushed with sum()). The point pinned here is the
+    *timing*: nothing may emit before the restored boundary (1100), and
+    the flush must fire via the re-armed timer alone.
+    """
     app = """
         define stream S (v long);
         from S#window.timeBatch(100) select sum(v) as total insert into O;
@@ -343,8 +352,10 @@ def test_time_batch_restore_rearms_timer(manager):
     rt2.add_callback("O", StreamCallback(lambda evs: got2.extend(evs)))
     rt2.start()
     rt2.restore(blob)
+    rt2.advance_time(1099)          # before the restored boundary: silence
+    assert got2 == []
     rt2.advance_time(1200)          # boundary at 1100 must fire via timer alone
-    assert [e.data[0] for e in got2] == [1, 3]
+    assert [e.data[0] for e in got2] == [3]
 
 
 def test_session_window_restore(manager):
