@@ -223,7 +223,7 @@ class _ChainHead(Processor):
 # processors extending BatchingWindowProcessor; chunks carry isBatch=true)
 BATCHING_WINDOWS = frozenset(
     {"batch", "lengthBatch", "timeBatch", "externalTimeBatch", "cron",
-     "expressionBatch"})
+     "expressionBatch", "hopping"})
 
 
 def build_single_chain(stream: SingleInputStream, definition: StreamDefinition,

@@ -251,11 +251,13 @@ class TimeBatchWindow(WindowProcessor):
         self.boundary: Optional[int] = None
 
     def process(self, events: list[StreamEvent]) -> None:
-        out: list[StreamEvent] = []
+        # per-flush forwards, same rationale as HoppingWindow.process: the
+        # selector collapses each aggregated batch chunk to one row, so two
+        # boundary flushes merged into one forward would lose a row
         for ev in events:
             if ev.type == EventType.TIMER:
                 if self.boundary is not None and ev.timestamp >= self.boundary:
-                    out.extend(self._flush(self.boundary))
+                    self.forward(self._flush(self.boundary))
                 continue
             if ev.type != EventType.CURRENT:
                 continue
@@ -264,9 +266,8 @@ class TimeBatchWindow(WindowProcessor):
                 self.boundary = base + self.duration
                 self.app_context.scheduler.notify_at(self.boundary, self._on_timer)
             while ev.timestamp >= self.boundary:
-                out.extend(self._flush(self.boundary))
+                self.forward(self._flush(self.boundary))
             self.pending.append(ev)
-        self.forward(out)
 
     def _flush(self, ts: int) -> list[StreamEvent]:
         out: list[StreamEvent] = []
@@ -728,11 +729,14 @@ class HoppingWindow(WindowProcessor):
         self.boundary: Optional[int] = None
 
     def process(self, events: list[StreamEvent]) -> None:
-        out: list[StreamEvent] = []
+        # each flush forwards as its OWN chunk: the selector collapses
+        # aggregated batch chunks to one row per chunk (reference: every
+        # scheduler fire delivers its own chunk), so merging two boundary
+        # flushes into one forward would silently drop the first row
         for ev in events:
             if ev.type == EventType.TIMER:
                 if self.boundary is not None and ev.timestamp >= self.boundary:
-                    out.extend(self._hop_flush(self.boundary))
+                    self.forward(self._hop_flush(self.boundary))
                 continue
             if ev.type != EventType.CURRENT:
                 continue
@@ -740,9 +744,8 @@ class HoppingWindow(WindowProcessor):
                 self.boundary = ev.timestamp + self.hop
                 self.app_context.scheduler.notify_at(self.boundary, self._on_timer)
             while ev.timestamp >= self.boundary:
-                out.extend(self._hop_flush(self.boundary))
+                self.forward(self._hop_flush(self.boundary))
             self.buffer.append(ev)
-        self.forward(out)
 
     def _hop_flush(self, ts: int) -> list[StreamEvent]:
         out: list[StreamEvent] = []

@@ -17,18 +17,24 @@ unbounded cloned ``StateEvent`` lists) becomes:
   ``within`` is a timestamp mask that also reclaims expired slots, slot
   exhaustion is an explicit drop-newest policy with an overflow counter.
 
-Scope (host interpreter is the fallback for the rest): linear chains of
-stream/count/logical/absent states over one or more input streams, ``every``
-scopes starting at state 0, stream-level ``within``. Logical ``and``/``or``
-(incl. ``X and not Y`` without ``for``) use per-slot done flags + masked side
-binds; standalone ``not X for t`` carries a per-slot arrival clock — expiry is
-evaluated in a pre-pass on the next arriving event (host timers fire before
-event delivery, so observable timing matches under the event-driven clock).
-Still host-only: final count states, element-level ``within`` outside
-stream-chain patterns (the blocked kernel handles it there), absent without
-``for``, patterns starting with absent, logical/absent/count inside
-sequences, logical/absent directly after a count state, sibling-alias
-references inside a logical state, and `e[k]` indexing beyond first/last.
+Scope — 104/104 of the untimed reference pattern corpus compiles and
+matches the host oracle (pinned by ``tests/test_pattern_corpus.py::
+test_device_corpus_coverage``): linear chains of stream/count/logical/
+absent states, patterns and sequences, ``every`` scopes starting at any
+stream state (incl. mid-pattern and group scopes), ``within``, ``e[k]``
+occurrence indexing up to ``_MAX_OCC_INDEX``, zero-min and final count
+states, absent-start patterns, and ``not X for t`` (per-slot arrival
+clocks; expiry evaluates in a pre-pass on the next arriving event — host
+timers fire before event delivery, so observable timing matches under the
+event-driven clock). Logical ``and``/``or`` (incl. ``X and not Y`` without
+``for``) use per-slot done flags + masked side binds.
+Still host-only (each raises ``DeviceCompileError`` and the bridge falls
+back): timer-driven emission after the stream ends (``for t`` expiring with
+no later arrival), absent without ``for``, absent/logical-for states inside
+sequences, mid-pattern ``every`` in sequences or ending at a non-stream
+state, count-after-count chains, non-immediate logical/absent directly
+after a count state, logical/absent into a zero-min final count,
+``select *`` over pattern outputs, and ``e[k]`` beyond ``_MAX_OCC_INDEX``.
 Outputs referencing an OR state's unmatched side, an absent branch, or a
 zero-occurrence count emit NULL via carried validity flags (host parity).
 """

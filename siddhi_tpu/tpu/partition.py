@@ -247,10 +247,12 @@ class PartitionedNFARuntime:
                     rows.extend(out)
         return rows
 
-    def flush_native(self, decode: bool = False):
-        decode = decode or self.callback is not None
-        if all(self._ning.lane_len(ln) == 0 for ln in range(self.P)):
-            return [] if decode else None
+    def emit_native_feed(self) -> dict:
+        """Drains all native lanes into ONE stacked [P, ...] wire feed
+        (cols/tag/ts/ts_base/counts/count) WITHOUT stepping the device —
+        the packing half of ``flush_native``, exposed so a producer thread
+        (bench / AsyncDeviceDriver) can overlap C++ packing with device
+        compute."""
         batches = [self._ning.emit_lane(ln) for ln in range(self.P)]
         used = self.compiler.used_cols
         cols = {}
@@ -278,9 +280,18 @@ class PartitionedNFARuntime:
                 "native lane ts span exceeds int32 ms; %d clamped",
                 self.ts_clamped)
         ts = np.clip(deltas, 0, 2**31 - 1).astype(np.int32)
+        return {"cols": cols, "tag": tag, "ts": ts, "ts_base": base,
+                "counts": counts, "count": int(counts.sum())}
+
+    def flush_native(self, decode: bool = False):
+        decode = decode or self.callback is not None
+        if all(self._ning.lane_len(ln) == 0 for ln in range(self.P)):
+            return [] if decode else None
+        b = self.emit_native_feed()
         if decode:
             self._sync_dict_from_native()
-        return self._step_and_decode(cols, tag, ts, base, counts, decode)
+        return self._step_and_decode(b["cols"], b["tag"], b["ts"],
+                                     b["ts_base"], b["counts"], decode)
 
     def _sync_dict_from_native(self) -> None:
         # pull strings the C++ dict minted during ingest into the Python

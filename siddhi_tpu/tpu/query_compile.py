@@ -246,6 +246,58 @@ class CompiledStreamQuery:
                     self.window_kind = "session"
                     self.window_ms = const_param(0)
                     self.window_n = window_capacity
+                elif h.name == "batch":
+                    # per-chunk tumbling window (reference
+                    # BatchWindowProcessor): the device batch IS the chunk
+                    if h.params:
+                        raise DeviceCompileError(
+                            "batch(length) takes the host path")
+                    self.window_kind = "batch"
+                elif h.name == "":
+                    # #window() pass-through (reference EmptyWindowProcessor):
+                    # never expires, so aggregates run exactly like the
+                    # unwindowed path — compile as no-window
+                    pass
+                elif h.name == "sort":
+                    # sort(N, key[, order]): carried sorted top-N buffer with
+                    # a masked-insertion scan (reference SortWindowProcessor
+                    # keeps a sorted list and evicts the per-order worst)
+                    if len(h.params) < 2 or \
+                            not isinstance(h.params[1], Variable):
+                        raise DeviceCompileError(
+                            "sort window needs (N, key attribute)")
+                    if len(h.params) > 3:
+                        raise DeviceCompileError(
+                            "multi-key sort takes the host path")
+                    order = "asc"
+                    if len(h.params) == 3:
+                        v = getattr(h.params[2], "value", None)
+                        if not isinstance(v, str) or \
+                                v.lower() not in ("asc", "desc"):
+                            raise DeviceCompileError(
+                                "sort order must be 'asc'|'desc'")
+                        order = v.lower()
+                    skey, skt = resolver.resolve(h.params[1])
+                    if skt not in (DataType.INT, DataType.LONG,
+                                   DataType.FLOAT, DataType.DOUBLE):
+                        raise DeviceCompileError(
+                            "sort key must be numeric on device (string "
+                            "collation takes the host path)")
+                    self.window_kind = "sort"
+                    self.window_n = const_param(0)
+                    self.sort_key = skey
+                    self.sort_key_type = skt
+                    self.sort_desc = order == "desc"
+                elif h.name == "hopping":
+                    # hopping(duration, hop): overlapping tumbling buckets;
+                    # flushes are event-driven on device like timeBatch
+                    self.window_kind = "hopping"
+                    self.window_ms = const_param(0)
+                    self.hop_ms = const_param(1)
+                    if self.hop_ms <= 0 or self.window_ms <= 0:
+                        raise DeviceCompileError(
+                            "hopping needs positive duration and hop")
+                    self.window_n = window_capacity
                 else:
                     raise DeviceCompileError(
                         f"window '{h.name}' has no device kernel yet")
@@ -264,7 +316,8 @@ class CompiledStreamQuery:
             self.group_keys.append(key)
             self.group_key_types.append(kt)
         if self.group_keys and self.window_kind in (
-                "lengthBatch", "timeBatch", "session"):
+                "lengthBatch", "timeBatch", "session", "batch", "sort",
+                "hopping"):
             raise DeviceCompileError(
                 f"group-by with {self.window_kind} windows takes the host "
                 f"path")
@@ -340,6 +393,11 @@ class CompiledStreamQuery:
             # over a delayed stream keep host semantics
             raise DeviceCompileError(
                 "aggregates/group-by over a delay window take the host path")
+        if self.window_kind == "hopping" and not self.agg_idx:
+            # non-aggregated hopping re-emits every buffered event per flush
+            # (output cardinality ~ duration/hop per event) — host path
+            raise DeviceCompileError(
+                "hopping without aggregates takes the host path")
 
         # having: post-filter over materialized output columns (reference
         # ``QuerySelector``'s havingConditionExecutor)
@@ -359,7 +417,8 @@ class CompiledStreamQuery:
         AS = len(self.sagg_idx)
         state: dict[str, Any] = {}
         windowed = self.window_kind in ("length", "lengthBatch", "time",
-                                        "timeBatch", "session", "timeLength")
+                                        "timeBatch", "session", "timeLength",
+                                        "hopping")
         if windowed:
             state["tail_fvals"] = jnp.zeros((AF, N), dtype=FACC)
             state["tail_ivals"] = jnp.zeros((AI, N), dtype=_IACC)
@@ -390,6 +449,28 @@ class CompiledStreamQuery:
         if self.window_kind in ("timeBatch", "session"):
             state["window_drops"] = jnp.zeros((), dtype=jnp.int64)
             state["ts_regressions"] = jnp.zeros((), dtype=jnp.int64)
+        if self.window_kind == "hopping":
+            state["tail_ts"] = jnp.full((N,), _TS_NEG, dtype=jnp.int64)
+            state["hop_next"] = jnp.asarray(_TS_NEG, dtype=jnp.int64)
+            state["window_drops"] = jnp.zeros((), dtype=jnp.int64)
+            state["last_ts"] = jnp.asarray(_TS_NEG, dtype=jnp.int64)
+            state["ts_regressions"] = jnp.zeros((), dtype=jnp.int64)
+            for i in self.value_idx:
+                state[f"tail_proj_{i}"] = jnp.zeros(
+                    (N,), dtype=_JNP_DTYPES[self.specs[i].dtype])
+        if self.window_kind == "sort":
+            kdt = _JNP_DTYPES[self.sort_key_type]
+            # empty slots sort at +inf (after every real key, desc keys are
+            # stored negated so ascending order IS the sort order)
+            state["sort_keys"] = jnp.full((N,), _ident(kdt, True), dtype=kdt)
+            state["sort_n"] = jnp.zeros((), dtype=jnp.int32)
+            state["sort_fvals"] = jnp.zeros((AF, N), dtype=FACC)
+            state["sort_ivals"] = jnp.zeros((AI, N), dtype=_IACC)
+            state["sort_svals"] = jnp.zeros((AS, N), dtype=FACC)
+            for i in self.magg_idx:
+                dt = self._mdtype(i)
+                state[f"sort_m{i}"] = jnp.full(
+                    (N,), _ident(dt, self.specs[i].kind == "min"), dt)
         if self.group_keys and windowed:
             # windowed group-by carries no per-key sums — aggregates are
             # recomputed from window contents; only the bucket id per tail
@@ -437,6 +518,11 @@ class CompiledStreamQuery:
         magg_idx, sagg_idx = self.magg_idx, self.sagg_idx
         window_kind, N = self.window_kind, max(self.window_n, 1)
         window_ms, time_key = self.window_ms, self.time_key
+        hop_ms = getattr(self, "hop_ms", 0)
+        sort_key = getattr(self, "sort_key", None)
+        sort_desc = getattr(self, "sort_desc", False)
+        sort_kdt = _JNP_DTYPES[self.sort_key_type] \
+            if window_kind == "sort" else None
         has_agg = bool(self.agg_idx)
         group_keys = list(self.group_keys)
         group_key_types = list(self.group_key_types)
@@ -615,6 +701,51 @@ class CompiledStreamQuery:
                                         cts_pos, k, N, B, finish,
                                         window_kind, window_ms,
                                         agg_collapse=has_agg)
+
+            if window_kind == "batch":
+                # the accepted sub-batch IS the chunk (reference
+                # BatchWindowProcessor expires the previous chunk + RESET,
+                # so aggregates restart per step); with aggregates the chunk
+                # collapses to ONE row — the last accepted slot (reference
+                # QuerySelector.processInBatchNoGroupBy keeps lastEvent)
+                j = jnp.arange(B)
+                lo0 = jnp.zeros((B,), jnp.int32)
+                sums_f = _range_sums(av_f, lo0, j)
+                sums_i = _range_sums(av_i, lo0, j)
+                cnts = jnp.cumsum(ones_c).astype(jnp.int64)
+                mins = {i: _range_reduce(av_m[i], lo0, j, m_ismin[i])
+                        for i in magg_idx}
+                svars = _window_svars(av_s, ones_c, lo0, j, cnts, k, 0, B)
+                ovalid = out_valid
+                if has_agg:
+                    ovalid = ovalid & (j == k - 1)
+                return finish(state, sums_f, sums_i, cnts, mins, svars,
+                              ovalid=ovalid,
+                              count=jnp.sum(ovalid.astype(jnp.int32)))
+
+            if window_kind == "sort":
+                kv = cols[sort_key].astype(sort_kdt)
+                if sort_desc:
+                    # stored negated: ascending order IS the sort order and
+                    # the evicted slot (N-1) is the per-order worst; int
+                    # min would wrap under negation (it has no positive
+                    # counterpart), so clamp it one up first
+                    if not jnp.issubdtype(sort_kdt, jnp.floating):
+                        lowest = jnp.iinfo(sort_kdt).min
+                        kv = jnp.where(kv == lowest, lowest + 1, kv)
+                    kv = -kv
+                skey_c = compact(kv, fill=_ident(sort_kdt, True))
+                new_state, sums_f, sums_i, cnts, mins, svars = _sort_window(
+                    state, skey_c, av_f, av_i, av_s, av_m, magg_idx,
+                    m_ismin, k, N, B)
+                return finish(new_state, sums_f, sums_i, cnts, mins, svars)
+
+            if window_kind == "hopping":
+                wts = compact(ts, fill=jnp.asarray(_TS_POS, jnp.int64))
+                return _hopping_flushes(
+                    state, value_idx, av_f, av_i, av_s, av_m, magg_idx,
+                    m_ismin, ones_c, proj_c, wts, k, N, B,
+                    window_ms, hop_ms, finish)
 
             if window_kind == "delay":
                 # pass-through after a fixed delay: hold rows until the
@@ -1186,6 +1317,207 @@ def _segmented_batch(state, value_idx, fagg_idx, iagg_idx, magg_idx,
     count = jnp.sum(out_valid.astype(jnp.int32))
     return finish(new_state, sums_f, sums_i, cnts, mins, svars,
                   ovalid=out_valid, ots=zts, proj=zproj, count=count)
+
+
+def _sort_window(state, skey_c, av_f, av_i, av_s, av_m, magg_idx, m_ismin,
+                 k, N, B):
+    """Top-N-by-key window (reference ``SortWindowProcessor``): a carried
+    sorted buffer of the N best keys with aligned aggregate lanes. Each
+    accepted event inserts at its rank (stable: after equal keys, matching
+    the host's stable append-then-sort) and the worst slot falls off; its
+    running aggregates are the buffer reduction AFTER its insertion.
+
+    A ``lax.scan`` over the batch axis: per-event O(N) shift-insert — the
+    per-event sequential dependence (each output sees the buffer as of its
+    own arrival) makes this inherently a scan, not a cumsum."""
+    idx = jnp.arange(N)
+
+    def insert(row, pos, v):
+        shifted = jnp.concatenate([row[:1], row[:-1]])
+        return jnp.where(idx < pos, row,
+                         jnp.where(idx == pos, v, shifted))
+
+    carry0 = {
+        "keys": state["sort_keys"], "n": state["sort_n"],
+        "f": state["sort_fvals"], "i": state["sort_ivals"],
+        "s": state["sort_svals"],
+    }
+    for i in magg_idx:
+        carry0[f"m{i}"] = state[f"sort_m{i}"]
+    m_ident = {i: _ident(state[f"sort_m{i}"].dtype, m_ismin[i])
+               for i in magg_idx}
+
+    xs = {
+        "accept": jnp.arange(B) < k,
+        "key": skey_c,
+        "f": av_f.T, "i": av_i.T, "s": av_s.T,
+    }
+    for i in magg_idx:
+        xs[f"m{i}"] = av_m[i]
+
+    def body(carry, x):
+        # outputs FIRST, over (carried buffer + the arriving event): the
+        # host chunk is [current, expired-evicted] in that order, so the
+        # emitted current row still includes the about-to-be-evicted value
+        # (the removal only lands on the NEXT row)
+        n_old = carry["n"]
+        occ = idx < n_old
+        sums_f = (jnp.sum(jnp.where(occ[None], carry["f"], 0.0), axis=1)
+                  + x["f"]) if carry["f"].shape[0] \
+            else jnp.zeros((0,), FACC)
+        sums_i = (jnp.sum(jnp.where(occ[None], carry["i"], 0), axis=1)
+                  + x["i"]) if carry["i"].shape[0] \
+            else jnp.zeros((0,), _IACC)
+        cnt = (n_old + 1).astype(jnp.int64)
+        mins = {}
+        for i in magg_idx:
+            lane = jnp.where(occ, carry[f"m{i}"], m_ident[i])
+            red = jnp.min if m_ismin[i] else jnp.max
+            mins[i] = red(jnp.concatenate([lane, x[f"m{i}"][None]]))
+        nf64 = jnp.maximum(cnt, 1).astype(FACC)
+        svs = []
+        for si in range(carry["s"].shape[0]):
+            v = jnp.where(occ, carry["s"][si], 0.0)
+            c = (jnp.sum(v) + x["s"][si]) / nf64
+            d = jnp.where(occ, v - c, 0.0)
+            dx = x["s"][si] - c
+            s1 = (jnp.sum(d) + dx) / nf64
+            s2 = (jnp.sum(d * d) + dx * dx) / nf64
+            svs.append(jnp.sqrt(jnp.maximum(s2 - s1 * s1, 0.0)))
+        svar = jnp.stack(svs) if svs else jnp.zeros((0,), FACC)
+
+        # then insert (and implicitly evict slot N-1, the per-order worst).
+        # Clamp to the occupied prefix: a key equal to the empty-slot
+        # sentinel (+inf / int max) would searchsorted past the fill slots
+        # and silently vanish from a non-full buffer; with a FULL buffer
+        # pos == N means the new event is the worst and evicts itself —
+        # exactly the host's append-sort-pop.
+        pos = jnp.minimum(
+            jnp.searchsorted(carry["keys"], x["key"], side="right"), n_old)
+        ins_lane = lambda row, v: insert(row, pos, v)
+        nk = insert(carry["keys"], pos, x["key"])
+        nf = jax.vmap(ins_lane)(carry["f"], x["f"]) \
+            if carry["f"].shape[0] else carry["f"]
+        ni = jax.vmap(ins_lane)(carry["i"], x["i"]) \
+            if carry["i"].shape[0] else carry["i"]
+        ns = jax.vmap(ins_lane)(carry["s"], x["s"]) \
+            if carry["s"].shape[0] else carry["s"]
+        nm = {i: insert(carry[f"m{i}"], pos, x[f"m{i}"]) for i in magg_idx}
+        nn = jnp.minimum(n_old + 1, N)
+
+        acc = x["accept"]
+        sel = lambda new, old: jnp.where(acc, new, old)
+        new_carry = {
+            "keys": sel(nk, carry["keys"]), "n": sel(nn, carry["n"]),
+            "f": sel(nf, carry["f"]), "i": sel(ni, carry["i"]),
+            "s": sel(ns, carry["s"]),
+        }
+        for i in magg_idx:
+            new_carry[f"m{i}"] = sel(nm[i], carry[f"m{i}"])
+        return new_carry, (sums_f, sums_i, cnt, mins, svar)
+
+    carry, (ys_f, ys_i, ys_c, ys_m, ys_s) = jax.lax.scan(body, carry0, xs)
+    new_state = {**state, "sort_keys": carry["keys"], "sort_n": carry["n"],
+                 "sort_fvals": carry["f"], "sort_ivals": carry["i"],
+                 "sort_svals": carry["s"]}
+    for i in magg_idx:
+        new_state[f"sort_m{i}"] = carry[f"m{i}"]
+    return (new_state, ys_f.T, ys_i.T, ys_c,
+            {i: ys_m[i] for i in magg_idx}, ys_s.T)
+
+
+def _hopping_flushes(state, value_idx, av_f, av_i, av_s, av_m, magg_idx,
+                     m_ismin, ones_c, proj_c, wts, k, N, B, D, H, finish):
+    """hopping(duration D, hop H) — overlapping tumbling buckets (reference
+    ``HopingWindowProcessor``): every H ms emit ONE aggregated row over the
+    events of the last D ms (strictly before the boundary; an arrival AT the
+    boundary flushes first, then joins the buffer — host processes the
+    boundary before appending). Flushes are event-driven like the device
+    timeBatch kernel; boundaries with no live events emit nothing, exactly
+    like the host's RESET-only flush.
+
+    Kernel: time-sorted concat [tail(N) + batch(B)] lanes; the f-th flush
+    boundary reads its bucket (t_f - D, t_f) as cumsum/sparse-table range
+    reductions — all flushes in the batch resolve in parallel."""
+    valid = jnp.arange(B) < k
+    raw = jnp.where(valid, wts, _TS_POS)
+    mono = jnp.maximum(jax.lax.cummax(raw), state["last_ts"])
+    regressed = jnp.sum(jnp.where(valid & (raw < mono), 1, 0)) \
+        .astype(jnp.int64)
+    wts_s = jnp.where(valid, mono, _TS_POS)
+    zts = jnp.concatenate([state["tail_ts"], wts_s])                # [N+B]
+    zo = jnp.concatenate([state["tail_ones"], ones_c])
+    z_f = jnp.concatenate([state["tail_fvals"], av_f], axis=1)
+    z_i = jnp.concatenate([state["tail_ivals"], av_i], axis=1)
+    z_s = jnp.concatenate([state["tail_svals"], av_s], axis=1)
+    zm = {i: jnp.concatenate([state[f"tail_m{i}"], av_m[i]])
+          for i in magg_idx}
+    zproj = {i: jnp.concatenate([state[f"tail_proj_{i}"], proj_c[i]])
+             for i in value_idx}
+
+    newest = jnp.where(k > 0, zts[jnp.maximum(N + k - 1, N)],
+                       state["last_ts"])
+    armed = state["hop_next"] > _TS_NEG
+    # unarmed ⇒ empty tail ⇒ the first real event sits at slot N
+    b0 = jnp.where(armed, state["hop_next"], zts[N] + H)
+    has_any = armed | (k > 0)
+    n_flush_raw = jnp.where(has_any & (newest >= b0),
+                            (newest - b0) // jnp.int64(H) + 1, 0)
+    F = B                         # flush capacity per step; overflow is loud
+    n_flush = jnp.minimum(n_flush_raw, F).astype(jnp.int32)
+    f = jnp.arange(F)
+    t_f = b0 + f.astype(jnp.int64) * jnp.int64(H)
+    lo_f = jnp.searchsorted(zts, t_f - jnp.int64(D), side="right")
+    hi_f = jnp.searchsorted(zts, t_f, side="left") - 1
+    hi_c = jnp.maximum(hi_f, lo_f - 1)            # empty bucket → zero range
+    sums_f = _range_sums(z_f, lo_f, hi_c)
+    sums_i = _range_sums(z_i, lo_f, hi_c)
+    cso = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(zo)])
+    cnts = (cso[hi_c + 1] - cso[lo_f]).astype(jnp.int64)
+    mins = {i: _range_reduce(zm[i], lo_f, hi_c, m_ismin[i])
+            for i in magg_idx}
+    svars = _window_svars(z_s, zo, lo_f, hi_c, cnts, k, N, B)
+    # non-aggregate columns of a collapsed row read the bucket's last event
+    proj_fl = {i: zproj[i][jnp.clip(hi_c, 0, N + B - 1)] for i in value_idx}
+    ovalid = (f < n_flush) & (cnts > 0)
+
+    # boundaries past the flush capacity are NOT dropped: hop_next advances
+    # only by the processed count, so they fire on the next step (the
+    # runtime's flush() drains trailing ones with empty steps)
+    b_last = b0 + (n_flush.astype(jnp.int64) - 1) * jnp.int64(H)
+    live_cut = jnp.where(n_flush > 0, b_last - jnp.int64(D),
+                         jnp.int64(_TS_NEG))
+    sliced = jnp.arange(N + B) < k        # slots pushed out by the slide
+    drops = jnp.sum(jnp.where(sliced & (zts > live_cut), zo, 0)) \
+        .astype(jnp.int64)
+
+    take = lambda row: jax.lax.dynamic_slice(row, (k,), (N,))
+    new_state = {
+        **state,
+        "tail_fvals": jax.vmap(take)(z_f) if z_f.shape[0]
+        else state["tail_fvals"],
+        "tail_ivals": jax.vmap(take)(z_i) if z_i.shape[0]
+        else state["tail_ivals"],
+        "tail_svals": jax.vmap(take)(z_s) if z_s.shape[0]
+        else state["tail_svals"],
+        "tail_ones": take(zo),
+        "tail_ts": take(zts),
+        "hop_next": jnp.where(n_flush > 0,
+                              b0 + n_flush.astype(jnp.int64) * jnp.int64(H),
+                              jnp.where(has_any, b0,
+                                        jnp.int64(_TS_NEG))),
+        "window_drops": state["window_drops"] + drops,
+        "last_ts": jnp.maximum(state["last_ts"], newest),
+        "ts_regressions": state["ts_regressions"] + regressed,
+    }
+    for i in magg_idx:
+        new_state[f"tail_m{i}"] = take(zm[i])
+    for i in value_idx:
+        new_state[f"tail_proj_{i}"] = take(zproj[i])
+
+    return finish(new_state, sums_f, sums_i, cnts, mins, svars,
+                  ovalid=ovalid, ots=t_f, proj=proj_fl,
+                  count=jnp.sum(ovalid.astype(jnp.int32)))
 
 
 def _materialize(specs, value_idx, fagg_idx, iagg_idx, magg_idx, sagg_idx,

@@ -48,10 +48,26 @@ class DeviceStreamRuntime:
             self.flush()
 
     def flush(self, decode: bool = True) -> None:
-        if len(self.builder) == 0:
-            return
-        batch = self.builder.emit()
-        self.state, out = self.compiled.step(self.state, batch)
+        if len(self.builder):
+            batch = self.builder.emit()
+            self.state, out = self.compiled.step(self.state, batch)
+            self._deliver(out, decode)
+        # hopping defers boundary flushes past the per-step flush capacity
+        # (a long time gap can span more hops than one step covers): drain
+        # them with empty steps until the next boundary is in the future
+        if self.compiled.window_kind == "hopping":
+            from .query_compile import _TS_NEG
+            while True:
+                hop_next, last_ts = (
+                    int(v) for v in jax.device_get(
+                        (self.state["hop_next"], self.state["last_ts"])))
+                if hop_next <= _TS_NEG or hop_next > last_ts:
+                    break
+                self.state, out = self.compiled.step(
+                    self.state, self.builder.emit())
+                self._deliver(out, decode)
+
+    def _deliver(self, out, decode: bool) -> None:
         if decode:
             rows = self.compiled.decode_outputs(out)
             if self.callback is not None and rows:

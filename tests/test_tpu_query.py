@@ -246,12 +246,30 @@ def test_unsupported_falls_back_cleanly():
     with pytest.raises(DeviceCompileError):
         DeviceStreamRuntime("""
         define stream S (v long);
-        from S#window.sort(5, v) select sum(v) as s insert into O;
+        from S#window.frequent(5) select sum(v) as s insert into O;
         """)
     with pytest.raises(DeviceCompileError):
         DeviceStreamRuntime("""
         define stream S (v double);
         from S select distinctCount(v) as dc insert into O;
+        """)
+    with pytest.raises(DeviceCompileError):
+        # multi-key sort keeps the host path
+        DeviceStreamRuntime("""
+        define stream S (v long, w long);
+        from S#window.sort(5, v, 'asc', w) select sum(v) as s insert into O;
+        """)
+    with pytest.raises(DeviceCompileError):
+        # string collation sort keeps the host path
+        DeviceStreamRuntime("""
+        define stream S (sym string);
+        from S#window.sort(5, sym) select count() as c insert into O;
+        """)
+    with pytest.raises(DeviceCompileError):
+        # non-aggregated hopping re-emits the buffer per flush — host path
+        DeviceStreamRuntime("""
+        define stream S (v long);
+        from S#window.hopping(300, 100) select v insert into O;
         """)
 
 
@@ -702,6 +720,101 @@ def test_parity_delay():
 
 def test_parity_delay_small_batches():
     assert_parity_ts(APP_DELAY, _ts_rows(80, 16, 300), batch_capacity=8)
+
+
+APP_SORT = """
+define stream S (sym string, price double, vol long);
+from S#window.sort(5, price)
+select sym, sum(price) as total, count() as c, min(price) as lo,
+       stdDev(price) as sd
+insert into O;
+"""
+
+APP_SORT_DESC = """
+define stream S (sym string, price double, vol long);
+from S#window.sort(4, vol, 'desc')
+select sym, sum(vol) as total, max(vol) as hi, avg(price) as ap
+insert into O;
+"""
+
+APP_HOPPING = """
+define stream S (sym string, price double, vol long);
+from S#window.hopping(1 sec, 400)
+select sym, sum(price) as total, count() as c, max(price) as hi
+insert into O;
+"""
+
+
+def test_parity_sort():
+    assert_parity_ts(APP_SORT, _ts_rows(100, 21, 50), window=5)
+
+
+def test_parity_sort_small_batches():
+    assert_parity_ts(APP_SORT, _ts_rows(80, 22, 50), batch_capacity=8,
+                     window=5)
+
+
+def test_parity_sort_desc():
+    assert_parity_ts(APP_SORT_DESC, _ts_rows(90, 23, 50), window=4)
+
+
+def test_parity_hopping():
+    # spread crosses many hop boundaries including multi-hop gaps; the
+    # device defers flushes past the per-step capacity and the runtime's
+    # flush() drains them — output must equal the host's timer ladder
+    assert_parity_ts(APP_HOPPING, _ts_rows(100, 24, 500))
+
+
+def test_parity_hopping_small_batches():
+    assert_parity_ts(APP_HOPPING, _ts_rows(80, 25, 700), batch_capacity=8)
+
+
+def test_parity_hopping_sparse():
+    # long gaps: many whole hops between events (deferred-flush drain path)
+    assert_parity_ts(APP_HOPPING, _ts_rows(30, 26, 4000), batch_capacity=4)
+
+
+def test_parity_batch_chunk_aligned():
+    """batch() is chunk-defined: the device batch IS the chunk, so the host
+    oracle is driven with identical chunks (reference BatchWindowProcessor
+    processes whatever chunk the junction delivers)."""
+    from siddhi_tpu.core.event import Event
+
+    app = """
+    define stream S (v long);
+    from S#window.batch() select sum(v) as s, count() as c insert into O;
+    """
+    rng = random.Random(27)
+    chunks, ts = [], 1000
+    for _ in range(12):
+        n = rng.randrange(1, 6)
+        chunk = []
+        for _ in range(n):
+            ts += rng.randrange(1, 50)
+            chunk.append((ts, [rng.randrange(100)]))
+        chunks.append(chunk)
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, playback=True)
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    ih = rt.input_handler("S")
+    for ch in chunks:
+        ih.send([Event(t, row) for t, row in ch])
+    m.shutdown()
+    expected = [e.data for e in got]
+
+    drt = DeviceStreamRuntime(app, batch_capacity=8)
+    actual = []
+    drt.add_callback(actual.extend)
+    for ch in chunks:
+        for t, row in ch:
+            drt.send(row, timestamp=t)
+        drt.flush()
+    assert len(expected) == len(actual), (expected, actual)
+    for e, a in zip(expected, actual):
+        assert rows_equal(e, a), (e, a)
 
 
 def test_time_batch_terminal_bucket_flushes_at_shutdown():
