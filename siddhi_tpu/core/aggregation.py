@@ -309,9 +309,48 @@ class AggregationRuntime:
         if self.purge_enabled:
             self._arm_purge()
 
+        # -- @device: compile the sec…year rollup to batched segmented
+        # reductions (tpu/aggregation_compile.py; reference cascade:
+        # aggregation/IncrementalExecutor.java:113-164). Events stage into a
+        # columnar micro-batch; the device reduces per-(bucket, key) partials
+        # which merge here at bucket granularity. Host fallback on any
+        # unsupported shape unless @device(strict='true').
+        dev_ann = _find_ann(definition.annotations, "device")
+        self._dev = None
+        self._dev_builder = None
+        if dev_ann is not None:
+            from ..tpu.aggregation_compile import CompiledAggregation
+            from ..tpu.batch import BatchBuilder
+            from ..tpu.expr_compile import DeviceCompileError
+            try:
+                cap = int(dev_ann.get("batch") or 1024)
+                self._dev = CompiledAggregation(definition, self.input_def,
+                                                cap)
+                self._dev_builder = BatchBuilder(self._dev.schema, cap)
+                self._dev_ts_pos = (
+                    self.input_def.attribute_position(
+                        definition.aggregate_attribute)
+                    if definition.aggregate_attribute is not None else None)
+            except DeviceCompileError as e:
+                if (dev_ann.get("strict") or "").lower() == "true":
+                    raise
+                import logging
+                logging.getLogger("siddhi_tpu.device").info(
+                    "aggregation '%s' stays on the host path: %s",
+                    definition.id, e)
+
     # -- junction receiver ----------------------------------------------------
     def receive(self, event: StreamEvent) -> None:
         if event.type != EventType.CURRENT:
+            return
+        if self._dev is not None:
+            # device mode: stage the raw row; the kernel applies the filter
+            # and the bucketing clock column is read positionally
+            ts = int(event.data[self._dev_ts_pos]) \
+                if self._dev_ts_pos is not None else event.timestamp
+            self._dev_builder.append(event.data, ts)
+            if self._dev_builder.full:
+                self._flush_device()
             return
         frame = StreamFrame(event)
         if self.filter_fn is not None and not bool(self.filter_fn(frame)):
@@ -352,6 +391,46 @@ class AggregationRuntime:
                 else:
                     state["values"][name] = fn(frame)
 
+    # -- device flush ---------------------------------------------------------
+    def _flush_device(self) -> None:
+        """Runs the staged micro-batch through the device reducer and merges
+        the per-(bucket, key) partials into the bucket stores (including the
+        persisted-store write-behind bookkeeping receive() does per event)."""
+        if self._dev_builder is None or len(self._dev_builder) == 0:
+            return
+        from ..tpu.aggregation_compile import merge_partial_into_state
+        batch = self._dev_builder.emit()
+        slab = self._dev.bucket_slab(batch["ts"])
+        fetched = self._dev.step(batch["cols"], batch["ts"], slab,
+                                 batch["valid"])
+        durations = self.definition.durations
+        for di, bs, key, row in self._dev.iter_partials(fetched):
+            duration = durations[di]
+            buckets = self.stores[duration]
+            if self.persist_stores:
+                prev_max = self._max_bucket[duration]
+                if prev_max is None or bs > prev_max:
+                    self._max_bucket[duration] = bs
+                    self._flush_duration(duration, up_to_exclusive=bs)
+                self._dirty[duration].add(bs)
+            bucket = buckets.setdefault(bs, {})
+            state = bucket.get(key)
+            if state is None and self.persist_stores:
+                state = self._load_persisted_state(duration, bs, key)
+                if state is not None:
+                    bucket[key] = state
+            if state is None:
+                state = {
+                    "aggs": {
+                        name: make_aggregator(agg_name, arg_t)
+                        for name, kind, fn, agg_name, rt, arg_t
+                        in self.attr_specs if kind == "agg"
+                    },
+                    "values": {},
+                }
+                bucket[key] = state
+            merge_partial_into_state(state, self._dev.specs, row)
+
     # -- purging --------------------------------------------------------------
     def _arm_purge(self) -> None:
         self.app_context.scheduler.notify_at(
@@ -368,6 +447,7 @@ class AggregationRuntime:
         number of buckets removed. The bucket covering `now` is never purged."""
         if now is None:
             now = self.app_context.current_time()
+        self._flush_device()          # staged events may reopen old buckets
         removed = 0
         for duration, buckets in self.stores.items():
             ret = self.retention.get(duration)
@@ -445,6 +525,7 @@ class AggregationRuntime:
     def flush_persisted(self) -> None:
         """Flush every dirty bucket — shutdown/persist barrier (the reference
         drains its CUD queue)."""
+        self._flush_device()
         for duration in self.persist_stores:
             self._flush_duration(duration)
 
@@ -527,6 +608,7 @@ class AggregationRuntime:
 
     def rows_for(self, duration: TimePeriodDuration,
                  start: Optional[int] = None, end: Optional[int] = None) -> list[list]:
+        self._flush_device()          # reads see every staged event
         buckets = self.stores.get(duration)
         if buckets is None:
             from .errors import SiddhiAppRuntimeError
@@ -591,6 +673,7 @@ class AggregationRuntime:
 
     # -- state ----------------------------------------------------------------
     def snapshot_state(self) -> dict:
+        self._flush_device()          # checkpoint covers staged events
         enc = {}
         for duration, buckets in self.stores.items():
             enc[duration.value] = {
@@ -607,6 +690,8 @@ class AggregationRuntime:
         return enc
 
     def restore_state(self, state: dict) -> None:
+        if self._dev_builder is not None and len(self._dev_builder):
+            self._dev_builder.emit()          # restore replaces staged rows
         for duration in self.stores:
             self.stores[duration] = {}
             for bs, bucket in state.get(duration.value, {}).items():

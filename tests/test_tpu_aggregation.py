@@ -1,0 +1,218 @@
+"""Device incremental-aggregation parity: the @device segmented-reduction
+rollup (tpu/aggregation_compile.py) vs the host AggregationRuntime oracle on
+identical event sequences (reference cascade:
+aggregation/IncrementalExecutor.java:113-164)."""
+
+import random
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+BASE = """
+define stream S (sym string, price double, vol long);
+define aggregation AGGNAME
+from S
+select sym, sum(price) as total, count() as c, avg(price) as ap,
+       min(vol) as lo, max(vol) as hi, stdDev(price) as sd
+group by sym
+aggregate every sec...year;
+"""
+
+SELECT = ("select AGG_TIMESTAMP, sym, total, c, ap, lo, hi, sd")
+
+
+def _events(n, seed, spread_ms=400, base_ts=1_700_000_000_000):
+    rng = random.Random(seed)
+    ts = base_ts
+    out = []
+    for _ in range(n):
+        ts += rng.randrange(spread_ms)
+        out.append((ts, [rng.choice("abc"), round(rng.uniform(1, 50), 2),
+                         rng.randrange(100)]))
+    return out
+
+
+def _run(app, agg_name, events, per="seconds", query=None):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, playback=True)
+    rt.start()
+    ih = rt.input_handler("S")
+    for t, row in events:
+        ih.send(row, timestamp=t)
+    q = query or (f"from {agg_name} within 0L, 9999999999999L per "
+                  f"'{per}' {SELECT}")
+    rows = rt.query(q)
+    m.shutdown()
+    return sorted(tuple(e.data) for e in rows)
+
+
+def _apps(extra_ann="", body=BASE):
+    host = body.replace("AGGNAME", "AggH")
+    dev = body.replace("define aggregation AGGNAME",
+                       f"@device(batch='16'{extra_ann})\n"
+                       f"define aggregation AggD").replace("AGGNAME", "AggD")
+    return host, dev
+
+
+def _assert_rows_close(h, d):
+    assert len(h) == len(d), (len(h), len(d))
+    for rh, rd in zip(h, d):
+        for a, b in zip(rh, rd):
+            if isinstance(a, float):
+                # device double columns ride the f32 wire policy
+                # (tpu/dtypes.py) — accumulation is f64 but inputs cast
+                assert b == pytest.approx(a, rel=1e-4, abs=1e-4), (rh, rd)
+            else:
+                assert a == b, (rh, rd)
+
+
+@pytest.mark.parametrize("per", ["seconds", "minutes", "hours", "days",
+                                 "months", "years"])
+def test_parity_all_durations(per):
+    host, dev = _apps()
+    events = _events(120, 31, spread_ms=60_000)   # spans many minute buckets
+    h = _run(host, "AggH", events, per=per)
+    d = _run(dev, "AggD", events, per=per)
+    _assert_rows_close(h, d)
+
+
+def test_parity_small_batches_cross_bucket():
+    # batch='4': buckets span many micro-batches; partials must merge
+    host, dev = _apps()
+    dev = dev.replace("batch='16'", "batch='4'")
+    events = _events(90, 32, spread_ms=700)
+    _assert_rows_close(_run(host, "AggH", events),
+                       _run(dev, "AggD", events))
+
+
+def test_parity_filter_and_no_group():
+    body = """
+define stream S (sym string, price double, vol long);
+define aggregation AGGNAME
+from S[vol > 20]
+select sum(price) as total, count() as c, max(price) as hi
+aggregate every sec...min;
+"""
+    host, dev = _apps(body=body)
+    events = _events(80, 33)
+    q = "within 0L, 9999999999999L per 'seconds' " \
+        "select AGG_TIMESTAMP, total, c, hi"
+    h = _run(host, "AggH", events, query=f"from AggH {q}")
+    d = _run(dev, "AggD", events, query=f"from AggD {q}")
+    _assert_rows_close(h, d)
+
+
+def test_parity_external_timestamp():
+    # aggregate by an event attribute, out of lockstep with arrival time
+    body = """
+define stream S (sym string, price double, ets long);
+define aggregation AGGNAME
+from S
+select sym, sum(price) as total, count() as c
+group by sym
+aggregate by ets every sec...min;
+"""
+    host, dev = _apps(body=body)
+    rng = random.Random(34)
+    events = []
+    ets = 1_700_000_000_000
+    for i in range(70):
+        ets += rng.randrange(900)
+        events.append((1000 + i, [rng.choice("ab"),
+                                  round(rng.uniform(1, 9), 2), ets]))
+    q = "within 0L, 9999999999999L per 'seconds' " \
+        "select AGG_TIMESTAMP, sym, total, c"
+    h = _run(host, "AggH", events, query=f"from AggH {q}")
+    d = _run(dev, "AggD", events, query=f"from AggD {q}")
+    _assert_rows_close(h, d)
+
+
+def test_device_aggregation_snapshot_restore():
+    _, dev = _apps()
+    events = _events(60, 35)
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(dev, playback=True)
+    rt.start()
+    ih = rt.input_handler("S")
+    for t, row in events[:40]:
+        ih.send(row, timestamp=t)
+    blob = rt.snapshot()
+
+    rt2 = m.create_siddhi_app_runtime(dev, playback=True)
+    rt2.start()
+    rt2.restore(blob)
+    ih2 = rt2.input_handler("S")
+    for t, row in events[40:]:
+        ih2.send(row, timestamp=t)
+    got = sorted(tuple(e.data) for e in rt2.query(
+        f"from AggD within 0L, 9999999999999L per 'seconds' {SELECT}"))
+
+    rt3 = m.create_siddhi_app_runtime(dev.replace("AggD", "AggX"),
+                                      playback=True)
+    rt3.start()
+    ih3 = rt3.input_handler("S")
+    for t, row in events:
+        ih3.send(row, timestamp=t)
+    want = sorted(tuple(e.data) for e in rt3.query(
+        f"from AggX within 0L, 9999999999999L per 'seconds' {SELECT}"))
+    m.shutdown()
+    _assert_rows_close(want, got)
+
+
+def test_device_aggregation_unsupported_falls_back():
+    # distinctCount has no mergeable device lanes → host path, still correct
+    body = """
+define stream S (sym string, price double, vol long);
+define aggregation AGGNAME
+from S
+select sym, distinctCount(vol) as dc
+group by sym
+aggregate every sec;
+"""
+    host, dev = _apps(body=body)
+    events = _events(50, 36)
+    q = "within 0L, 9999999999999L per 'seconds' " \
+        "select AGG_TIMESTAMP, sym, dc"
+    h = _run(host, "AggH", events, query=f"from AggH {q}")
+    d = _run(dev, "AggD", events, query=f"from AggD {q}")
+    _assert_rows_close(h, d)
+
+
+def test_device_aggregation_strict_raises():
+    from siddhi_tpu.tpu.expr_compile import DeviceCompileError
+
+    body = """
+define stream S (sym string, price double, vol long);
+define aggregation AggD
+from S
+select sym, distinctCount(vol) as dc
+group by sym
+aggregate every sec;
+"""
+    dev = body.replace("define aggregation AggD",
+                       "@device(strict='true')\ndefine aggregation AggD")
+    m = SiddhiManager()
+    with pytest.raises(DeviceCompileError):
+        m.create_siddhi_app_runtime(dev, playback=True)
+
+
+def test_device_aggregation_purge():
+    _, dev = _apps()
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(dev, playback=True)
+    rt.start()
+    ih = rt.input_handler("S")
+    base = 1_700_000_000_000
+    ih.send(["a", 1.0, 5], timestamp=base)
+    ih.send(["a", 2.0, 6], timestamp=base + 10_000_000)
+    agg = rt.ctx.aggregations["AggD"]
+    # retention for seconds defaults to 120s: the old bucket purges, the
+    # staged new one must be flushed-then-kept
+    removed = agg.purge(now=base + 10_000_000)
+    assert removed >= 1
+    rows = rt.query(f"from AggD within 0L, 9999999999999L per 'seconds' "
+                    f"{SELECT}")
+    assert len(rows) == 1 and rows[0].data[2] == pytest.approx(2.0)
+    m.shutdown()
