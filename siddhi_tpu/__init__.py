@@ -20,6 +20,12 @@ from .core import (
     InMemoryPersistenceStore,
     InputHandler,
     QueryCallback,
+    RecordTableHandler,
+    RecordTableHandlerManager,
+    SinkHandler,
+    SinkHandlerManager,
+    SourceHandler,
+    SourceHandlerManager,
     SiddhiAppRuntime,
     SiddhiManager,
     StreamCallback,

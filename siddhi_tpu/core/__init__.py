@@ -22,7 +22,14 @@ from .extension import (
     StreamFunctionExtension,
     extension,
 )
-from .io import InMemoryBroker
+from .io import (
+    InMemoryBroker,
+    SinkHandler,
+    SinkHandlerManager,
+    SourceHandler,
+    SourceHandlerManager,
+)
+from .table import RecordTableHandler, RecordTableHandlerManager
 from .metrics import Level
 from .config import (
     ConfigManager,

@@ -83,6 +83,7 @@ class SiddhiAppRuntime:
         self.sources: list = []
         self.sinks: list = []
         self.device_bridges: list = []
+        self._io_handlers: list[tuple[str, str]] = []   # (kind, element id)
         self._started = False
         self._ondemand_cache: dict[str, OnDemandQueryRuntime] = {}
 
@@ -111,6 +112,13 @@ class SiddhiAppRuntime:
                 table = cls(td, ctx)
                 table.config_reader = ctx.config_reader("store", store_type)
                 table.init(td, {e.key: e.value for e in store_ann.elements if e.key})
+                rmgr = ctx.siddhi_context.record_table_handler_manager
+                if rmgr is not None:
+                    th = rmgr.generate_record_table_handler()
+                    th.init(self.name, td)
+                    rmgr.register_record_table_handler(th.id, th)
+                    table.handler = th
+                    self._io_handlers.append(("table", th.id))
                 cache_ann = store_ann.nested("cache")
                 if cache_ann is not None:
                     from .table import CacheTable
@@ -306,7 +314,7 @@ class SiddhiAppRuntime:
                 mapper = self._with_config(mapper_cls(), "sourceMapper", s["map"])
                 mapper.init(sd, s["options"])
                 src = self._with_config(cls(), "source", s["type"])
-                handler = self._make_source_handler(sd.id, mapper)
+                handler = self._make_source_handler(sd.id, mapper, s["type"])
                 src.init(sd, s["options"], mapper, handler)
                 self.sources.append(src)
             for s in sinks:
@@ -357,15 +365,39 @@ class SiddhiAppRuntime:
                     sink = self._with_config(cls(), "sink", s["type"])
                     sink.init(sd, s["options"], mapper)
                 self.sinks.append(sink)
-                cb = StreamCallback(lambda events, sk=sink: [
-                    sk.on_event(e) for e in events])
+                smgr = ctx.siddhi_context.sink_handler_manager
+                if smgr is not None:
+                    sh = smgr.generate_sink_handler()
+                    sh.init(self.name, sd, sink.on_event,
+                            element_id=self.ctx.element_id(
+                                f"{self.name}-{sd.id}-{type(sh).__name__}"))
+                    smgr.register_sink_handler(sh.id, sh)
+                    self._io_handlers.append(("sink", sh.id))
+                    cb = StreamCallback(lambda events, h=sh: [
+                        h.handle(e) for e in events])
+                else:
+                    cb = StreamCallback(lambda events, sk=sink: [
+                        sk.on_event(e) for e in events])
                 self.add_callback(sd.id, cb)
 
-    def _make_source_handler(self, stream_id: str, mapper):
+    def _make_source_handler(self, stream_id: str, mapper, source_type: str):
+        mgr = self.ctx.siddhi_context.source_handler_manager
+        sh = None
+        if mgr is not None:
+            sh = mgr.generate_source_handler(source_type)
+            sh.init(self.name, self.app.stream_definitions[stream_id],
+                    element_id=self.ctx.element_id(
+                        f"{self.name}-{stream_id}-{type(sh).__name__}"))
+            mgr.register_source_handler(sh.id, sh)
+            self._io_handlers.append(("source", sh.id))
+
         def handler(payload):
             ih = self.input_handler(stream_id)
             for row in mapper.map(payload):
-                ih.send(row)
+                if sh is not None:
+                    sh.send_event(row, ih)
+                else:
+                    ih.send(row)
         return handler
 
     # -------------------------------------------------------------- public API
@@ -438,6 +470,13 @@ class SiddhiAppRuntime:
             src.disconnect()
         for sink in self.sinks:
             sink.disconnect()
+        sc = self.ctx.siddhi_context
+        for kind, hid in self._io_handlers:
+            mgr = {"source": sc.source_handler_manager,
+                   "sink": sc.sink_handler_manager,
+                   "table": sc.record_table_handler_manager}[kind]
+            if mgr is not None:
+                getattr(mgr, f"unregister_{'record_table' if kind == 'table' else kind}_handler")(hid)
         self.ctx.statistics_manager.stop_reporting()
         if self.ctx.ticker is not None:
             self.ctx.ticker.stop()

@@ -23,6 +23,11 @@ class SiddhiContext:
         self.persistence_store = None
         self.config_manager = None
         self.attributes: dict[str, Any] = {}
+        # handler interception SPIs (reference SiddhiContext source/sink/
+        # record-table handler manager slots)
+        self.source_handler_manager = None
+        self.sink_handler_manager = None
+        self.record_table_handler_manager = None
 
 
 class SiddhiAppContext:

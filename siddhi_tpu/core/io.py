@@ -141,6 +141,93 @@ class ConnectionUnavailableError(Exception):
     pass
 
 
+# ---------------------------------------------------------------------------
+# handler interception SPIs
+# ---------------------------------------------------------------------------
+
+class SourceHandler:
+    """Optional interception stage between a source's mapped rows and the
+    stream's ``InputHandler`` (reference ``stream/input/source/
+    SourceHandler.java:44`` — there it wraps the InputHandler with optional
+    pre-processing; state rides on the instance here instead of the
+    reference's StateHolder ceremony).
+
+    Override :meth:`send_event`; call ``input_handler.send(row)`` to forward
+    (possibly transformed), or skip the call to drop the event."""
+
+    def init(self, app_name: str, definition: StreamDefinition,
+             element_id: str = None) -> None:
+        self.app_name = app_name
+        self.definition = definition
+        # the registry key is the UNIQUE element id (reference registers by
+        # the Source's IdGenerator id, not a name-derived one — two @source
+        # annotations on one stream must not collide)
+        self.id = element_id or \
+            f"{app_name}-{definition.id}-{type(self).__name__}"
+
+    def send_event(self, row, input_handler) -> None:
+        input_handler.send(row)
+
+
+class SourceHandlerManager:
+    """Per-engine factory + registry of :class:`SourceHandler` instances
+    (reference ``SourceHandlerManager.java:27``). Install via
+    ``SiddhiManager.set_source_handler_manager``; one handler is generated
+    per wired source."""
+
+    def __init__(self):
+        self.registered: dict[str, SourceHandler] = {}
+
+    def generate_source_handler(self, source_type: str) -> SourceHandler:
+        raise NotImplementedError
+
+    def register_source_handler(self, element_id: str,
+                                handler: SourceHandler) -> None:
+        self.registered[element_id] = handler
+
+    def unregister_source_handler(self, element_id: str) -> None:
+        self.registered.pop(element_id, None)
+
+
+class SinkHandler:
+    """Optional interception stage between a stream's outgoing events and
+    its sink mapper (reference ``stream/output/sink/SinkHandler.java:34``).
+
+    Override :meth:`handle`; call ``callback(event)`` to forward to the
+    mapper+transport, or skip the call to drop it."""
+
+    def init(self, app_name: str, definition: StreamDefinition,
+             callback: Callable[[Event], None],
+             element_id: str = None) -> None:
+        self.app_name = app_name
+        self.definition = definition
+        self.callback = callback
+        self.id = element_id or \
+            f"{app_name}-{definition.id}-{type(self).__name__}"
+
+    def handle(self, event: Event) -> None:
+        self.callback(event)
+
+
+class SinkHandlerManager:
+    """Reference ``SinkHandlerManager.java`` — factory + registry of
+    :class:`SinkHandler` instances, installed via
+    ``SiddhiManager.set_sink_handler_manager``."""
+
+    def __init__(self):
+        self.registered: dict[str, SinkHandler] = {}
+
+    def generate_sink_handler(self) -> SinkHandler:
+        raise NotImplementedError
+
+    def register_sink_handler(self, element_id: str,
+                              handler: SinkHandler) -> None:
+        self.registered[element_id] = handler
+
+    def unregister_sink_handler(self, element_id: str) -> None:
+        self.registered.pop(element_id, None)
+
+
 class Source:
     """Transport-agnostic ingress (reference ``Source.java:50``).
 

@@ -535,12 +535,29 @@ def _build_join(ist: JoinInputStream, rt: QueryRuntime, app_context,
             if ist.per is None:
                 raise QueryBuildError(
                     "aggregation join needs `per '<granularity>'`")
+            from ..query_api import Constant as _Const
+            w = ist.within
+            dynamic = not isinstance(ist.per, _Const) or (
+                isinstance(w, tuple) and not all(
+                    isinstance(x, _Const) for x in w)) or (
+                w is not None and not isinstance(w, tuple)
+                and not isinstance(w, _Const))
+            if dynamic:
+                # per/within read from the DRIVING event's attributes
+                # (reference Aggregation1TestCase test6: `within i.startTime,
+                # i.endTime per i.perValue`) — resolved per probe in the
+                # post-pass below, once both sides' schemas exist
+                sides[label] = {
+                    "kind": "aggregation", "def": agg.output_definition,
+                    "ref": s.ref(), "find": None, "stream": s, "agg": agg,
+                    "dynamic": True,
+                }
+                continue
             from .errors import SiddhiAppRuntimeError
             try:
                 duration = agg.duration_for(ist.per.value)
             except SiddhiAppRuntimeError as e:
                 raise QueryBuildError(str(e)) from None
-            w = ist.within
             start = end = None
             if isinstance(w, tuple):
                 start, end = _within_bound(w[0]), _within_bound(w[1])
@@ -588,6 +605,59 @@ def _build_join(ist: JoinInputStream, rt: QueryRuntime, app_context,
     if ist.on_condition is not None:
         cond_fn, _ = builder.build(ist.on_condition)
 
+    # dynamic aggregation sides: compile per/within executors over the
+    # joined frame (the probe event rides its own side; the aggregation side
+    # of the frame stays None) and rebuild the rollup row-set per probe
+    for label, is_left in (("left", True), ("right", False)):
+        side = sides[label]
+        if not side.get("dynamic"):
+            continue
+        from ..query_api import Constant as _Const
+        from .aggregation import parse_within_single, parse_within_value
+        from .errors import SiddhiAppRuntimeError
+        from .event import StreamEvent as _SE
+        from .executor import JoinFrame as _JF
+
+        def _valfn(e):
+            if isinstance(e, _Const):
+                v = e.value
+                return lambda fr, v=v: v
+            fn, _t = builder.build(e)
+            return fn
+
+        agg = side["agg"]
+        per_fn = _valfn(ist.per)
+        w = ist.within
+        if isinstance(w, tuple):
+            w_fns = (_valfn(w[0]), _valfn(w[1]))
+            w_single = None
+        elif w is not None:
+            w_fns = None
+            w_single = _valfn(w)
+        else:
+            w_fns = w_single = None
+        probe_is_left = not is_left     # the driving event is the other side
+
+        def agg_find(probe_ev=None, agg=agg, per_fn=per_fn, w_fns=w_fns,
+                     w_single=w_single, probe_is_left=probe_is_left):
+            ts = probe_ev.timestamp if probe_ev is not None else 0
+            fr = _JF(probe_ev if probe_is_left else None,
+                     None if probe_is_left else probe_ev, ts)
+            try:
+                duration = agg.duration_for(per_fn(fr))
+                if w_fns is not None:
+                    start = parse_within_value(w_fns[0](fr))
+                    end = parse_within_value(w_fns[1](fr))
+                elif w_single is not None:
+                    start, end = parse_within_single(w_single(fr))
+                else:
+                    start = end = None
+            except ValueError as e:
+                raise SiddhiAppRuntimeError(str(e)) from None
+            return [_SE(r[0], r) for r in agg.rows_for(duration, start, end)]
+
+        side["find"] = agg_find
+
     within_ms = None
     if ist.per is None and ist.within is not None:
         from ..query_api import Constant as _Const
@@ -616,7 +686,10 @@ def _build_join(ist: JoinInputStream, rt: QueryRuntime, app_context,
                 fn = lambda probe_ev=None, t=table: t.all_events(  # noqa: E731
                     probe_ev.timestamp if probe_ev is not None else 0)
         if fn is None:
-            fn = lambda probe_ev=None, f=side["find"]: f()  # noqa: E731
+            if side.get("dynamic"):
+                fn = side["find"]          # per-probe per/within resolution
+            else:
+                fn = lambda probe_ev=None, f=side["find"]: f()  # noqa: E731
         finds[label] = fn
     jr = JoinRuntime(ist.join_type, ist.trigger, cond_fn,
                      finds["left"], finds["right"], within_ms)

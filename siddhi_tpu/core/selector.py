@@ -141,7 +141,8 @@ class QuerySelector:
                 else:
                     data.append(spec.value_fn(frame))
             if self.having_fn is not None:
-                if not bool(self.having_fn(RowFrame(data, ev.timestamp))):
+                if not bool(self.having_fn(
+                        HavingFrame(data, ev.timestamp, frame))):
                     continue
             out.append(StreamEvent(ev.timestamp, data, ev.type))
             out_keys.append(key)
@@ -202,6 +203,41 @@ class QuerySelector:
                 a.restore(saved[i])
 
 
+class HavingFrame:
+    """Evaluation frame for having conditions: the projected output row
+    (``.data`` — RowFrame protocol) plus the pre-projection input frame
+    (``.src``) for input-attribute references."""
+    __slots__ = ("data", "ts", "src")
+
+    def __init__(self, data: list, ts: int, src):
+        self.data = data
+        self.ts = ts
+        self.src = src
+
+    def timestamp(self) -> int:
+        return self.ts
+
+
+class _HavingResolver:
+    """Output aliases first (unprefixed), then the query's input resolver
+    over ``frame.src`` (reference: having sees the whole meta event)."""
+
+    def __init__(self, out_names, out_types, input_resolver):
+        self.out_names = out_names
+        self.out_types = out_types
+        self.input_resolver = input_resolver
+
+    def resolve(self, var):
+        if var.stream_id is None and var.attribute in self.out_names:
+            pos = self.out_names.index(var.attribute)
+            return (lambda f: f.data[pos]), self.out_types[pos]
+        fn, t = self.input_resolver.resolve(var)
+        return (lambda f: fn(f.src)), t
+
+    def encode_string(self, key, value):       # pragma: no cover - delegate
+        return self.input_resolver.encode_string(key, value)
+
+
 class _Rev:
     __slots__ = ("v",)
 
@@ -251,10 +287,17 @@ def build_selector(selector: Selector, builder: ExecutorBuilder,
 
     having_fn = None
     if selector.having is not None:
-        from .executor import RowResolver
+        # the reference's having executor sees BOTH the projected output
+        # attributes and the query's input attributes (its output meta event
+        # still wraps the input state — JoinTestCase.joinTest14 pins
+        # `having orders.items == "item1"` over a join). Output aliases win
+        # for unprefixed names; prefixed or unknown names resolve through
+        # the query's own input resolver against the pre-projection frame.
         out_names = [s.name for s in specs]
         out_types = [s.dtype for s in specs]
-        hb = ExecutorBuilder(RowResolver(out_names, out_types), builder.context)
+        hb = ExecutorBuilder(
+            _HavingResolver(out_names, out_types, builder.resolver),
+            builder.context)
         having_fn, _ = hb.build(selector.having)
 
     order_by = []

@@ -315,6 +315,53 @@ class InMemoryTable(Table):
         self.add(state["rows"])
 
 
+class RecordTableHandler:
+    """Optional interception stage around a record store's operations
+    (reference ``table/record/RecordTableHandler.java:41`` — there each op
+    routes through the handler with a RecordTableHandlerCallback; here the
+    underlying op arrives as the ``do`` callable and the default forwards).
+
+    Override the ops you care about (audit, caching, latency injection);
+    always return ``do(...)``'s result (or a transformed one)."""
+
+    def init(self, app_name: str, definition: TableDefinition) -> None:
+        self.app_name = app_name
+        self.definition = definition
+        self.id = f"{app_name}-{definition.id}-{type(self).__name__}"
+
+    def add(self, timestamp: int, rows: list[list], do) -> None:
+        return do(rows)
+
+    def find(self, timestamp: int, params: dict, compiled, do) -> list[list]:
+        return do(params, compiled)
+
+    def delete(self, timestamp: int, params: dict, compiled, do) -> int:
+        return do(params, compiled)
+
+    def update(self, timestamp: int, params: dict, values: dict,
+               compiled, do) -> int:
+        return do(params, values, compiled)
+
+
+class RecordTableHandlerManager:
+    """Reference ``RecordTableHandlerManager`` — factory + registry of
+    :class:`RecordTableHandler` instances, installed via
+    ``SiddhiManager.set_record_table_handler_manager``."""
+
+    def __init__(self):
+        self.registered: dict[str, RecordTableHandler] = {}
+
+    def generate_record_table_handler(self) -> RecordTableHandler:
+        raise NotImplementedError
+
+    def register_record_table_handler(self, element_id: str,
+                                      handler: RecordTableHandler) -> None:
+        self.registered[element_id] = handler
+
+    def unregister_record_table_handler(self, element_id: str) -> None:
+        self.registered.pop(element_id, None)
+
+
 class AbstractRecordTable(Table):
     """External store SPI (reference ``record/AbstractRecordTable.java:57``).
 
@@ -367,11 +414,29 @@ class AbstractRecordTable(Table):
         log grows with superseded versions until the store supports this."""
         return False
 
+    # set by the app builder when a RecordTableHandlerManager is installed
+    handler: "RecordTableHandler | None" = None
+
+    def _find_records(self, params: dict, compiled, ts: int) -> list[list]:
+        # no-pushdown scans call record_find with ONE argument, as before
+        # handlers existed — store subclasses may omit the optional
+        # compiled_condition parameter entirely
+        def do(p, c):
+            return self.record_find(p, c) if c is not None \
+                else self.record_find(p)
+        if self.handler is not None:
+            return self.handler.find(ts, params, compiled, do)
+        return do(params, compiled)
+
     def add(self, rows, ts: int = 0) -> None:
-        self.record_add(rows)
+        if self.handler is not None:
+            self.handler.add(ts, rows, self.record_add)
+        else:
+            self.record_add(rows)
 
     def all_events(self, ts: int = 0) -> list[StreamEvent]:
-        return [StreamEvent(ts, list(r)) for r in self.record_find({})]
+        return [StreamEvent(ts, list(r))
+                for r in self._find_records({}, None, ts)]
 
     def _pushdown(self, cond) -> tuple:
         """(compiled_condition | None, params dict) for this lookup."""
@@ -391,9 +456,9 @@ class AbstractRecordTable(Table):
         compiled, param_fns = self._pushdown(cond)
         if compiled is not None:
             # the store pre-filters; rows come back final
-            return self.record_find(self._params(param_fns, out_data, ts),
-                                    compiled)
-        rows = self.record_find({})
+            return self._find_records(
+                self._params(param_fns, out_data, ts), compiled, ts)
+        rows = self._find_records({}, None, ts)
         if cond is None:
             return rows
         return [r for r in rows if cond.fn(TableMatchFrame(r, out_data, ts))]
@@ -401,8 +466,12 @@ class AbstractRecordTable(Table):
     def delete(self, cond, out_data, ts: int = 0) -> int:
         compiled, param_fns = self._pushdown(cond)
         if compiled is not None:
-            return self.record_delete(
-                self._params(param_fns, out_data, ts), compiled)
+            params = self._params(param_fns, out_data, ts)
+            if self.handler is not None:
+                return self.handler.delete(
+                    ts, params, compiled,
+                    lambda p, c: self.record_delete(p, c))
+            return self.record_delete(params, compiled)
         raise NotImplementedError(
             f"store table '{self.id}': delete requires condition pushdown "
             f"(record_compile_condition returned None)")
@@ -435,8 +504,12 @@ class AbstractRecordTable(Table):
                 except Exception:       # noqa: BLE001 — unrelated probe
                     pass                # failure: let the real eval decide
                 values[name] = value_fn(TableMatchFrame(None, out_data, ts))
-            return self.record_update(
-                self._params(param_fns, out_data, ts), values, compiled)
+            params = self._params(param_fns, out_data, ts)
+            if self.handler is not None:
+                return self.handler.update(
+                    ts, params, values, compiled,
+                    lambda p, v, c: self.record_update(p, v, c))
+            return self.record_update(params, values, compiled)
         raise NotImplementedError(
             f"store table '{self.id}': update requires condition pushdown "
             f"(record_compile_condition returned None)")

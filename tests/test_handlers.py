@@ -1,0 +1,241 @@
+"""Handler interception SPIs (reference ``SourceHandler.java`` /
+``SinkHandler.java`` / ``RecordTableHandler.java`` + their managers) and the
+on-demand query plan cache (reference ``SiddhiAppRuntimeImpl.java:129``)."""
+
+import pytest
+
+from siddhi_tpu import (
+    InMemoryBroker,
+    RecordTableHandler,
+    RecordTableHandlerManager,
+    SiddhiManager,
+    SinkHandler,
+    SinkHandlerManager,
+    SourceHandler,
+    SourceHandlerManager,
+    StreamCallback,
+)
+from siddhi_tpu.core.table import AbstractRecordTable
+
+
+# -- source ------------------------------------------------------------------
+
+class _TaggingSourceHandler(SourceHandler):
+    """Transforms rows (doubles v) and drops negatives."""
+
+    def __init__(self):
+        self.seen = []
+
+    def send_event(self, row, input_handler):
+        self.seen.append(list(row))
+        if row[0] < 0:
+            return                      # drop
+        input_handler.send([row[0] * 2])
+
+
+class _SourceMgr(SourceHandlerManager):
+    def __init__(self):
+        super().__init__()
+        self.generated = []
+
+    def generate_source_handler(self, source_type):
+        h = _TaggingSourceHandler()
+        self.generated.append((source_type, h))
+        return h
+
+
+def test_source_handler_intercepts_and_drops():
+    m = SiddhiManager()
+    mgr = _SourceMgr()
+    m.set_source_handler_manager(mgr)
+    rt = m.create_siddhi_app_runtime("""
+        @source(type='inMemory', topic='sh_t', @map(type='passThrough'))
+        define stream S (v int);
+        from S select v insert into O;
+    """, playback=True)
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    InMemoryBroker.publish("sh_t", [5])
+    InMemoryBroker.publish("sh_t", [-1])
+    InMemoryBroker.publish("sh_t", [7])
+    assert [e.data for e in got] == [[10], [14]]
+    handler = mgr.generated[0][1]
+    assert mgr.generated[0][0] == "inMemory"
+    assert handler.seen == [[5], [-1], [7]]
+    assert handler.id in mgr.registered
+    m.shutdown()
+    assert handler.id not in mgr.registered      # unregistered on shutdown
+
+
+def test_source_handlers_unique_per_annotation():
+    """Two @source annotations on one stream generate two handlers with
+    DISTINCT registry ids (review regression: name-derived ids collided and
+    the registry silently dropped one)."""
+    m = SiddhiManager()
+    mgr = _SourceMgr()
+    m.set_source_handler_manager(mgr)
+    rt = m.create_siddhi_app_runtime("""
+        @source(type='inMemory', topic='shu_t1', @map(type='passThrough'))
+        @source(type='inMemory', topic='shu_t2', @map(type='passThrough'))
+        define stream S (v int);
+        from S select v insert into O;
+    """, playback=True)
+    rt.start()
+    assert len(mgr.registered) == 2
+    m.shutdown()
+    assert mgr.registered == {}
+
+
+# -- sink --------------------------------------------------------------------
+
+class _AuditSinkHandler(SinkHandler):
+    def __init__(self):
+        self.audited = []
+
+    def handle(self, event):
+        self.audited.append(list(event.data))
+        if event.data[0] == "skip":
+            return                      # drop before the transport
+        self.callback(event)
+
+
+class _SinkMgr(SinkHandlerManager):
+    def __init__(self):
+        super().__init__()
+        self.generated = []
+
+    def generate_sink_handler(self):
+        h = _AuditSinkHandler()
+        self.generated.append(h)
+        return h
+
+
+def test_sink_handler_intercepts_and_drops():
+    received = []
+    unsub = InMemoryBroker.subscribe("sk_t", received.append)
+    try:
+        m = SiddhiManager()
+        mgr = _SinkMgr()
+        m.set_sink_handler_manager(mgr)
+        rt = m.create_siddhi_app_runtime("""
+            define stream S (w string);
+            @sink(type='inMemory', topic='sk_t', @map(type='passThrough'))
+            define stream O (w string);
+            from S select w insert into O;
+        """, playback=True)
+        rt.start()
+        ih = rt.input_handler("S")
+        ih.send(["a"], timestamp=1)
+        ih.send(["skip"], timestamp=2)
+        ih.send(["b"], timestamp=3)
+        h = mgr.generated[0]
+        assert h.audited == [["a"], ["skip"], ["b"]]
+        assert [list(p.data) for p in received] == [["a"], ["b"]]
+        assert h.id in mgr.registered
+        m.shutdown()
+        assert h.id not in mgr.registered
+    finally:
+        unsub()
+
+
+# -- record table ------------------------------------------------------------
+
+class _MemStore(AbstractRecordTable):
+    def __init__(self, definition, app_context):
+        super().__init__(definition, app_context)
+        self.rows: list[list] = []
+
+    def record_add(self, rows):
+        self.rows.extend(list(r) for r in rows)
+
+    def record_find(self, condition_params, compiled_condition=None):
+        return [list(r) for r in self.rows]
+
+
+class _AuditTableHandler(RecordTableHandler):
+    def __init__(self):
+        self.ops = []
+
+    def add(self, timestamp, rows, do):
+        self.ops.append(("add", [list(r) for r in rows]))
+        return do(rows)
+
+    def find(self, timestamp, params, compiled, do):
+        self.ops.append(("find", dict(params)))
+        return do(params, compiled)
+
+
+class _TableMgr(RecordTableHandlerManager):
+    def __init__(self):
+        super().__init__()
+        self.generated = []
+
+    def generate_record_table_handler(self):
+        h = _AuditTableHandler()
+        self.generated.append(h)
+        return h
+
+
+def test_record_table_handler_audits_ops():
+    m = SiddhiManager()
+    m.set_extension("store:memdb", _MemStore)
+    mgr = _TableMgr()
+    m.set_record_table_handler_manager(mgr)
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (sym string, price double);
+        @store(type='memdb')
+        define table T (sym string, price double);
+        from S select sym, price insert into T;
+    """, playback=True)
+    rt.start()
+    ih = rt.input_handler("S")
+    ih.send(["a", 1.0], timestamp=1)
+    ih.send(["b", 2.0], timestamp=2)
+    rows = rt.query("from T select sym, price")
+    h = mgr.generated[0]
+    kinds = [op for op, _ in h.ops]
+    assert kinds.count("add") == 2
+    assert "find" in kinds
+    assert h.ops[0] == ("add", [["a", 1.0]])
+    assert sorted(e.data for e in rows) == [["a", 1.0], ["b", 2.0]]
+    assert h.id in mgr.registered
+    m.shutdown()
+    assert h.id not in mgr.registered
+
+
+# -- on-demand plan cache ----------------------------------------------------
+
+def test_on_demand_plan_cache_hits():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (v int);
+        define table T (v int);
+        from S select v insert into T;
+    """, playback=True)
+    rt.start()
+    rt.input_handler("S").send([1], timestamp=1)
+    q = "from T select v"
+    assert [e.data for e in rt.query(q)] == [[1]]
+    compiled_first = rt._ondemand_cache[q]
+    rt.input_handler("S").send([2], timestamp=2)
+    # second execution: same cached runtime object, fresh results
+    assert sorted(e.data for e in rt.query(q)) == [[1], [2]]
+    assert rt._ondemand_cache[q] is compiled_first
+    assert len(rt._ondemand_cache) == 1
+    m.shutdown()
+
+
+def test_on_demand_plan_cache_bounded():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (v int);
+        define table T (v int);
+        from S select v insert into T;
+    """, playback=True)
+    rt.start()
+    for i in range(105):
+        rt.query(f"from T on v == {i} select v")
+    # the cache clears past 100 entries instead of growing unboundedly
+    assert len(rt._ondemand_cache) <= 101
+    m.shutdown()
