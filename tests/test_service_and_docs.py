@@ -192,3 +192,51 @@ def test_deploy_conflicts_with_programmatic_runtime():
     code, body = svc.deploy(app)
     assert code == 409, (code, body)
     assert m.runtimes["Shared"] is rt
+
+
+def test_builtin_library_documented():
+    """The standard library documents like the reference's annotated
+    built-ins: every concrete window type and aggregator has a metadata
+    block with a syntax line."""
+    from siddhi_tpu.doc_gen import (
+        BUILTIN_LIBRARY,
+        generate_extension_docs,
+        syntax_for,
+    )
+
+    by_kind = {}
+    for m in BUILTIN_LIBRARY:
+        by_kind.setdefault(m.kind, set()).add(m.name)
+    assert by_kind["window"] >= {
+        "length", "lengthBatch", "time", "timeBatch", "timeLength",
+        "externalTime", "externalTimeBatch", "session", "batch", "delay",
+        "sort", "frequent", "lossyFrequent", "hopping", "cron",
+        "expression", "expressionBatch", "empty"}
+    assert by_kind["aggregator"] >= {
+        "sum", "count", "avg", "min", "max", "distinctCount", "stdDev",
+        "and", "or", "minForever", "maxForever", "unionSet"}
+    sort_meta = next(m for m in BUILTIN_LIBRARY
+                     if m.name == "sort" and m.kind == "window")
+    assert syntax_for(sort_meta).startswith("#window.sort(")
+    md = generate_extension_docs(include_builtins=True)
+    assert "#window.hopping" in md and "stdDev" in md
+
+
+def test_generate_site_tree(tmp_path):
+    from siddhi_tpu.doc_gen import generate_site
+
+    paths = generate_site(str(tmp_path))
+    assert (tmp_path / "mkdocs.yml").exists()
+    idx = (tmp_path / "docs" / "index.md").read_text()
+    assert "[length](window.md#length)" in idx
+    assert "[sum](aggregator.md#sum)" in idx
+    window_page = (tmp_path / "docs" / "window.md").read_text()
+    assert "### hopping" in window_page and "**Parameters**" in window_page
+    assert len(paths) >= 6
+
+
+def test_doc_gen_cli(tmp_path):
+    from siddhi_tpu.doc_gen import main
+
+    assert main(["--out", str(tmp_path / "site")]) == 0
+    assert (tmp_path / "site" / "mkdocs.yml").exists()
