@@ -295,3 +295,74 @@ end;""")
                        ("Stream2", ["IBM", 55.7, 200], 100)],
                  out="OutputStream")
     assert ins == []
+
+
+def test_sequence_partition_strict_per_instance():
+    # SequencePartitionTestCase.testSequencePartitionQuery1: strict
+    # sequence continuity holds WITHIN each key instance — interleaved
+    # arrivals for other keys do not break a partition's sequence
+    app = ("define stream Stream1 (symbol string, price double, volume int);\n"
+           "define stream Stream2 (symbol string, price double, volume int);\n"
+           + """
+partition with (volume of Stream1, volume of Stream2)
+begin
+    from e1=Stream1[price > 20], e2=Stream2[price > e1.price]
+    select e1.symbol as symbol1, e2.symbol as symbol2
+    insert into OutputStream;
+end;""")
+    ins, _ = run(app, [("Stream1", ["WSO2", 55.6, 100], 10),
+                       ("Stream1", ["BIRT", 55.6, 200], 10),
+                       ("Stream2", ["GOOG", 55.7, 200], 10),
+                       ("Stream2", ["IBM", 55.7, 100], 10)],
+                 out="OutputStream")
+    assert sorted(ins) == [["BIRT", "GOOG"], ["WSO2", "IBM"]]
+
+
+ATR = ("define stream cseEventStream (atr1 string, atr2 string, atr3 int, "
+       "atr4 double, atr5 long, atr6 long, atr7 double, atr8 float, "
+       "atr9 bool, atr10 bool, atr11 int);\n")
+
+
+def test_partition_mod_expression_long():
+    # PartitionTestCase2.testModExpressionExecutorLongCase: atr5 % atr6
+    # inside a partition, with cast over a null attribute
+    app = ATR + """
+partition with (atr1 of cseEventStream)
+begin
+    from cseEventStream[atr5 < 700]
+    select atr5 % atr6 as dividedVal, atr5 as threshold, atr1 as symbol,
+           cast(atr2, 'string') as nullable, sum(atr7) as summedValue
+    insert into OutStockStream;
+end;"""
+    rows = [
+        ["IBM", None, 100, 101.0, 500, 20, 11.43, 75.7, False, True, 105],
+        ["WSO2", "aa", 100, 101.0, 501, 206, 15.21, 76.7, False, True, 106],
+        ["IBM", None, 100, 102.0, 502, 202, 45.23, 77.7, False, True, 107],
+        ["ORACLE", None, 100, 101.0, 502, 209, 87.34, 77.7, False, False, 108],
+    ]
+    ins, _ = run(app, [("cseEventStream", r, 10) for r in rows])
+    assert [r[0] for r in ins] == [0, 89, 98, 84]
+    assert ins[0][3] is None and ins[1][3] == "aa"
+    # per-key sums: IBM 11.43 then 11.43+45.23
+    assert ins[2][4] == pytest.approx(56.66)
+
+
+def test_partition_subtract_expression_double():
+    # PartitionTestCase2.testSubtractExpressionExecutorDoubleCase
+    app = ATR + """
+partition with (atr1 of cseEventStream)
+begin
+    from cseEventStream[atr5 < 700]
+    select atr4 - atr7 as dividedVal, atr5 as threshold, atr1 as symbol,
+           sum(atr7) as summedValue
+    insert into OutStockStream;
+end;"""
+    rows = [
+        ["IBM", None, 100, 101.0, 500, 200, 11.43, 75.7, False, True, 105],
+        ["WSO2", "aa", 100, 101.0, 501, 201, 15.21, 76.7, False, True, 106],
+        ["IBM", None, 100, 102.0, 502, 202, 45.23, 77.7, False, True, 107],
+        ["ORACLE", None, 100, 101.0, 502, 202, 87.34, 77.7, False, False, 108],
+    ]
+    ins, _ = run(app, [("cseEventStream", r, 10) for r in rows])
+    assert [r[0] for r in ins] == pytest.approx(
+        [89.57, 85.78999999999999, 56.77, 13.659999999999997])
