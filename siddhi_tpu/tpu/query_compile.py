@@ -288,6 +288,77 @@ class CompiledStreamQuery:
                     self.sort_key = skey
                     self.sort_key_type = skt
                     self.sort_desc = order == "desc"
+                elif h.name in ("frequent", "lossyFrequent"):
+                    # Misra-Gries / lossy-counting heavy hitters: a carried
+                    # key-counter table walked by a lax.scan (every event's
+                    # behavior depends on the table its predecessors left)
+                    def fconst(idx: int) -> float:
+                        if len(h.params) <= idx or \
+                                not hasattr(h.params[idx], "value"):
+                            raise DeviceCompileError(
+                                f"window '{h.name}' needs a constant "
+                                f"parameter at position {idx}")
+                        return float(h.params[idx].value)
+
+                    if h.name == "frequent":
+                        cap = const_param(0)
+                        if cap < 1:
+                            # a zero-capacity Misra-Gries table never emits
+                            # on the host; the generic max(N,1) clamp would
+                            # silently turn it into a 1-slot table
+                            raise DeviceCompileError(
+                                "frequent window count must be >= 1")
+                        key_params = list(h.params[1:])
+                    else:
+                        from ..query_api import Constant as _Konst
+                        self.lossy_support = fconst(0)
+                        nxt = 1
+                        if len(h.params) > 1 \
+                                and isinstance(h.params[1], _Konst) \
+                                and not isinstance(h.params[1].value, str):
+                            self.lossy_error = fconst(1)
+                            nxt = 2
+                        else:
+                            self.lossy_error = self.lossy_support / 10.0
+                        if self.lossy_error <= 0:
+                            raise DeviceCompileError(
+                                "lossyFrequent error bound must be positive")
+                        # the host dict is unbounded; worst-case live
+                        # entries exceed 1/error, so honor the
+                        # @device(window='N') capacity knob (the overflow
+                        # warning tells operators to raise exactly that)
+                        cap = min(65536,
+                                  max(int(1.0 / self.lossy_error) + 64,
+                                      window_capacity))
+                        key_params = list(h.params[nxt:])
+                    if not key_params:
+                        from ..query_api import Variable as _Var
+                        key_params = [
+                            _Var(attribute=a.name)
+                            for a in definition.attributes]
+                    if len(key_params) > 2:
+                        raise DeviceCompileError(
+                            f"{h.name} with >2 key attributes takes the "
+                            f"host path")
+                    self.hh_keys = []
+                    for kp in key_params:
+                        if not isinstance(kp, Variable):
+                            raise DeviceCompileError(
+                                f"{h.name} key must be an attribute")
+                        kk, kt = resolver.resolve(kp)
+                        allowed = (DataType.STRING, DataType.INT) \
+                            if len(key_params) == 2 \
+                            else (DataType.STRING, DataType.INT,
+                                  DataType.LONG)
+                        if kt not in allowed:
+                            # exact key identity is required (hash
+                            # collisions would corrupt counts)
+                            raise DeviceCompileError(
+                                f"{h.name} key '{kk}' type takes the host "
+                                f"path")
+                        self.hh_keys.append(kk)
+                    self.window_kind = h.name
+                    self.window_n = cap
                 elif h.name == "hopping":
                     # hopping(duration, hop): overlapping tumbling buckets;
                     # flushes are event-driven on device like timeBatch
@@ -317,7 +388,7 @@ class CompiledStreamQuery:
             self.group_key_types.append(kt)
         if self.group_keys and self.window_kind in (
                 "lengthBatch", "timeBatch", "session", "batch", "sort",
-                "hopping"):
+                "hopping", "frequent", "lossyFrequent"):
             raise DeviceCompileError(
                 f"group-by with {self.window_kind} windows takes the host "
                 f"path")
@@ -393,6 +464,14 @@ class CompiledStreamQuery:
             # over a delayed stream keep host semantics
             raise DeviceCompileError(
                 "aggregates/group-by over a delay window take the host path")
+        if self.window_kind in ("frequent", "lossyFrequent") and \
+                (self.magg_idx or self.sagg_idx):
+            # heavy-hitter evictions retract via the evicted key's LAST
+            # value — sums/counts/avgs roll back exactly, but min/max/stdDev
+            # would need the host's multiset bookkeeping
+            raise DeviceCompileError(
+                f"min/max/stdDev over {self.window_kind} windows take the "
+                f"host path")
         if self.window_kind == "hopping" and not self.agg_idx:
             # non-aggregated hopping re-emits every buffered event per flush
             # (output cardinality ~ duration/hop per event) — host path
@@ -458,6 +537,19 @@ class CompiledStreamQuery:
             for i in self.value_idx:
                 state[f"tail_proj_{i}"] = jnp.zeros(
                     (N,), dtype=_JNP_DTYPES[self.specs[i].dtype])
+        if self.window_kind in ("frequent", "lossyFrequent"):
+            C = N
+            state["hh_keys"] = jnp.zeros((C,), dtype=jnp.int64)
+            state["hh_counts"] = jnp.zeros((C,), dtype=jnp.int64)
+            state["hh_fvals"] = jnp.zeros((AF, C), dtype=FACC)
+            state["hh_ivals"] = jnp.zeros((AI, C), dtype=_IACC)
+            state["hh_run_f"] = jnp.zeros((AF,), dtype=FACC)
+            state["hh_run_i"] = jnp.zeros((AI,), dtype=_IACC)
+            state["hh_run_cnt"] = jnp.zeros((), dtype=jnp.int64)
+            if self.window_kind == "lossyFrequent":
+                state["hh_delta"] = jnp.zeros((C,), dtype=jnp.int64)
+                state["hh_total"] = jnp.zeros((), dtype=jnp.int64)
+                state["window_drops"] = jnp.zeros((), dtype=jnp.int64)
         if self.window_kind == "sort":
             kdt = _JNP_DTYPES[self.sort_key_type]
             # empty slots sort at +inf (after every real key, desc keys are
@@ -519,6 +611,9 @@ class CompiledStreamQuery:
         window_kind, N = self.window_kind, max(self.window_n, 1)
         window_ms, time_key = self.window_ms, self.time_key
         hop_ms = getattr(self, "hop_ms", 0)
+        hh_keys = getattr(self, "hh_keys", [])
+        hh_support = getattr(self, "lossy_support", 0.0)
+        hh_error = getattr(self, "lossy_error", 0.0)
         sort_key = getattr(self, "sort_key", None)
         sort_desc = getattr(self, "sort_desc", False)
         sort_kdt = _JNP_DTYPES[self.sort_key_type] \
@@ -746,6 +841,23 @@ class CompiledStreamQuery:
                     state, value_idx, av_f, av_i, av_s, av_m, magg_idx,
                     m_ismin, ones_c, proj_c, wts, k, N, B,
                     window_ms, hop_ms, finish)
+
+            if window_kind in ("frequent", "lossyFrequent"):
+                k64 = [compact(cols[kk].astype(jnp.int64))
+                       for kk in hh_keys]
+                if len(k64) == 2:
+                    kcode = (k64[0] << 32) | (k64[1] & 0xFFFFFFFF)
+                else:
+                    kcode = k64[0]
+                new_state, emit, sums_f, sums_i, cnts = _heavy_hitters(
+                    state, kcode, av_f, av_i, k, N, B,
+                    lossy=(window_kind == "lossyFrequent"),
+                    support=hh_support, error=hh_error)
+                return finish(new_state, sums_f, sums_i, cnts, {},
+                              jnp.zeros((0, B), FACC),
+                              ovalid=out_valid & emit,
+                              count=jnp.sum((out_valid & emit)
+                                            .astype(jnp.int32)))
 
             if window_kind == "delay":
                 # pass-through after a fixed delay: hold rows until the
@@ -1518,6 +1630,135 @@ def _hopping_flushes(state, value_idx, av_f, av_i, av_s, av_m, magg_idx,
     return finish(new_state, sums_f, sums_i, cnts, mins, svars,
                   ovalid=ovalid, ots=t_f, proj=proj_fl,
                   count=jnp.sum(ovalid.astype(jnp.int32)))
+
+
+def _heavy_hitters(state, kcode, av_f, av_i, k, C, B, lossy, support, error):
+    """frequent / lossyFrequent device kernels (reference
+    ``FrequentWindowProcessor`` — classic Misra-Gries — and
+    ``LossyFrequentWindowProcessor``): a carried [C]-slot key/counter table
+    walked by a ``lax.scan`` over the batch.
+
+    Aggregation semantics match the host exactly: every EMITTED current
+    event adds to the running aggregates; an eviction/prune retracts the
+    evicted key's LAST event values (the host expires that StreamEvent).
+    The emitted row shows the aggregates after its own add, before any
+    same-event evictions land — the selector builds the current row before
+    processing the expired chunk."""
+    carry0 = {
+        "keys": state["hh_keys"], "counts": state["hh_counts"],
+        "f": state["hh_fvals"], "i": state["hh_ivals"],
+        "run_f": state["hh_run_f"], "run_i": state["hh_run_i"],
+        "run_cnt": state["hh_run_cnt"],
+    }
+    if lossy:
+        carry0["delta"] = state["hh_delta"]
+        carry0["total"] = state["hh_total"]
+        carry0["drops"] = state["window_drops"]
+
+    slots = jnp.arange(C)
+
+    def set_slot(table, idx, v):
+        return jnp.where(slots == idx, v, table)
+
+    def set_lane(table, idx, vals):            # [A, C] ← [A]
+        if not table.shape[0]:
+            return table
+        return jnp.where(slots[None, :] == idx, vals[:, None], table)
+
+    def body(carry, x):
+        accept, key, vf, vi = x["accept"], x["key"], x["f"], x["i"]
+        occ = carry["counts"] > 0
+        hit = occ & (carry["keys"] == key)
+        has = jnp.any(hit)
+        has_space = jnp.any(~occ)
+
+        # shared hit/insert bookkeeping (the branches differ only in the
+        # full-table miss handling: decrement-all vs drop)
+        insert = (~has) & has_space
+        idx = jnp.where(has, jnp.argmax(hit), jnp.argmax(~occ))
+        upd = accept & (has | insert)
+        counts = carry["counts"]
+        counts = jnp.where(accept & has & hit, counts + 1, counts)
+        counts = jnp.where((accept & insert) & (slots == idx), 1, counts)
+
+        if not lossy:
+            emit = accept & (has | insert)
+            # Misra-Gries decrement-all; slots reaching zero evict and
+            # retract their last event from the running aggregates
+            dec = accept & (~has) & (~has_space)
+            dec_counts = jnp.maximum(counts - 1, 0)
+            evicted = dec & occ & (dec_counts == 0)
+            counts = jnp.where(dec, jnp.where(occ, dec_counts, counts),
+                               counts)
+            new_total = carry.get("total")
+            new_delta = carry.get("delta")
+            new_drops = carry.get("drops")
+        else:
+            total = carry["total"] + jnp.where(accept, 1, 0)
+            bucket = (total.astype(jnp.float64) * error).astype(jnp.int64) + 1
+            dropped = accept & (~has) & (~has_space)
+            delta = jnp.where((accept & insert) & (slots == idx),
+                              bucket - 1, carry["delta"])
+            entry_f = counts[idx]
+            entry_d = delta[idx]
+            emit = accept & (has | insert) & (
+                (entry_f + entry_d).astype(jnp.float64)
+                >= total.astype(jnp.float64) * support)
+            # prune pass (host prunes after the emission decision): every
+            # entry with f + delta <= bucket-1 expires and retracts
+            evicted = occ & accept & ((counts + delta) <= bucket - 1)
+            # the slot being updated this event is occupied NOW even if it
+            # was free before — include it in the occupancy for pruning
+            evicted = evicted | (upd & (slots == idx)
+                                 & ((counts + delta) <= bucket - 1))
+            counts = jnp.where(evicted, 0, counts)
+            new_total = total
+            new_delta = delta
+            new_drops = carry["drops"] + jnp.where(dropped, 1, 0)
+
+        # last-event value lanes for the touched slot
+        nf = jnp.where(upd, set_lane(carry["f"], idx, vf), carry["f"]) \
+            if carry["f"].shape[0] else carry["f"]
+        ni = jnp.where(upd, set_lane(carry["i"], idx, vi), carry["i"]) \
+            if carry["i"].shape[0] else carry["i"]
+
+        # running aggregates: add the emitted event, then retract evictions
+        run_f = carry["run_f"] + jnp.where(emit, vf, 0.0)
+        run_i = carry["run_i"] + jnp.where(emit, vi, 0)
+        run_cnt = carry["run_cnt"] + jnp.where(emit, 1, 0)
+        out_f, out_i, out_cnt = run_f, run_i, run_cnt
+        if carry["f"].shape[0]:
+            run_f = run_f - jnp.sum(
+                jnp.where(evicted[None, :], nf, 0.0), axis=1)
+        if carry["i"].shape[0]:
+            run_i = run_i - jnp.sum(
+                jnp.where(evicted[None, :], ni, 0), axis=1)
+        n_evicted = jnp.sum(evicted.astype(jnp.int64))
+        run_cnt = run_cnt - n_evicted
+
+        new_carry = {"keys": set_slot(carry["keys"], idx,
+                                      jnp.where(upd, key,
+                                                carry["keys"][idx])),
+                     "counts": counts, "f": nf, "i": ni,
+                     "run_f": run_f, "run_i": run_i, "run_cnt": run_cnt}
+        if lossy:
+            new_carry["delta"] = new_delta
+            new_carry["total"] = new_total
+            new_carry["drops"] = new_drops
+        return new_carry, (emit, out_f, out_i, out_cnt)
+
+    xs = {"accept": jnp.arange(B) < k, "key": kcode,
+          "f": av_f.T, "i": av_i.T}
+    carry, (emit, ys_f, ys_i, ys_c) = jax.lax.scan(body, carry0, xs)
+    new_state = {**state, "hh_keys": carry["keys"],
+                 "hh_counts": carry["counts"], "hh_fvals": carry["f"],
+                 "hh_ivals": carry["i"], "hh_run_f": carry["run_f"],
+                 "hh_run_i": carry["run_i"], "hh_run_cnt": carry["run_cnt"]}
+    if lossy:
+        new_state["hh_delta"] = carry["delta"]
+        new_state["hh_total"] = carry["total"]
+        new_state["window_drops"] = carry["drops"]
+    return new_state, emit, ys_f.T, ys_i.T, ys_c
 
 
 def _materialize(specs, value_idx, fagg_idx, iagg_idx, magg_idx, sagg_idx,

@@ -55,11 +55,11 @@ def test_device_output_chains_into_host_query(manager):
 
 
 def test_device_fallback_to_host(manager):
-    # frequent windows aren't device kernels → silently built on host path
+    # expression windows aren't device kernels → silently built on host path
     rt, got = setup(manager, """
         define stream S (v long);
         @device
-        from S#window.frequent(3) select sum(v) as s insert into O;
+        from S#window.expression('count() <= 3') select sum(v) as s insert into O;
     """)
     rt.input_handler("S").send([7], timestamp=1000)
     assert [e.data for e in got] == [[7]]
@@ -71,7 +71,7 @@ def test_device_strict_raises(manager):
         manager.create_siddhi_app_runtime("""
             define stream S (v long);
             @device(strict='true')
-            from S#window.frequent(3) select sum(v) as s insert into O;
+            from S#window.expression('count() <= 3') select sum(v) as s insert into O;
         """, playback=True)
 
 

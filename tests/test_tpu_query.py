@@ -246,7 +246,7 @@ def test_unsupported_falls_back_cleanly():
     with pytest.raises(DeviceCompileError):
         DeviceStreamRuntime("""
         define stream S (v long);
-        from S#window.frequent(5) select sum(v) as s insert into O;
+        from S#window.cron('*/2 * * * * ?') select sum(v) as s insert into O;
         """)
     with pytest.raises(DeviceCompileError):
         DeviceStreamRuntime("""
@@ -772,6 +772,74 @@ def test_parity_hopping_small_batches():
 def test_parity_hopping_sparse():
     # long gaps: many whole hops between events (deferred-flush drain path)
     assert_parity_ts(APP_HOPPING, _ts_rows(30, 26, 4000), batch_capacity=4)
+
+
+APP_FREQUENT = """
+define stream S (sym string, v long);
+from S#window.frequent(4, sym)
+select sym, v, sum(v) as s, count() as c, avg(v) as a insert into O;
+"""
+
+APP_LOSSY = """
+define stream S (sym string, v long);
+from S#window.lossyFrequent(0.3, 0.05, sym)
+select sym, v, sum(v) as s, count() as c insert into O;
+"""
+
+
+def _hh_rows(n, seed, keys="abcdefgh"):
+    rng = random.Random(seed)
+    return [[rng.choice(keys), rng.randrange(100)] for _ in range(n)]
+
+
+def test_parity_frequent():
+    # Misra-Gries: hits/inserts emit, decrement-all evictions retract the
+    # evicted key's LAST event from the running aggregates (host chunk
+    # order: [current, expired])
+    assert_parity(APP_FREQUENT, _hh_rows(200, 41), batch_capacity=32)
+
+
+def test_parity_frequent_small_batches():
+    assert_parity(APP_FREQUENT, _hh_rows(150, 42), batch_capacity=8)
+
+
+def test_parity_frequent_two_key():
+    app = """
+    define stream S (sym string, v int);
+    from S#window.frequent(3, sym, v) select sym, v, count() as c
+    insert into O;
+    """
+    rng = random.Random(43)
+    rows = [[rng.choice("ab"), rng.randrange(3)] for _ in range(120)]
+    assert_parity(app, rows, batch_capacity=16)
+
+
+def test_parity_lossy_frequent():
+    assert_parity(APP_LOSSY, _hh_rows(200, 44), batch_capacity=32)
+
+
+def test_parity_lossy_frequent_default_error():
+    app = """
+    define stream S (sym string, v long);
+    from S#window.lossyFrequent(0.25, sym) select sym, sum(v) as s
+    insert into O;
+    """
+    assert_parity(app, _hh_rows(120, 45, keys="abcd"), batch_capacity=8)
+
+
+def test_heavy_hitter_host_only_shapes():
+    with pytest.raises(DeviceCompileError):
+        # min/max retraction needs the host's multiset bookkeeping
+        DeviceStreamRuntime("""
+        define stream S (sym string, v long);
+        from S#window.frequent(3, sym) select sym, max(v) as m insert into O;
+        """)
+    with pytest.raises(DeviceCompileError):
+        # >2 key attributes take the host path
+        DeviceStreamRuntime("""
+        define stream S (a string, b string, c string);
+        from S#window.frequent(3, a, b, c) select a insert into O;
+        """)
 
 
 def test_parity_batch_chunk_aligned():
