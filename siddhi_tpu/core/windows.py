@@ -633,12 +633,19 @@ class FrequentWindow(WindowProcessor):
                 self.counts[key] = [1, ev]
                 out.append(ev)
             else:
-                # decrement all; evict zeros (classic Misra-Gries)
+                # decrement all; evict zeros — and if the pass freed a
+                # slot, the NEW event takes it and emits (reference
+                # FrequentWindowProcessor tentatively inserts, decrements
+                # the old keys, and only drops the arrival when nothing
+                # evicted)
                 for k in list(self.counts):
                     self.counts[k][0] -= 1
                     if self.counts[k][0] <= 0:
                         out.append(self._expired(self.counts[k][1], ev.timestamp))
                         del self.counts[k]
+                if len(self.counts) < self.count:
+                    self.counts[key] = [1, ev]
+                    out.append(ev)
         self.forward(out)
 
     def find_events(self) -> list[StreamEvent]:

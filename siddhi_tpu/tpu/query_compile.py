@@ -1682,14 +1682,21 @@ def _heavy_hitters(state, kcode, av_f, av_i, k, C, B, lossy, support, error):
         counts = jnp.where((accept & insert) & (slots == idx), 1, counts)
 
         if not lossy:
-            emit = accept & (has | insert)
             # Misra-Gries decrement-all; slots reaching zero evict and
-            # retract their last event from the running aggregates
+            # retract their last event from the running aggregates. If the
+            # pass freed a slot, the NEW key takes the first evicted one
+            # and emits (reference FrequentWindowProcessor tentatively
+            # inserts and only drops the arrival when nothing evicted)
             dec = accept & (~has) & (~has_space)
             dec_counts = jnp.maximum(counts - 1, 0)
             evicted = dec & occ & (dec_counts == 0)
+            dec_ins = dec & jnp.any(evicted)
+            idx = jnp.where(dec_ins, jnp.argmax(evicted), idx)
+            upd = upd | dec_ins
+            emit = accept & (has | insert) | dec_ins
             counts = jnp.where(dec, jnp.where(occ, dec_counts, counts),
                                counts)
+            counts = jnp.where(dec_ins & (slots == idx), 1, counts)
             new_total = carry.get("total")
             new_delta = carry.get("delta")
             new_drops = carry.get("drops")
@@ -1722,19 +1729,39 @@ def _heavy_hitters(state, kcode, av_f, av_i, k, C, B, lossy, support, error):
         ni = jnp.where(upd, set_lane(carry["i"], idx, vi), carry["i"]) \
             if carry["i"].shape[0] else carry["i"]
 
-        # running aggregates: add the emitted event, then retract evictions
-        run_f = carry["run_f"] + jnp.where(emit, vf, 0.0)
-        run_i = carry["run_i"] + jnp.where(emit, vi, 0)
-        run_cnt = carry["run_cnt"] + jnp.where(emit, 1, 0)
-        out_f, out_i, out_cnt = run_f, run_i, run_cnt
-        if carry["f"].shape[0]:
-            run_f = run_f - jnp.sum(
-                jnp.where(evicted[None, :], nf, 0.0), axis=1)
-        if carry["i"].shape[0]:
-            run_i = run_i - jnp.sum(
-                jnp.where(evicted[None, :], ni, 0), axis=1)
+        # running aggregates. Chunk order differs per window: the frequent
+        # host appends evictions BEFORE the dec-inserted current (retract
+        # the evicted keys' OLD last values, then add — the emitted row
+        # sees the post-retraction state), while the lossy host emits the
+        # current FIRST and prunes after (the row sees pre-prune state, and
+        # a prune can expire the just-updated entry, so it retracts the
+        # post-update lanes).
         n_evicted = jnp.sum(evicted.astype(jnp.int64))
-        run_cnt = run_cnt - n_evicted
+        if not lossy:
+            run_f, run_i = carry["run_f"], carry["run_i"]
+            if carry["f"].shape[0]:
+                run_f = run_f - jnp.sum(
+                    jnp.where(evicted[None, :], carry["f"], 0.0), axis=1)
+            if carry["i"].shape[0]:
+                run_i = run_i - jnp.sum(
+                    jnp.where(evicted[None, :], carry["i"], 0), axis=1)
+            run_cnt = carry["run_cnt"] - n_evicted
+            run_f = run_f + jnp.where(emit, vf, 0.0)
+            run_i = run_i + jnp.where(emit, vi, 0)
+            run_cnt = run_cnt + jnp.where(emit, 1, 0)
+            out_f, out_i, out_cnt = run_f, run_i, run_cnt
+        else:
+            run_f = carry["run_f"] + jnp.where(emit, vf, 0.0)
+            run_i = carry["run_i"] + jnp.where(emit, vi, 0)
+            run_cnt = carry["run_cnt"] + jnp.where(emit, 1, 0)
+            out_f, out_i, out_cnt = run_f, run_i, run_cnt
+            if carry["f"].shape[0]:
+                run_f = run_f - jnp.sum(
+                    jnp.where(evicted[None, :], nf, 0.0), axis=1)
+            if carry["i"].shape[0]:
+                run_i = run_i - jnp.sum(
+                    jnp.where(evicted[None, :], ni, 0), axis=1)
+            run_cnt = run_cnt - n_evicted
 
         new_carry = {"keys": set_slot(carry["keys"], idx,
                                       jnp.where(upd, key,

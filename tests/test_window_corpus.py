@@ -180,6 +180,28 @@ insert all events into out;""",
             # pairs: (e1,e1) at t1; (e2,e1),(e1,e2)... reference expects both
             # cross pairs + self pairs = 4 in events
             4, 4, end=2000),
+    # ---------------- FrequentWindowTestCase ------------------------------
+    # frequentUniqueWindowTest1: frequent(2), whole-row keys, 2 rounds of 4
+    # distinct rows — every round after the table fills decrements/evicts
+    _counts("frequent1", """
+define stream purchase (cardNo string, price double);
+@info(name='q') from purchase[price >= 30]#window.frequent(2)
+select cardNo, price insert all events into out;""",
+            [("purchase", [c, p], 10) for _ in range(2) for c, p in
+             [("3234-3244-2432-4124", 73.36), ("1234-3244-2432-123", 46.36),
+              ("5768-3244-2432-5646", 48.36), ("9853-3244-2432-4125", 78.36)]],
+            8, 6),
+    # frequentUniqueWindowTest2: keyed frequent(2, cardNo) — the two hot
+    # cards always occupy the table; the third card's arrivals only decrement
+    _counts("frequent2", """
+define stream purchase (cardNo string, price double);
+@info(name='q') from purchase[price >= 30]#window.frequent(2, cardNo)
+select cardNo, price insert all events into out;""",
+            [("purchase", [c, p], 10) for _ in range(2) for c, p in
+             [("3234-3244-2432-4124", 73.36), ("1234-3244-2432-123", 46.36),
+              ("3234-3244-2432-4124", 78.36), ("1234-3244-2432-123", 86.36),
+              ("5768-3244-2432-5646", 48.36)]],
+            8, 0),
 ]
 
 
