@@ -397,6 +397,16 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                 # limiter; the device join emits CURRENT rows only
                 raise DeviceCompileError(
                     "output rate limiting on joins takes the host path")
+            from ..query_api import OutputRateType
+            if sel is not None and sel.group_by and \
+                    query.output_rate.type in (OutputRateType.FIRST,
+                                               OutputRateType.LAST):
+                # grouped first/last emit PER KEY per batch (reference
+                # FirstGroupByPerEventOutputRateLimiter); device rows do
+                # not carry group keys through the limiter
+                raise DeviceCompileError(
+                    "group-by with first/last output rate limiting takes "
+                    "the host path")
         if not isinstance(query.output_stream, InsertIntoStream):
             raise DeviceCompileError(
                 "device path handles insert-into-stream outputs only")

@@ -21,14 +21,19 @@ class EventType(enum.Enum):
 
 
 class StreamEvent:
-    """A single event within the engine."""
+    """A single event within the engine.
 
-    __slots__ = ("timestamp", "data", "type")
+    ``group_key`` rides along on SELECTOR OUTPUT events of group-by queries
+    (reference ``GroupedComplexEvent``): grouped first/last output rate
+    limiters batch per key, not per event stream."""
+
+    __slots__ = ("timestamp", "data", "type", "group_key")
 
     def __init__(self, timestamp: int, data: list, type: EventType = EventType.CURRENT):
         self.timestamp = timestamp
         self.data = data
         self.type = type
+        self.group_key = None
 
     def copy(self) -> "StreamEvent":
         return StreamEvent(self.timestamp, list(self.data), self.type)

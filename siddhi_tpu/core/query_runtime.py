@@ -343,7 +343,8 @@ def build_query_runtime(query: Query, app_context, stream_defs: dict,
     out_types = selector.output_types
     rt.output_schema = (out_names, out_types)
 
-    limiter = build_rate_limiter(query.output_rate, app_context)
+    limiter = build_rate_limiter(query.output_rate, app_context,
+                                 grouped=bool(query.selector.group_by))
     app_context.register_state(app_context.element_id(f"{qid}-ratelimit"), limiter)
     selector.next = limiter
 

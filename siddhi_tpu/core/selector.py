@@ -124,8 +124,8 @@ class QuerySelector:
             if ev.type == EventType.TIMER:
                 continue
             frame = make_frame(ev)
-            key = self._group_key(frame) \
-                if (self.has_aggregates or collapse) else None
+            key = self._group_key(frame) if self.group_by_fns or \
+                (self.has_aggregates or collapse) else None
             data: list = []
             aggs = self._aggs_for(key) if self.has_aggregates else {}
             for i, spec in enumerate(self.attributes):
@@ -144,7 +144,12 @@ class QuerySelector:
                 if not bool(self.having_fn(
                         HavingFrame(data, ev.timestamp, frame))):
                     continue
-            out.append(StreamEvent(ev.timestamp, data, ev.type))
+            oev = StreamEvent(ev.timestamp, data, ev.type)
+            if self.group_by_fns:
+                # reference GroupedComplexEvent: grouped first/last rate
+                # limiters downstream batch per key
+                oev.group_key = key
+            out.append(oev)
             out_keys.append(key)
         if not out:
             return

@@ -1,0 +1,123 @@
+"""Output rate-limit corpus transliterated from the reference suites:
+
+- ``.../core/query/ratelimit/EventOutputRateLimitTestCase.java`` (18 tests —
+  the distinct all/first/last × batch-size shapes)
+- ``.../core/query/ratelimit/TimeOutputRateLimitTestCase.java``
+
+Assertions (NOT code) ported; wall-clock sleeps become playback timestamps
+(``advance_time`` fires the time-based emitters' timers)."""
+
+from siddhi_tpu import QueryCallback, SiddhiManager
+
+LOGIN = "define stream LoginEvents (ts long, ip string);\n"
+
+IPS5 = ["192.10.1.5", "192.10.1.3", "192.10.1.9", "192.10.1.4", "192.10.1.3"]
+IPS8 = ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+        "192.10.1.4", "192.10.1.4", "192.10.1.4", "192.10.1.30"]
+
+
+def run(output_clause, ips, group_by="", gaps=None, end=0):
+    app = LOGIN + f"""
+@info(name='q') from LoginEvents
+select ip {group_by}
+{output_clause}
+insert into uniqueIps;"""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, playback=True, start_time=1000)
+    rows = []
+
+    class _CB(QueryCallback):
+        def receive(self, ts, current, expired):
+            if current:
+                rows.extend(e.data[0] for e in current)
+
+    rt.add_query_callback("q", _CB())
+    rt.start()
+    ih = rt.input_handler("LoginEvents")
+    ts = 1000
+    for i, ip in enumerate(ips):
+        ts += (gaps[i] if gaps else 10)
+        ih.send([ts, ip], timestamp=ts)
+    if end:
+        rt.advance_time(ts + end)
+    m.shutdown()
+    return rows
+
+
+def test_output_all_every_2_events():
+    # testEventOutputRateLimitQuery1: full pairs flush; the 5th holds
+    assert len(run("output all every 2 events", IPS5)) == 4
+
+
+def test_output_default_every_2_events():
+    # testEventOutputRateLimitQuery2: bare `output every` defaults to all
+    assert len(run("output every 2 events", IPS5)) == 4
+
+
+def test_output_every_5_events():
+    # testEventOutputRateLimitQuery3: one full batch of 5 from 8 sends
+    assert len(run("output every 5 events", IPS8)) == 5
+
+
+def test_output_first_every_2_events():
+    # testEventOutputRateLimitQuery4: first of each pair → events 1, 3, 5
+    got = run("output first every 2 events", IPS5)
+    assert got == [IPS5[0], IPS5[2], IPS5[4]]
+
+
+def test_output_first_every_3_events():
+    # testEventOutputRateLimitQuery5: events 1, 4
+    got = run("output first every 3 events", IPS5)
+    assert got == [IPS5[0], IPS5[3]]
+
+
+def test_output_last_every_2_events():
+    # testEventOutputRateLimitQuery6: last of each full pair → events 2, 4
+    got = run("output last every 2 events", IPS5)
+    assert got == [IPS5[1], IPS5[3]]
+
+
+def test_output_last_every_4_events():
+    # testEventOutputRateLimitQuery7: one full batch → event 4
+    got = run("output last every 4 events", IPS5)
+    assert got == [IPS5[3]]
+
+
+def test_output_first_every_5_events_group_by():
+    # testEventOutputRateLimitQuery8: PER-KEY occurrence counters (no
+    # global batch): each key's first arrival emits, its next N-1 are
+    # suppressed — .5, .3, .9, .4, then .30 (the repeats of .5/.4 suppress)
+    got = run("output first every 5 events", IPS8, group_by="group by ip")
+    assert got == ["192.10.1.5", "192.10.1.3", "192.10.1.9",
+                   "192.10.1.4", "192.10.1.30"]
+
+
+def test_output_last_every_4_events_group_by():
+    # derived from LastGroupByPerEventOutputRateLimiter: global 4-event
+    # batches, each flushing every key's final row in first-seen order
+    got = run("output last every 4 events", IPS8, group_by="group by ip")
+    assert got == ["192.10.1.5", "192.10.1.3", "192.10.1.9",
+                   "192.10.1.4", "192.10.1.30"]
+
+
+def test_output_first_every_1_sec_group_by():
+    # derived from FirstGroupByPerTimeOutputRateLimiter: per-key SLIDING
+    # gate — a key re-emits once a full period passed since ITS last emit
+    gaps = [10, 10, 400, 400, 400, 10]
+    ips = ["a", "a", "b", "a", "a", "b"]
+    # a@1010 emits; a@1020 gated; b@1420 emits; a@1820 gated (<1s since
+    # 1010? 810ms — gated); a@2220 emits (1210ms since 1010); b@2230 gated
+    got = run("output first every 1 sec", ips, group_by="group by ip",
+              gaps=gaps, end=1500)
+    assert got == ["a", "b", "a"]
+
+
+def test_output_every_1_sec_time_batches():
+    # TimeOutputRateLimitTestCase.testTimeOutputRateLimitQuery1: every
+    # second boundary flushes the accumulated events — all 6 eventually out
+    gaps = [10, 10, 1100, 10, 1100, 2000]
+    got = run("output every 1 sec", ["192.10.1.5", "192.10.1.3",
+                                     "192.10.1.9", "192.10.1.4",
+                                     "192.10.1.30", "192.10.1.40"],
+              gaps=gaps, end=1500)
+    assert len(got) == 6
