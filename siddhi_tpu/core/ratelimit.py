@@ -190,14 +190,18 @@ class TimeRateLimiter(PassThroughRateLimiter):
 
 
 class SnapshotRateLimiter(PassThroughRateLimiter):
-    """`output snapshot every <time>` — emits the latest value (per group when the
-    output has repeating keys is approximated by last event) each period."""
+    """``output snapshot every <time>`` — each period emits the latest
+    output value; for group-by queries, EVERY group's latest row in
+    first-seen order (reference ``WrappedSnapshotOutputRateLimiter``'s
+    per-group snapshot limiters)."""
 
-    def __init__(self, period_ms: int, app_context):
+    def __init__(self, period_ms: int, app_context, grouped: bool = False):
         super().__init__()
         self.period = period_ms
         self.app_context = app_context
+        self.grouped = grouped
         self.latest: Optional[StreamEvent] = None
+        self.latest_by_key: dict = {}
         self.window_end: Optional[int] = None
 
     def process(self, events: list[StreamEvent]) -> None:
@@ -206,11 +210,17 @@ class SnapshotRateLimiter(PassThroughRateLimiter):
                 self.window_end = ev.timestamp + self.period
                 self.app_context.scheduler.notify_at(self.window_end, self._on_timer)
             if ev.type == EventType.CURRENT:
-                self.latest = ev
+                if self.grouped:
+                    self.latest_by_key[ev.group_key] = ev
+                else:
+                    self.latest = ev
 
     def _on_timer(self, ts: int) -> None:
         out = []
-        if self.latest is not None:
+        if self.grouped:
+            out = [StreamEvent(ts, e.data, EventType.CURRENT)
+                   for e in self.latest_by_key.values()]
+        elif self.latest is not None:
             out = [StreamEvent(ts, self.latest.data, EventType.CURRENT)]
         self.window_end = ts + self.period
         self.app_context.scheduler.notify_at(self.window_end, self._on_timer)
@@ -227,5 +237,5 @@ def build_rate_limiter(output_rate, app_context, grouped: bool = False):
         return TimeRateLimiter(output_rate.value_ms, output_rate.type,
                                app_context, grouped)
     if isinstance(output_rate, SnapshotOutputRate):
-        return SnapshotRateLimiter(output_rate.value_ms, app_context)
+        return SnapshotRateLimiter(output_rate.value_ms, app_context, grouped)
     raise ValueError(f"unknown output rate {output_rate!r}")

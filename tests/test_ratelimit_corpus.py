@@ -121,3 +121,44 @@ def test_output_every_1_sec_time_batches():
                                      "192.10.1.30", "192.10.1.40"],
               gaps=gaps, end=1500)
     assert len(got) == 6
+
+
+def test_output_snapshot_last_event():
+    # SnapshotOutputRateLimitTestCase.testSnapshotOutputRateLimitQuery1:
+    # windowless snapshot emits the LATEST row each period — every output
+    # equals the last sent ip
+    gaps = [10, 10, 1100]
+    got = run("output snapshot every 1 sec",
+              ["192.10.1.5", "192.10.1.3", "192.10.1.3"],
+              gaps=gaps, end=1500)
+    assert got and all(ip == "192.10.1.3" for ip in got)
+
+
+def test_output_snapshot_group_by_all_groups():
+    # derived from WrappedSnapshotOutputRateLimiter's per-group snapshot
+    # limiters: each period emits EVERY group's current aggregate row
+    app = """
+define stream L (ts long, ip string);
+@info(name='q') from L
+select ip, count() as c group by ip
+output snapshot every 1 sec
+insert into U;"""
+    from siddhi_tpu import QueryCallback, SiddhiManager
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, playback=True, start_time=1000)
+    rows = []
+
+    class _CB(QueryCallback):
+        def receive(self, ts, current, expired):
+            if current:
+                rows.extend(list(e.data) for e in current)
+
+    rt.add_query_callback("q", _CB())
+    rt.start()
+    ih = rt.input_handler("L")
+    for ts, ip in [(1010, "a"), (1020, "b"), (1030, "a")]:
+        ih.send([ts, ip], timestamp=ts)
+    rt.advance_time(2100)
+    m.shutdown()
+    assert sorted(rows[:2]) == [["a", 2], ["b", 1]]
