@@ -183,7 +183,9 @@ class Parser:
                     continue
                 self.fail("expected definition, partition, query, or annotation")
                 anns = []
-            app.annotations.extend(a for a in anns if a.name.lower() == "app")
+            app.annotations.extend(
+                a for a in anns
+                if a.name.lower() == "app" or a.namespace == "app")
             self.accept_op(";")
         return app
 
@@ -199,10 +201,19 @@ class Parser:
         name = self.expect_ident()
         ann = Annotation(name)
         if self.accept_op(":"):
-            # `@App:name('x')` form → Annotation('app').element(key, value)
             key = self.expect_ident()
             ann.name = name.lower()
             if self.accept_op("("):
+                if self._at_annotation_kv():
+                    # `@app:playback(idle.time='…', increment='…')` — the
+                    # namespaced form with key=value content is its own
+                    # annotation named after the sub-key (reference parses
+                    # the `app:` prefix as a namespace)
+                    sub = Annotation(key.lower(), namespace=name.lower())
+                    self._parse_annotation_elements(sub)
+                    self.expect_op(")")
+                    return sub
+                # `@App:name('x')` form → Annotation('app').element(key, v)
                 val = self.parse_annotation_value()
                 self.expect_op(")")
                 ann.element(key, val)
@@ -210,33 +221,41 @@ class Parser:
                 ann.element(key, "true")
             return ann
         if self.accept_op("("):
-            while not self.at_op(")"):
-                if self.at_op("@"):
-                    ann.annotations.append(self.parse_annotation())
-                else:
-                    t = self.peek()
-                    # keys may be dotted identifiers: buffer.size, cache.policy
-                    klen = 0
-                    if t.type == TokenType.IDENT:
-                        klen = 1
-                        while (self.peek(klen).type == TokenType.OP
-                               and self.peek(klen).value == "."
-                               and self.peek(klen + 1).type == TokenType.IDENT):
-                            klen += 2
-                    if (
-                        klen
-                        and self.peek(klen).type == TokenType.OP
-                        and self.peek(klen).value == "="
-                    ):
-                        key = "".join(self.next().value for _ in range(klen))
-                        self.next()  # '='
-                        ann.element(key, self.parse_annotation_value())
-                    else:
-                        ann.element(None, self.parse_annotation_value())
-                if not self.accept_op(","):
-                    break
+            self._parse_annotation_elements(ann)
             self.expect_op(")")
         return ann
+
+    def _kv_key_len(self) -> int:
+        """Token count of a (dotted) identifier key at the cursor, else 0."""
+        if self.peek().type != TokenType.IDENT:
+            return 0
+        klen = 1
+        while (self.peek(klen).type == TokenType.OP
+               and self.peek(klen).value == "."
+               and self.peek(klen + 1).type == TokenType.IDENT):
+            klen += 2
+        return klen
+
+    def _at_annotation_kv(self) -> bool:
+        klen = self._kv_key_len()
+        return bool(klen) and self.peek(klen).type == TokenType.OP \
+            and self.peek(klen).value == "="
+
+    def _parse_annotation_elements(self, ann: Annotation) -> None:
+        """Comma-separated annotation body: nested @annotations, key=value
+        pairs (keys may be dotted: buffer.size, cache.policy), bare values."""
+        while not self.at_op(")"):
+            if self.at_op("@"):
+                ann.annotations.append(self.parse_annotation())
+            elif self._at_annotation_kv():
+                klen = self._kv_key_len()
+                key = "".join(self.next().value for _ in range(klen))
+                self.next()  # '='
+                ann.element(key, self.parse_annotation_value())
+            else:
+                ann.element(None, self.parse_annotation_value())
+            if not self.accept_op(","):
+                break
 
     def parse_annotation_value(self) -> str:
         t = self.peek()
@@ -251,7 +270,8 @@ class Parser:
     # ------------------------------------------------------------ definitions
     def parse_definition(self, app: SiddhiApp, anns: list[Annotation]) -> None:
         self.expect_kw("define")
-        anns = [a for a in anns if a.name.lower() != "app"]
+        anns = [a for a in anns
+                if a.name.lower() != "app" and a.namespace != "app"]
         kind = self.expect_kw(
             "stream", "table", "window", "trigger", "aggregation", "function"
         )

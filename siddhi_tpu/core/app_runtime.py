@@ -56,6 +56,15 @@ class SiddhiAppRuntime:
         if playback is None:
             playback = playback_ann is not None or (
                 app_ann is not None and app_ann.get("playback") == "true")
+        # @app:playback(idle.time='...', increment='...') heartbeat: after
+        # idle.time of wall silence the playback clock jumps by increment
+        # (reference EventTimeBasedMillisTimestampGenerator)
+        self._heartbeat_cfg = None
+        if playback_ann is not None and playback_ann.get("idle.time"):
+            from .aggregation import parse_retention
+            idle = parse_retention(playback_ann.get("idle.time"))
+            inc = parse_retention(playback_ann.get("increment") or "1 sec")
+            self._heartbeat_cfg = (int(idle), int(inc))
         self.name = app.name()
         self.ctx = SiddhiAppContext(siddhi_context, self.name, playback, start_time)
         self.ctx.runtime = self
@@ -452,6 +461,11 @@ class SiddhiAppRuntime:
         if not self.ctx.timestamp_generator.playback:
             self.ctx.ticker = SystemTicker(self.ctx.scheduler)
             self.ctx.ticker.start()
+        elif self._heartbeat_cfg is not None:
+            from .scheduler import PlaybackHeartbeat
+            self._heartbeat = PlaybackHeartbeat(self.ctx,
+                                                *self._heartbeat_cfg)
+            self._heartbeat.start()
 
     def shutdown(self) -> None:
         self.drain_async()           # deliver queued async events
@@ -480,6 +494,9 @@ class SiddhiAppRuntime:
         self.ctx.statistics_manager.stop_reporting()
         if self.ctx.ticker is not None:
             self.ctx.ticker.stop()
+        if getattr(self, "_heartbeat", None) is not None:
+            self._heartbeat.stop()
+            self._heartbeat = None
         self._started = False
 
     def drain_async(self) -> None:

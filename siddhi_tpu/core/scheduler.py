@@ -26,6 +26,8 @@ class TimestampGenerator:
         self.playback = playback
         self._current = start_time
         self.idle_timeout_ms = idle_timeout_ms
+        # wall time of the last clock advance — read by PlaybackHeartbeat
+        self.last_advance_wall = time.time() * 1000
 
     def current_time(self) -> int:
         if self.playback:
@@ -33,6 +35,7 @@ class TimestampGenerator:
         return int(time.time() * 1000)
 
     def advance(self, ts: int) -> None:
+        self.last_advance_wall = time.time() * 1000
         if ts > self._current:
             self._current = ts
 
@@ -75,6 +78,41 @@ class Scheduler:
     def clear(self) -> None:
         with self._lock:
             self._heap.clear()
+
+
+class PlaybackHeartbeat:
+    """``@app:playback(idle.time='...', increment='...')`` — after
+    ``idle.time`` of WALL-clock silence on the ingress, the playback clock
+    jumps forward by ``increment`` and due timers fire (reference
+    ``util/timestamp/EventTimeBasedMillisTimestampGenerator``'s heartbeat).
+    The one deliberate wall-clock element in playback mode: everything else
+    stays event-time deterministic."""
+
+    def __init__(self, app_context, idle_ms: int, increment_ms: int):
+        self.app_context = app_context
+        self.idle_ms = idle_ms
+        self.increment_ms = increment_ms
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(max(self.idle_ms / 2000.0, 0.005))
+            clock = self.app_context.timestamp_generator
+            if (time.time() * 1000) - clock.last_advance_wall < self.idle_ms:
+                continue
+            with self.app_context.root_lock:
+                self.app_context.advance_time(
+                    clock.current_time() + self.increment_ms)
+
+    def stop(self) -> None:
+        self._stop.set()
 
 
 class SystemTicker:

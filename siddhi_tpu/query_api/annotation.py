@@ -21,6 +21,9 @@ class Annotation:
     name: str
     elements: list[Element] = field(default_factory=list)
     annotations: list["Annotation"] = field(default_factory=list)  # nested
+    # namespace of the `@ns:name(...)` form (e.g. @app:playback → "app");
+    # the parser routes app-namespaced annotations to the SiddhiApp
+    namespace: Optional[str] = None
 
     def element(self, key: Optional[str], value: str) -> "Annotation":
         self.elements.append(Element(key, value))

@@ -532,3 +532,29 @@ def test_extension_optional_params_must_trail():
         ])
         class Bad:
             pass
+
+
+def test_app_playback_heartbeat_advances_clock(manager):
+    """@app:playback(idle.time, increment) — reference PlaybackTestCase
+    .playbackTest3: after idle.time of WALL silence the playback clock jumps
+    by increment, so the timeBatch flushes with no further events."""
+    import time as _time
+
+    rt = manager.create_siddhi_app_runtime("""
+        @app:playback(idle.time = '200 millisecond', increment = '2 sec')
+        define stream S (symbol string, price double, volume int);
+        from S#window.timeBatch(2 sec, 0)
+        select symbol, sum(price) as sumPrice, volume insert into O;
+    """)
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    ih = rt.input_handler("S")
+    # both sends land well inside the first idle window
+    ih.send(["IBM", 700.0, 0], timestamp=10)
+    ih.send(["WSO2", 60.5, 1], timestamp=20)
+    deadline = _time.time() + 5.0
+    while not got and _time.time() < deadline:
+        _time.sleep(0.05)
+    rt.shutdown()
+    assert len(got) == 1 and got[0].data[1] == pytest.approx(760.5)
