@@ -39,6 +39,70 @@ class SiddhiManager:
     # reference-style alias
     createSiddhiAppRuntime = create_siddhi_app_runtime
 
+    def create_sandbox_siddhi_app_runtime(
+            self, app: Union[str, SiddhiApp],
+            playback: Optional[bool] = None,
+            start_time: int = 0) -> SiddhiAppRuntime:
+        """Runs the app WITHOUT its external sources/sinks/stores (reference
+        ``SiddhiManager.createSandboxSiddhiAppRuntime:105`` — non-inMemory
+        @source/@sink annotations and every @store are stripped, so the app
+        can be driven by input handlers/callbacks in isolation)."""
+        if isinstance(app, str):
+            app = _parse(update_variables(app, None, self.context.config_manager)
+                         if "${" in app else app)
+        for sd in app.stream_definitions.values():
+            sd.annotations = [
+                a for a in sd.annotations
+                if a.name.lower() not in ("source", "sink")
+                or (a.get("type") or "").lower() == "inmemory"]
+        for td in app.table_definitions.values():
+            td.annotations = [a for a in td.annotations
+                              if a.name.lower() != "store"]
+        return self.create_siddhi_app_runtime(app, playback, start_time)
+
+    createSandboxSiddhiAppRuntime = create_sandbox_siddhi_app_runtime
+
+    def validate_siddhi_app(self, app: Union[str, SiddhiApp]) -> None:
+        """Full validation: parse + build the runtime, then discard it
+        (reference ``SiddhiManager.validateSiddhiApp:145`` does exactly
+        this — creation IS the validator). Raises on any invalid app."""
+        if isinstance(app, str):
+            app = _parse(update_variables(app, None, self.context.config_manager)
+                         if "${" in app else app)
+        runtime = SiddhiAppRuntime(app, self.context, playback=True)
+        runtime.shutdown()
+
+    validateSiddhiApp = validate_siddhi_app
+
+    # -- engine-level attribute map (reference get/setAttributes) -----------
+    def get_attributes(self) -> dict:
+        return self.context.attributes
+
+    def set_attribute(self, key: str, value) -> None:
+        self.context.attributes[key] = value
+
+    def get_extensions(self) -> dict:
+        return dict(self.context.extensions)
+
+    def remove_extension(self, name: str) -> None:
+        self.context.extensions.pop(name, None)
+
+    def set_error_store(self, store) -> None:
+        """Reference ``SiddhiManager.setErrorStore`` — replayable store for
+        events that failed with OnErrorAction.STORE."""
+        self.context.error_store = store
+
+    # -- engine-wide persistence (reference persist()/restoreLastState()) ---
+    def persist(self) -> dict:
+        """Persist every running app; returns {app name: revision}."""
+        return {name: rt.persist() for name, rt in self.runtimes.items()}
+
+    def restore_last_state(self) -> None:
+        for rt in self.runtimes.values():
+            rt.restore_last_revision()
+
+    restoreLastState = restore_last_state
+
     def set_extension(self, name: str, cls: type) -> None:
         self.context.extensions[name] = cls
 

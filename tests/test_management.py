@@ -558,3 +558,73 @@ def test_app_playback_heartbeat_advances_clock(manager):
         _time.sleep(0.05)
     rt.shutdown()
     assert len(got) == 1 and got[0].data[1] == pytest.approx(760.5)
+
+
+# ------------------------------------------------------- manager API surface
+
+def test_sandbox_runtime_strips_external_io(manager):
+    """Reference SandboxTestCase: external @source/@sink/@store strip away;
+    inMemory transports survive; the app runs driven by handlers."""
+    manager.set_extension("store:nodb", type("NoDB", (), {}))  # never built
+    rt = manager.create_sandbox_siddhi_app_runtime("""
+        @source(type='http', receiver.url='http://localhost:9999/in',
+                @map(type='json'))
+        @sink(type='inMemory', topic='sandbox_t', @map(type='passThrough'))
+        define stream S (v int);
+        @store(type='nodb')
+        define table T (v int);
+        from S select v insert into T;
+        from S select v insert into O;
+    """, playback=True)
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()          # would raise on the unknown http source otherwise
+    rt.input_handler("S").send([7], timestamp=1)
+    assert [e.data for e in got] == [[7]]
+    assert [e.data for e in rt.query("from T select v")] == [[7]]
+
+
+def test_validate_siddhi_app(manager):
+    manager.validate_siddhi_app("""
+        define stream S (v int);
+        from S select v insert into O;
+    """)
+    with pytest.raises(Exception):
+        manager.validate_siddhi_app("""
+            define stream S (v int);
+            from S select missing_attr insert into O;
+        """)
+    # validation must not register a runtime
+    assert manager.runtimes == {}
+
+
+def test_manager_attributes_and_extensions(manager):
+    manager.set_attribute("region", "us-east")
+    assert manager.get_attributes()["region"] == "us-east"
+    manager.set_extension("custom:noop", StreamFunctionExtension)
+    assert "custom:noop" in manager.get_extensions()
+    manager.remove_extension("custom:noop")
+    assert "custom:noop" not in manager.get_extensions()
+
+
+def test_manager_engine_wide_persist_restore(manager):
+    manager.set_persistence_store(InMemoryPersistenceStore())
+    app = """
+        define stream S (v long);
+        from S#window.length(4) select sum(v) as t insert into O;
+    """
+    rt, got = setup(manager, app)
+    rt.input_handler("S").send([10], timestamp=1)
+    revs = manager.persist()
+    assert list(revs.values()) and all(revs.values())
+
+    m2 = SiddhiManager()
+    m2.set_persistence_store(manager.context.persistence_store)
+    rt2 = m2.create_siddhi_app_runtime(app, playback=True)
+    got2 = []
+    rt2.add_callback("O", StreamCallback(lambda evs: got2.extend(evs)))
+    rt2.start()
+    m2.restore_last_state()
+    rt2.input_handler("S").send([5], timestamp=2)
+    m2.shutdown()
+    assert [e.data[0] for e in got2] == [15]
