@@ -428,6 +428,25 @@ class SiddhiAppRuntime:
         self.ctx.stream_junctions[stream_id].subscribe(
             _StreamCallbackReceiver(callback))
 
+    def remove_callback(self, callback: StreamCallback) -> None:
+        """Detach a previously added stream callback (reference
+        ``SiddhiAppRuntime.removeCallback``)."""
+        for j in self.ctx.stream_junctions.values():
+            for r in list(j.receivers):
+                if isinstance(r, _StreamCallbackReceiver) \
+                        and r.callback is callback:
+                    j.unsubscribe(r)
+
+    def remove_query_callback(self, callback: QueryCallback) -> None:
+        for rt in self.query_runtimes.values():
+            cbs = rt.callback_adapter.callbacks
+            if callback in cbs:
+                cbs.remove(callback)
+        for bridge in self.device_bridges:
+            cbs = getattr(bridge, "query_callbacks", [])
+            if callback in cbs:
+                cbs.remove(callback)
+
     def add_query_callback(self, query_name: str, callback: QueryCallback) -> None:
         rt = self.query_runtimes.get(query_name)
         if rt is not None:
@@ -455,8 +474,9 @@ class SiddhiAppRuntime:
             rt.start()
         for tr in self.trigger_runtimes:
             tr.start()
-        for src in self.sources:
-            src.connect_with_retry()
+        if not getattr(self, "_defer_sources", False):
+            for src in self.sources:
+                src.connect_with_retry()
         self.ctx.statistics_manager.start_reporting()
         if not self.ctx.timestamp_generator.playback:
             self.ctx.ticker = SystemTicker(self.ctx.scheduler)
@@ -595,8 +615,120 @@ class SiddhiAppRuntime:
         return self.ctx.debugger
 
     # -- stats / errors -------------------------------------------------------
+    # -- introspection (reference SiddhiAppRuntime getter surface) ----------
+    @property
+    def stream_definition_map(self) -> dict:
+        # declared + inferred (output streams materialize junctions with
+        # their inferred definitions — the reference's map includes both)
+        return self._stream_defs()
+
+    @property
+    def table_definition_map(self) -> dict:
+        return dict(self.app.table_definitions)
+
+    @property
+    def window_definition_map(self) -> dict:
+        return dict(self.app.window_definitions)
+
+    @property
+    def aggregation_definition_map(self) -> dict:
+        return dict(self.app.aggregation_definitions)
+
+    @property
+    def query_names(self) -> set:
+        names = set(self.query_runtimes)
+        names.update(b.query_name for b in self.device_bridges)
+        return names
+
+    @property
+    def tables(self) -> list:
+        return list(self.ctx.tables.values())
+
+    @property
+    def windows(self) -> list:
+        return list(self.ctx.named_windows.values())
+
+    @property
+    def triggers(self) -> list:
+        return list(self.trigger_runtimes)
+
+    def table_input_handler(self, table_id: str):
+        """Direct table ingress (reference ``getTableInputHandler``)."""
+        table = self.ctx.tables.get(table_id)
+        if table is None:
+            raise KeyError(f"table '{table_id}' is not defined")
+        return _TableInputHandler(table, self.ctx)
+
+    def on_demand_query_output_attributes(self, text: str) -> list:
+        """(name, DataType) pairs the on-demand query would emit (reference
+        ``getOnDemandQueryOutputAttributes``)."""
+        from .executor import ExecutorBuilder, RowResolver
+        odq = parse_on_demand_query(text)
+        sid = odq.input_store_id
+        ctx = self.ctx
+        if sid in ctx.tables:
+            d = ctx.tables[sid].definition
+        elif sid in ctx.named_windows:
+            d = ctx.named_windows[sid].definition
+        elif sid in ctx.aggregations:
+            d = ctx.aggregations[sid].output_definition
+        else:
+            raise KeyError(f"store '{sid}' is not defined")
+        names = d.attribute_names
+        types = [d.attribute_type(n) for n in names]
+        attrs = list(odq.selector.attributes)
+        if odq.selector.select_all or not attrs:
+            return list(zip(names, types))
+        builder = ExecutorBuilder(RowResolver(names, types), ctx)
+        out = []
+        for oa in attrs:
+            fn, t = builder.build(oa.expr)
+            name = oa.name or getattr(oa.expr, "attribute", None) or "value"
+            out.append((name, t))
+        return out
+
+    def set_purging_enabled(self, enabled: bool) -> None:
+        """Toggle incremental-aggregation purging engine-wide (reference
+        ``setPurgingEnabled``)."""
+        for agg in self.ctx.aggregations.values():
+            was = agg.purge_enabled
+            agg.purge_enabled = enabled
+            if enabled and not was and agg.purge_interval:
+                agg._arm_purge()
+
+    def start_without_sources(self) -> None:
+        """Start everything but the transports (reference
+        ``startWithoutSources`` — sources attach later via
+        :meth:`start_sources`)."""
+        self._defer_sources = True
+        try:
+            self.start()
+        finally:
+            self._defer_sources = False
+
+    def start_sources(self) -> None:
+        for src in self.sources:
+            src.connect_with_retry()
+
     def set_statistics_level(self, level: Level) -> None:
         self.ctx.statistics_manager.set_level(level)
 
     def set_exception_listener(self, listener) -> None:
         self.ctx.exception_listener = listener
+
+
+class _TableInputHandler:
+    """Direct table ingress (reference ``TableInputHandler``): rows go into
+    the table without a feeding stream/query."""
+
+    def __init__(self, table, app_context):
+        self.table = table
+        self.app_context = app_context
+
+    def send(self, rows, timestamp=None) -> None:
+        if rows and not isinstance(rows[0], list):
+            rows = [rows]
+        ts = timestamp if timestamp is not None \
+            else self.app_context.current_time()
+        with self.app_context.root_lock:
+            self.table.add([list(r) for r in rows], ts)

@@ -47,6 +47,10 @@ class StreamJunction:
         if receiver not in self.receivers:
             self.receivers.append(receiver)
 
+    def unsubscribe(self, receiver) -> None:
+        if receiver in self.receivers:
+            self.receivers.remove(receiver)
+
     def enable_async(self, buffer_size: int = 1024, workers: int = 1,
                      batch_size_max: int = 64) -> None:
         from .async_junction import AsyncDispatcher

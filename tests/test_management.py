@@ -628,3 +628,76 @@ def test_manager_engine_wide_persist_restore(manager):
     rt2.input_handler("S").send([5], timestamp=2)
     m2.shutdown()
     assert [e.data[0] for e in got2] == [15]
+
+
+def test_runtime_introspection_and_table_input_handler(manager):
+    rt, got = setup(manager, """
+        define stream S (v int);
+        define table T (v int, w int);
+        @info(name='q1') from S select v insert into O;
+    """)
+    assert set(rt.stream_definition_map) >= {"S", "O"}
+    assert "T" in rt.table_definition_map
+    assert "q1" in rt.query_names
+    assert len(rt.tables) == 1
+
+    tih = rt.table_input_handler("T")
+    tih.send([1, 2])
+    tih.send([[3, 4], [5, 6]])
+    rows = sorted(e.data for e in rt.query("from T select v, w"))
+    assert rows == [[1, 2], [3, 4], [5, 6]]
+
+    assert rt.on_demand_query_output_attributes("from T select v, w") == [
+        ("v", DataType.INT), ("w", DataType.INT)]
+    assert [n for n, _ in rt.on_demand_query_output_attributes(
+        "from T select v * 2 as d")] == ["d"]
+
+
+def test_remove_stream_and_query_callbacks(manager):
+    from siddhi_tpu.core.stream import QueryCallback as _QC
+
+    rt = manager.create_siddhi_app_runtime("""
+        define stream S (v int);
+        @info(name='q') from S select v insert into O;
+    """, playback=True)
+    got = []
+    cb = StreamCallback(lambda evs: got.extend(evs))
+    rt.add_callback("O", cb)
+
+    qgot = []
+
+    class QC(_QC):
+        def receive(self, ts, cur, exp):
+            if cur:
+                qgot.extend(cur)
+
+    qcb = QC()
+    rt.add_query_callback("q", qcb)
+    rt.start()
+    rt.input_handler("S").send([1], timestamp=1)
+    rt.remove_callback(cb)
+    rt.remove_query_callback(qcb)
+    rt.input_handler("S").send([2], timestamp=2)
+    assert [e.data[0] for e in got] == [1]
+    assert [e.data[0] for e in qgot] == [1]
+
+
+def test_start_without_sources_then_start_sources(manager):
+    received = []
+    unsub = InMemoryBroker.subscribe("sws_out", received.append)
+    try:
+        rt = manager.create_siddhi_app_runtime("""
+            @source(type='inMemory', topic='sws_in', @map(type='passThrough'))
+            define stream S (v int);
+            from S select v insert into O;
+        """, playback=True)
+        got = []
+        rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+        rt.start_without_sources()
+        InMemoryBroker.publish("sws_in", [1])     # no source connected yet
+        assert got == []
+        rt.start_sources()
+        InMemoryBroker.publish("sws_in", [2])
+        assert [e.data for e in got] == [[2]]
+    finally:
+        unsub()
