@@ -202,6 +202,34 @@ select cardNo, price insert all events into out;""",
               ("3234-3244-2432-4124", 78.36), ("1234-3244-2432-123", 86.36),
               ("5768-3244-2432-5646", 48.36)]],
             8, 0),
+    # ---------------- ExpressionWindowTestCase ----------------------------
+    # expressionWindowTest1: retain while count() <= 2 — every arrival
+    # emits; the 3rd onward evicts the oldest
+    _counts("expression1", S_CSE + """
+@info(name='q') from cse#window.expression('count() <= 2')
+select symbol, price, volume insert all events into out;""",
+            [("cse", ["IBM", 700.0, 0], 10), ("cse", ["WSO2", 60.5, 1], 10),
+             ("cse", ["WSO2", 61.5, 2], 10), ("cse", ["WSO2", 62.5, 3], 10),
+             ("cse", ["WSO2", 63.5, 4], 10)],
+            5, 3),
+    # expressionWindowTest2: retain while last.volume - first.volume <= 2
+    _counts("expression2", S_CSE + """
+@info(name='q') from cse#window.expression('last.volume - first.volume <= 2')
+select symbol, price, volume insert all events into out;""",
+            [("cse", ["WSO2", 60.5, 0], 10), ("cse", ["WSO2", 61.5, 1], 10),
+             ("cse", ["WSO2", 62.5, 2], 10), ("cse", ["WSO2", 63.5, 3], 10),
+             ("cse", ["WSO2", 64.5, 4], 10)],
+            5, 2),
+    # ---------------- ExpressionBatchWindowTestCase -----------------------
+    # expressionBatchWindowTest1: flush when count() <= 2 breaks — two full
+    # 2-event batches from 5 sends, the 5th held open
+    _counts("expressionBatch1", S_CSE + """
+@info(name='q') from cse#window.expressionBatch('count() <= 2')
+select symbol, price, volume insert all events into out;""",
+            [("cse", ["IBM", 700.0, 0], 10), ("cse", ["WSO2", 60.5, 1], 10),
+             ("cse", ["WSO2", 61.5, 2], 10), ("cse", ["WSO2", 62.5, 3], 10),
+             ("cse", ["WSO2", 63.5, 4], 10)],
+            4, 2),
 ]
 
 
