@@ -1156,24 +1156,27 @@ def _keyed_range_sums(z, zk, K, lo, j, keys_b):
 
 def _window_svars(z_s, zo, lo, j, cnts, k, N, B):
     """stdDev over inclusive ranges: shifted second moments, centered at the
-    current batch's mean (any shift is exact algebraically; centering keeps
-    f32 conditioning)."""
+    current batch's mean, ACCUMULATED IN f64 — the prefix-sum differences
+    cancel catastrophically (a single-element range's variance is the
+    difference of two near-equal slab totals; f32 there leaves ~1e-2
+    absolute noise on 1e2-scale values, measured by the differential fuzz)."""
     AS = z_s.shape[0]
     if not AS:
         return jnp.zeros((0, B), FACC)
-    occ = (zo > 0).astype(FACC)
+    occ = (zo > 0).astype(jnp.float64)
     out = jnp.zeros((AS, B), FACC)
-    n = jnp.maximum(cnts.astype(FACC), 1.0)
+    n = jnp.maximum(cnts.astype(jnp.float64), 1.0)
     for si in range(AS):
-        raw = z_s[si]
+        raw = z_s[si].astype(jnp.float64)
         c = jnp.sum(raw * occ) / jnp.maximum(jnp.sum(occ), 1.0)
         d = (raw - c) * occ
-        cs1 = jnp.concatenate([jnp.zeros((1,), FACC), jnp.cumsum(d)])
-        cs2 = jnp.concatenate([jnp.zeros((1,), FACC), jnp.cumsum(d * d)])
+        cs1 = jnp.concatenate([jnp.zeros((1,), jnp.float64), jnp.cumsum(d)])
+        cs2 = jnp.concatenate([jnp.zeros((1,), jnp.float64),
+                               jnp.cumsum(d * d)])
         s1 = cs1[j + 1] - cs1[lo]
         s2 = cs2[j + 1] - cs2[lo]
         var = jnp.maximum(s2 / n - (s1 / n) ** 2, 0.0)
-        out = out.at[si].set(jnp.sqrt(var))
+        out = out.at[si].set(jnp.sqrt(var).astype(FACC))
     return out
 
 
