@@ -325,23 +325,32 @@ class PatternRuntime:
             self._enforce_strict(stream_id, event, touched, created)
 
     def _reseed_on_expiry(self, i: int, p: StateEvent, now: int):
-        """Re-seed the `every` scope containing node i after its pending
-        instance expired or was strict-killed (scope = [reseed_to .. j] of the
-        nearest enclosing every end-node j ≥ i). Returns the new seed."""
-        for j in range(i, len(self.c.nodes)):
+        """Re-seed the `every` scope after its pending instance expired or
+        was strict-killed at node i. The scope is [reseed_to .. j] of an
+        every end-node: ENCLOSING (reseed_to ≤ i ≤ j) or — when the partial
+        had already advanced PAST the scope before dying — the nearest
+        preceding end-node j < i (fuzz regression: `every e1=A[..]<1:3> ->
+        e2=B[..]` killed at e2 by `within` never re-seeded, losing every
+        later chain). Returns the new seed."""
+        ends = [j for j in range(i, len(self.c.nodes))
+                if self.c.nodes[j].reseed_to is not None
+                and self.c.nodes[j].reseed_to <= i]
+        if not ends:
+            ends = [j for j in range(i - 1, -1, -1)
+                    if self.c.nodes[j].reseed_to is not None][:1]
+        for j in ends:
             node_j = self.c.nodes[j]
-            if node_j.reseed_to is not None and node_j.reseed_to <= i:
-                start = node_j.reseed_to
-                # another live instance of the scope → nothing to re-seed
-                if any(self.pending[k] for k in range(start, j + 1)):
-                    return None
-                seed = self._build_seed(node_j, p)
-                self._place(start, seed, now)
-                # unlike completion re-seeds, an expiry re-seed is visible to
-                # the event being processed (the reference re-inits the start
-                # state during expiry, before matching)
-                self._created.discard(id(seed))
-                return seed, start
+            start = node_j.reseed_to
+            # another live instance of the scope → nothing to re-seed
+            if any(self.pending[k] for k in range(start, j + 1)):
+                return None
+            seed = self._build_seed(node_j, p)
+            self._place(start, seed, now)
+            # unlike completion re-seeds, an expiry re-seed is visible to
+            # the event being processed (the reference re-inits the start
+            # state during expiry, before matching)
+            self._created.discard(id(seed))
+            return seed, start
         return None
 
     def _expired_partial(self, node: StateNode, p: StateEvent, ts: int) -> bool:
