@@ -435,8 +435,13 @@ class PartitionedNFARuntime:
         return out if decode else None
 
     def flush(self, decode: bool = False):
+        # a registered callback implies decode — without this, the
+        # auto-flush on a filled lane would silently discard every match
+        # row found mid-stream (fuzz regression: match_count advanced while
+        # the callback saw nothing)
+        decode = decode or self.callback is not None
         if all(len(b) == 0 for b in self.builders):
-            return None
+            return [] if decode else None
         batches = [b.emit() for b in self.builders]
         cols = {
             k: np.stack([bt["cols"][k] for bt in batches])

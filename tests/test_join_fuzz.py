@@ -1,0 +1,97 @@
+"""Join differential fuzz: randomized two-sided join shapes × randomized
+streams, host oracle vs the device masked-pair-grid kernel
+(``tpu/join_compile.py``). Same rationale as the query/NFA/snapshot
+sweeps — sample the cross product the hand-written suites cannot."""
+
+import random
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.tpu import DeviceCompileError
+from siddhi_tpu.tpu.join_compile import DeviceJoinRuntime
+from util_parity import rows_equal
+
+WINDOWS = ["#window.length({n})", "#window.time({ms})"]
+JOIN_TYPES = ["join", "left outer join", "right outer join",
+              "full outer join"]
+CONDS = [
+    "on L.sym == R.sym",
+    "on L.sym == R.sym and R.price < L.price",
+    "on L.price > R.price",
+]
+
+
+def _shape(rng):
+    lwin = rng.choice(WINDOWS).format(n=rng.choice([2, 4]),
+                                      ms=rng.choice([300, 900]))
+    rwin = rng.choice(WINDOWS).format(n=rng.choice([2, 4]),
+                                      ms=rng.choice([300, 900]))
+    jt = rng.choice(JOIN_TYPES)
+    cond = rng.choice(CONDS)
+    uni = "unidirectional " if jt == "join" and rng.random() < 0.3 else ""
+    within = f" within {rng.choice([400, 1200])}" \
+        if jt == "join" and rng.random() < 0.4 else ""
+    return f"""
+define stream L (sym string, price double);
+define stream R (sym string, price double);
+from L{lwin} {uni}{jt} R{rwin}
+  {cond}{within}
+select L.sym as ls, L.price as lp, R.sym as rs, R.price as rp
+insert into O;
+"""
+
+
+def _events(rng, n):
+    ts, out = 1000, []
+    for _ in range(n):
+        ts += rng.choice([10, 40, 40, 250])
+        out.append((rng.choice(["L", "R"]),
+                    [rng.choice("ab"), round(rng.uniform(1, 50), 1)], ts))
+    return out
+
+
+def _host(app, events):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, playback=True)
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    for sid, row, ts in events:
+        rt.input_handler(sid).send(list(row), timestamp=ts)
+    m.shutdown()
+    return [e.data for e in got]
+
+
+def _device(app, events, cap):
+    rt = DeviceJoinRuntime(app, batch_capacity=cap, ring_capacity=128,
+                           joined_capacity=2048)
+    rows = []
+    rt.add_callback(rows.extend)
+    for sid, row, ts in events:
+        rt.send(sid, list(row), ts)
+    rt.flush()
+    if rt.drop_count or rt.ring_drop_count:
+        pytest.skip("capacity overflow invalidates parity")
+    return rows
+
+
+def _rows_match(expected, actual):
+    assert len(expected) == len(actual)
+    for e in expected:
+        assert any(rows_equal(e, a, rel=2e-3, abs_=2e-3) for a in actual), e
+
+
+@pytest.mark.parametrize("seed", range(18))
+def test_join_differential_fuzz(seed):
+    rng = random.Random(6000 + seed)
+    app = _shape(rng)
+    events = _events(rng, rng.choice([25, 50]))
+    try:
+        actual = _device(app, events, cap=rng.choice([8, 16]))
+    except DeviceCompileError:
+        pytest.skip(f"host-only shape: {app.splitlines()[3]}")
+    expected = _host(app, events)
+    assert len(expected) == len(actual), \
+        f"row count {len(expected)} != {len(actual)} for:\n{app}"
+    _rows_match(expected, actual)
