@@ -23,16 +23,29 @@ class NamedWindow:
         self.processor.process([event])
 
     def _dispatch(self, events: list[StreamEvent]) -> None:
+        # deliver the flush as ONE chunk, RESET events included: batch-type
+        # named windows (lengthBatch/timeBatch/...) rely on downstream
+        # selectors seeing chunk boundaries to collapse aggregated rows and
+        # reset between batches (CustomJoinWindowTestCase
+        # .testMultipleStreamsToWindow pins one row per flush)
         t = self.definition.output_event_type
+        out: list[StreamEvent] = []
         for ev in events:
             if ev.type == EventType.CURRENT and t == OutputEventType.EXPIRED_EVENTS:
                 continue
             if ev.type == EventType.EXPIRED and t == OutputEventType.CURRENT_EVENTS:
                 continue
-            if ev.type in (EventType.CURRENT, EventType.EXPIRED):
-                out = StreamEvent(ev.timestamp, list(ev.data), ev.type)
-                for s in self.subscribers:
-                    s.receive(out)
+            if ev.type in (EventType.CURRENT, EventType.EXPIRED,
+                           EventType.RESET):
+                out.append(StreamEvent(ev.timestamp, list(ev.data), ev.type))
+        if not out:
+            return
+        for s in self.subscribers:
+            if hasattr(s, "receive_chunk"):
+                s.receive_chunk(list(out))
+            else:
+                for ev in out:
+                    s.receive(ev)
 
     def subscribe(self, receiver) -> None:
         self.subscribers.append(receiver)

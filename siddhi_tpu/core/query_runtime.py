@@ -297,10 +297,18 @@ def build_query_runtime(query: Query, app_context, stream_defs: dict,
                                   [a.type for a in eff_def.attributes],
                                   app_context.element_id(f"{qid}-selector"))
         # aggregated chunks from BATCHING windows collapse to one row per
-        # flush (reference QuerySelector.process:81 — isBatch chunks)
+        # flush (reference QuerySelector.process:81 — isBatch chunks);
+        # reading FROM a named window inherits ITS window type's batching
+        # (CustomJoinWindowTestCase.testMultipleStreamsToWindow pins one
+        # collapsed row per lengthBatch named-window flush)
         selector.batching = any(
             isinstance(h, Window) and h.name in BATCHING_WINDOWS
             for h in ist.handlers)
+        nw_src = app_context.named_windows.get(ist.stream_id)
+        if nw_src is not None:
+            wh = nw_src.definition.window_handler
+            if wh is not None and getattr(wh, "name", None) in BATCHING_WINDOWS:
+                selector.batching = True
         ef = getattr(query.output_stream, "events_for",
                      OutputEventsFor.CURRENT_EVENTS)
         selector.current_on = ef != OutputEventsFor.EXPIRED_EVENTS

@@ -287,3 +287,92 @@ insert all events into out;""", [
         [None, "Hello World", None, "WSO2"],
         ["WSO2", "Hello World", 55.6, "WSO2"],
     ], ins
+
+
+# --------------------------------------------------------------------------
+# CustomJoinWindowTestCase — `define window` shared across queries
+# --------------------------------------------------------------------------
+
+def _named_window_run(app, sends, out):
+    from siddhi_tpu import SiddhiManager, StreamCallback
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, playback=True, start_time=1000)
+    rows = []
+    rt.add_callback(out, StreamCallback(
+        lambda evs: rows.extend(list(e.data) for e in evs)))
+    rt.start()
+    ts = 1000
+    for sid, row in sends:
+        ts += 10
+        rt.input_handler(sid).send(list(row), timestamp=ts)
+    m.shutdown()
+    return rows
+
+
+def test_named_window_join_table():
+    # testJoinWindowWithTable: a length(1) named window joined to a table
+    app = """
+define stream StockStream (symbol string, price double, volume long);
+define stream CheckStockStream (symbol string);
+define window CheckStockWindow (symbol string) length(1) output all events;
+define table StockTable (symbol string, price double, volume long);
+from StockStream insert into StockTable;
+from CheckStockStream insert into CheckStockWindow;
+@info(name='q') from CheckStockWindow join StockTable
+on CheckStockWindow.symbol == StockTable.symbol
+select CheckStockWindow.symbol as checkSymbol, StockTable.symbol as symbol,
+       StockTable.volume as volume
+insert into OutputStream;
+"""
+    rows = _named_window_run(app, [
+        ("StockStream", ["WSO2", 55.6, 100]),
+        ("StockStream", ["IBM", 75.6, 10]),
+        ("CheckStockStream", ["WSO2"]),
+    ], "OutputStream")
+    assert rows == [["WSO2", "WSO2", 100]]
+
+
+def test_named_window_join_window():
+    # testJoinWindowWithWindow: time(1 min) window ⋈ length(1) window on
+    # roomNo — only rooms 4 and 5 pass the temp filter; each regulator-off
+    # arrival for those rooms pairs exactly once
+    app = """
+define stream TempStream (deviceID long, roomNo int, temp double);
+define stream RegulatorStream (deviceID long, roomNo int, isOn bool);
+define window TempWindow (deviceID long, roomNo int, temp double) time(1 min);
+define window RegulatorWindow (deviceID long, roomNo int, isOn bool) length(1);
+from TempStream[temp > 30.0] insert into TempWindow;
+from RegulatorStream[isOn == false] insert into RegulatorWindow;
+@info(name='q') from TempWindow join RegulatorWindow
+on TempWindow.roomNo == RegulatorWindow.roomNo
+select TempWindow.roomNo, RegulatorWindow.deviceID, 'start' as action
+insert into RegulatorActionStream;
+"""
+    sends = ([("TempStream", [100, r, t]) for r, t in
+              [(1, 20.0), (2, 25.0), (3, 30.0), (4, 35.0), (5, 40.0)]]
+             + [("RegulatorStream", [100, r, False]) for r in range(1, 6)])
+    rows = _named_window_run(app, sends, "RegulatorActionStream")
+    assert sorted(rows) == [[4, 100, "start"], [5, 100, "start"]]
+
+
+def test_named_window_multiple_feeder_streams():
+    # testMultipleStreamsToWindow: six streams feed ONE lengthBatch(5)
+    # window; the 5th arrival flushes one aggregate row over the batch
+    feeders = "\n".join(
+        f"define stream Stream{i} (symbol string, price double, volume long);"
+        for i in range(1, 7))
+    inserts = "\n".join(
+        f"from Stream{i} insert into StockWindow;" for i in range(1, 7))
+    app = feeders + """
+define window StockWindow (symbol string, price double, volume long) lengthBatch(5);
+""" + inserts + """
+@info(name='q') from StockWindow
+select symbol, sum(price) as totalPrice, sum(volume) as volumes
+insert into OutputStream;
+"""
+    rows = _named_window_run(
+        app, [(f"Stream{i}", ["WSO2", i * 10.0, 1]) for i in range(1, 7)],
+        "OutputStream")
+    assert len(rows) == 1
+    assert rows[0][1] == pytest.approx(150.0) and rows[0][2] == 5
