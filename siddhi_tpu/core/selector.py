@@ -170,6 +170,15 @@ class QuerySelector:
                 out = [pairs[-1][0]] if pairs else []
             if not out:
                 return
+        if self.order_by or self.limit is not None \
+                or self.offset is not None:
+            # the reference removes non-output event kinds INSIDE the
+            # selector before order/limit (processNoGroupBy's gate) — a
+            # mixed [expired..., current...] flush chunk must not have its
+            # limit slots consumed by rows the query never outputs
+            out = [ev for ev in out
+                   if (ev.type == EventType.CURRENT and self.current_on)
+                   or (ev.type == EventType.EXPIRED and self.expired_on)]
         out = self._order_limit(out)
         if self.next is not None and out:
             self.next.process(out)

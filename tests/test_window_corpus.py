@@ -376,3 +376,70 @@ insert into OutputStream;
         "OutputStream")
     assert len(rows) == 1
     assert rows[0][1] == pytest.approx(150.0) and rows[0][2] == 5
+
+
+# --------------------------------------------------------------------------
+# OrderByLimitTestCase — limit/order-by applied per output chunk
+# --------------------------------------------------------------------------
+
+def _chunked_query_run(app, rows_in, stream="cse"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, playback=True, start_time=1000)
+    chunks = []
+
+    class _CB(QueryCallback):
+        def receive(self, ts, current, expired):
+            if current:
+                chunks.append([list(e.data) for e in current])
+
+    rt.add_query_callback("q", _CB())
+    rt.start()
+    ih = rt.input_handler(stream)
+    for i, row in enumerate(rows_in):
+        ih.send(list(row), timestamp=1000 + 10 * i)
+    m.shutdown()
+    return chunks
+
+
+def test_limit_per_batch_chunk():
+    # limitTest1: lengthBatch(4) + limit 2 — each flush emits its first two
+    app = S_CSE + """
+@info(name='q') from cse#window.lengthBatch(4)
+select symbol, price, volume limit 2 insert into outputStream;"""
+    chunks = _chunked_query_run(app, [
+        ["IBM", 700.0, 0], ["WSO2", 60.5, 1], ["WSO2", 60.5, 2],
+        ["WSO2", 60.5, 3], ["IBM", 700.0, 4], ["WSO2", 60.5, 5],
+        ["WSO2", 60.5, 6], ["WSO2", 60.5, 7]])
+    assert [len(c) for c in chunks] == [2, 2]
+    assert chunks[0][0][2] == 0 and chunks[1][0][2] == 4
+
+
+def test_order_by_then_limit_per_chunk():
+    # limitTest2: order by symbol limit 3 — each flush sorts then truncates
+    app = S_CSE + """
+@info(name='q') from cse#window.lengthBatch(4)
+select symbol, price, volume order by symbol limit 3
+insert into outputStream;"""
+    chunks = _chunked_query_run(app, [
+        ["IBM", 700.0, 0], ["WSO2", 60.5, 1], ["AAA", 60.5, 2],
+        ["IBM", 60.5, 3], ["IBM", 700.0, 4], ["WSO2", 60.5, 5],
+        ["IBM", 601.5, 6], ["BBB", 60.5, 7]])
+    assert [len(c) for c in chunks] == [3, 3]
+    assert chunks[0][0][2] == 2      # AAA leads the sorted first batch
+    assert chunks[1][0][2] == 7      # BBB leads the second
+
+
+def test_group_by_order_by_multi_key_limit():
+    # limitTest5: group-by collapse per batch, then order by (price,
+    # totalVolume) and limit 2 — IBM's singleton group leads each flush
+    app = S_CSE + """
+@info(name='q') from cse#window.lengthBatch(4)
+select symbol, sum(volume) as totalVolume, volume, price
+group by symbol order by price, totalVolume limit 2
+insert into outputStream;"""
+    chunks = _chunked_query_run(app, [
+        ["IBM", 60.5, 0], ["WSO2", 60.5, 1], ["WSO2", 60.5, 2],
+        ["XYZ", 60.5, 3], ["IBM", 60.5, 4], ["WSO2", 60.5, 5],
+        ["WSO2", 60.5, 6], ["XYZ", 60.5, 7]])
+    assert [len(c) for c in chunks] == [2, 2]
+    assert chunks[0][0][2] == 0 and chunks[1][0][2] == 4
