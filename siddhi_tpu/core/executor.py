@@ -270,10 +270,24 @@ class ExecutorBuilder:
         raise ExecutorBuildError(f"unsupported expression {expr!r}")
 
     # -- comparisons ---------------------------------------------------------
+    _NUMERIC = {DataType.INT, DataType.LONG, DataType.FLOAT, DataType.DOUBLE}
+
     def _build_compare(self, expr: Compare):
         lf, lt = self.build(expr.left)
         rf, rt = self.build(expr.right)
         op = expr.op
+        # incompatible operand types fail at BUILD time (reference
+        # StringCompareTestCase/BooleanCompareTestCase expect
+        # SiddhiAppCreationException for e.g. double != string); unknown/
+        # OBJECT types stay permissive
+        if lt is not None and rt is not None and lt != rt:
+            groups = (self._NUMERIC, {DataType.STRING}, {DataType.BOOL})
+            lg = next((g for g in groups if lt in g), None)
+            rg = next((g for g in groups if rt in g), None)
+            if lg is not None and rg is not None and lg is not rg:
+                raise ExecutorBuildError(
+                    f"cannot compare {lt.value} with {rt.value} "
+                    f"({expr.op.value})")
 
         def cmp(f):
             a, b = lf(f), rf(f)

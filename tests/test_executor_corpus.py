@@ -1,0 +1,83 @@
+"""Expression-executor corpus transliterated from the reference suites:
+
+- ``.../core/query/IsNullTestCase.java``
+- ``.../core/query/StringCompareTestCase.java`` /
+  ``BooleanCompareTestCase.java`` (the type-compatibility matrices — the
+  reference rejects incompatible comparisons at CREATION time)"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+def run(app, rows, stream="S", out="O"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, playback=True)
+    got = []
+    rt.add_callback(out, StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    ih = rt.input_handler(stream)
+    for i, r in enumerate(rows):
+        ih.send(list(r), timestamp=1000 + i)
+    m.shutdown()
+    return [e.data for e in got]
+
+
+def test_is_null_filter():
+    # isNullTest1: `symbol is null` passes exactly the null-symbol event
+    got = run("""
+define stream S (symbol string, price double, volume long);
+from S[symbol is null] select price, volume insert into O;
+""", [["IBM", 700.0, 100], [None, 60.5, 200], ["WSO2", 60.5, 200]])
+    assert got == [[60.5, 200]]
+
+
+def test_not_is_null_filter():
+    got = run("""
+define stream S (symbol string, price double, volume long);
+from S[not (symbol is null)] select symbol insert into O;
+""", [["IBM", 700.0, 100], [None, 60.5, 200], ["WSO2", 60.5, 200]])
+    assert got == [["IBM"], ["WSO2"]]
+
+
+@pytest.mark.parametrize("cond,fields", [
+    # StringCompareTestCase.test30 family: numeric vs string
+    ("x != y", "x double, y string"),
+    ("x == y", "x int, y string"),
+    ("x < y", "x long, y string"),
+    # BooleanCompareTestCase family: bool vs numeric / string
+    ("x == y", "x bool, y double"),
+    ("x != y", "x bool, y string"),
+])
+def test_incompatible_compare_rejected_at_creation(cond, fields):
+    m = SiddhiManager()
+    with pytest.raises(Exception):
+        m.create_siddhi_app_runtime(f"""
+define stream S ({fields}, symbol string, price double);
+from S[{cond}] select symbol, price insert into O;
+""", playback=True)
+
+
+def test_compatible_mixed_numeric_compare_ok():
+    # int vs double comparisons are legal and exact
+    got = run("""
+define stream S (x int, y double);
+from S[x < y] select x, y insert into O;
+""", [[1, 1.5], [2, 1.5]])
+    assert got == [[1, 1.5]]
+
+
+def test_string_equality_against_constant():
+    got = run("""
+define stream S (symbol string, v int);
+from S[symbol == 'IBM'] select v insert into O;
+""", [["IBM", 1], ["WSO2", 2], ["IBM", 3]])
+    assert [r[0] for r in got] == [1, 3]
+
+
+def test_bool_compare_bool_ok():
+    got = run("""
+define stream S (a bool, b bool, v int);
+from S[a == b] select v insert into O;
+""", [[True, True, 1], [True, False, 2], [False, False, 3]])
+    assert [r[0] for r in got] == [1, 3]
