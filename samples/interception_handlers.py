@@ -1,0 +1,68 @@
+"""Interception handlers: audit every source row, sink event, and table
+operation without touching app code (reference SourceHandler / SinkHandler /
+RecordTableHandler + their managers).
+
+Install managers on the SiddhiManager BEFORE creating runtimes; one handler
+instance is generated per wired source/sink/store table and registered under
+a unique element id."""
+
+import _common  # noqa: F401
+
+from siddhi_tpu import (
+    InMemoryBroker,
+    SiddhiManager,
+    SinkHandler,
+    SinkHandlerManager,
+    SourceHandler,
+    SourceHandlerManager,
+    StreamCallback,
+)
+
+
+class AuditSourceHandler(SourceHandler):
+    def send_event(self, row, input_handler):
+        print(f"  [source {self.definition.id}] in : {row}")
+        input_handler.send(row)          # forward (or drop by not calling)
+
+
+class AuditSinkHandler(SinkHandler):
+    def handle(self, event):
+        print(f"  [sink {self.definition.id}] out: {event.data}")
+        self.callback(event)
+
+
+class AuditSourceManager(SourceHandlerManager):
+    def generate_source_handler(self, source_type):
+        return AuditSourceHandler()
+
+
+class AuditSinkManager(SinkHandlerManager):
+    def generate_sink_handler(self):
+        return AuditSinkHandler()
+
+
+manager = SiddhiManager()
+manager.set_source_handler_manager(AuditSourceManager())
+manager.set_sink_handler_manager(AuditSinkManager())
+
+runtime = manager.create_siddhi_app_runtime("""
+@source(type='inMemory', topic='ticks', @map(type='passThrough'))
+define stream StockStream (symbol string, price double);
+
+@sink(type='inMemory', topic='alerts', @map(type='passThrough'))
+define stream HighPrice (symbol string, price double);
+
+from StockStream[price > 50.0] select symbol, price insert into HighPrice;
+""", playback=True)
+
+received = []
+unsub = InMemoryBroker.subscribe("alerts", received.append)
+runtime.add_callback("HighPrice", StreamCallback(lambda evs: None))
+runtime.start()
+
+for row in [["WSO2", 55.6], ["IBM", 40.0], ["GOOG", 120.0]]:
+    InMemoryBroker.publish("ticks", row)
+
+print(f"  delivered to transport: {[list(p.data) for p in received]}")
+unsub()
+manager.shutdown()
