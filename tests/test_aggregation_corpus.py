@@ -233,3 +233,62 @@ aggregate by ts every sec...min;
         [1496289950000, "IBM", 1],
         [1496289950000, "WSO2", 2],
     ]
+
+
+OOO_ROWS = [
+    # out-of-order aggregate-by timestamps (Aggregation2TestCase test47/48):
+    # the ...950000 bucket REOPENS after later-bucket events arrived
+    ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+    ["IBM", 100.0, None, 200, 16, 1496289951011],
+    ["IBM", 400.0, None, 200, 9, 1496289952000],
+    ["IBM", 900.0, None, 200, 60, 1496289950000],
+    ["WSO2", 500.0, None, 200, 7, 1496289951011],
+    ["IBM", 100.0, None, 200, 26, 1496289953000],
+    ["WSO2", 100.0, None, 200, 96, 1496289953000],
+]
+
+OOO_APP = STOCK + """
+define aggregation stockAggregation
+from stockStream
+select symbol, sum(price) as totalPrice, avg(price) as avgPrice
+group by symbol
+aggregate by ts every sec...year;
+"""
+
+
+@pytest.mark.parametrize("device", [False, True])
+def test_out_of_order_minute_granularity(device):
+    # test47: per minutes → one bucket, 2 symbol rows with full sums
+    app = OOO_APP if not device else OOO_APP.replace(
+        "define aggregation", "@device(batch='4')\ndefine aggregation")
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, playback=True)
+    rt.start()
+    _send_all(rt, OOO_ROWS)
+    events = rt.query('from stockAggregation within 0L, 1543664151000L '
+                      'per "minutes"')
+    got = sorted([list(e.data) for e in events])
+    m.shutdown()
+    assert len(got) == 2
+    assert got[0][1] == "IBM" and got[0][2] == pytest.approx(1500.0)
+    assert got[1][1] == "WSO2" and got[1][2] == pytest.approx(650.0)
+
+
+@pytest.mark.parametrize("device", [False, True])
+def test_out_of_order_second_granularity(device):
+    # test48: per seconds → 7 (bucket, symbol) rows incl. the reopened one
+    app = OOO_APP if not device else OOO_APP.replace(
+        "define aggregation", "@device(batch='4')\ndefine aggregation")
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app, playback=True)
+    rt.start()
+    _send_all(rt, OOO_ROWS)
+    events = rt.query('from stockAggregation within 0L, 1543664151000L '
+                      'per "seconds"')
+    got = [list(e.data) for e in events]
+    m.shutdown()
+    assert len(got) == 7
+    by_key = {(r[0], r[1]): r[2] for r in got}
+    assert by_key[(1496289950000, "IBM")] == pytest.approx(900.0)
+    assert by_key[(1496289950000, "WSO2")] == pytest.approx(50.0)
+    assert by_key[(1496289953000, "WSO2")] == pytest.approx(100.0)
