@@ -170,7 +170,10 @@ class SiddhiAppRuntime:
         # streams + junctions (+ fault streams)
         for sd in app.stream_definitions.values():
             self._get_junction(sd.id, define=sd)
-            async_ann = find_annotation(sd.annotations, "async")
+            # stream-level @async, or app-wide @app:async applying to every
+            # defined stream (reference AsyncTestCase.asyncTest2)
+            async_ann = find_annotation(sd.annotations, "async") \
+                or find_annotation(app.annotations, "async")
             if async_ann is not None:
                 # Disruptor-mode analog (StreamJunction.java:279-316):
                 # producers enqueue, workers deliver under the engine lock

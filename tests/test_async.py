@@ -269,3 +269,30 @@ def test_async_backpressure_grows_not_deadlocks():
     assert n[0] == 100
     assert time.monotonic() - t0 < 30.0
     m.shutdown()
+
+
+def test_app_level_async_annotation():
+    """Reference AsyncTestCase.asyncTest2: @app:async(buffer.size='2')
+    makes EVERY defined stream's junction asynchronous."""
+    import time as _time
+
+    from siddhi_tpu import SiddhiManager, StreamCallback
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:async(buffer.size='2')
+        define stream S (v int);
+        from S select v insert into O;
+    """, playback=True)
+    assert rt.ctx.stream_junctions["S"].dispatcher is not None
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    ih = rt.input_handler("S")
+    for i in range(5):
+        ih.send([i], timestamp=1000 + i)
+    deadline = _time.time() + 5.0
+    while len(got) < 5 and _time.time() < deadline:
+        _time.sleep(0.02)
+    m.shutdown()
+    assert sorted(e.data[0] for e in got) == [0, 1, 2, 3, 4]
