@@ -449,6 +449,10 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                 def __init__(self):
                     self.compiled = compiled
                     self.builder = BatchBuilder(compiled.schema, batch)
+                    # drain steps run on the WORKER thread in async mode —
+                    # they must not touch the producer's live builder
+                    self._drain_builder = BatchBuilder(compiled.schema,
+                                                       batch)
                     self.state = compiled.init_state()
                     # segment clock high-water: arrival ts, or the
                     # externalTimeBatch attribute column
@@ -495,6 +499,23 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                     lock — device state is worker-owned)."""
                     self.state, out = self.compiled.step(self.state, b)
                     rows = self.compiled.decode_outputs(out)
+                    # hopping defers boundary flushes past the per-step
+                    # capacity (long gaps span more hops than one step
+                    # covers): drain them with empty steps, same as
+                    # DeviceStreamRuntime.flush
+                    if self.compiled.window_kind == "hopping":
+                        from ..tpu.query_compile import _TS_NEG
+                        import jax as _jax
+                        while True:
+                            hop_next, last_ts = (
+                                int(v) for v in _jax.device_get(
+                                    (self.state["hop_next"],
+                                     self.state["last_ts"])))
+                            if hop_next <= _TS_NEG or hop_next > last_ts:
+                                break
+                            self.state, out = self.compiled.step(
+                                self.state, self._drain_builder.emit())
+                            rows.extend(self.compiled.decode_outputs(out))
                     self._check_counters()
                     return rows
 
