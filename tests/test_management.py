@@ -701,3 +701,16 @@ def test_start_without_sources_then_start_sources(manager):
         assert [e.data for e in got] == [[2]]
     finally:
         unsub()
+
+
+def test_cron_trigger_fires_on_schedule(manager):
+    """Cron trigger: quartz-style 6-field expression fires on second
+    boundaries (reference TriggerTestCase cron shape)."""
+    rt, got = setup(manager, """
+        define trigger T at '*/2 * * * * ?';
+        from T select triggered_time insert into O;
+    """)
+    # playback clock starts at 0; */2 fires at even seconds
+    rt.advance_time(6500)
+    assert len(got) == 3
+    assert [e.data[0] % 2000 for e in got] == [0, 0, 0]
