@@ -185,3 +185,32 @@ def test_pattern_snapshot_restore(manager):
     rt2.restore(blob)
     rt2.input_handler("B").send([2], timestamp=5)
     assert [e.data for e in got2] == [[1, 2]]
+
+
+def test_every_reseeds_after_partial_dies_past_scope_end():
+    """Fuzz regression (r5 defect #4): `every e1=A[..]<1:3> -> e2=B[..]`
+    whose instance advanced past the every scope and then within-expired at
+    e2 must re-seed the scope — later chains must still match."""
+    from siddhi_tpu import SiddhiManager, StreamCallback
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream A (k string, v long);
+        define stream B (k string, v long);
+        from every e1=A[v > 60]<1:3> -> e2=B[k == e1.k] -> e3=A[v > 20]
+        within 300
+        select e1.v as v1, e2.v as v2, e3.v as v3 insert into OutputStream;
+    """, playback=True, start_time=1_000_000)
+    rows = []
+    rt.add_callback("OutputStream", StreamCallback(
+        lambda evs: rows.extend(list(e.data) for e in evs)))
+    rt.start()
+    for sid, row, ts in [
+            ("A", ["y", 93], 820),     # seed consumed; chain advances to e2
+            ("B", ["y", 64], 2640),    # within-expired AT e2 → must re-seed
+            ("A", ["y", 64], 3240),    # fresh chain on the re-seeded scope
+            ("B", ["y", 33], 3340),
+            ("A", ["y", 57], 3360)]:
+        rt.input_handler(sid).send(list(row), timestamp=1_000_000 + ts)
+    m.shutdown()
+    assert rows == [[64, 33, 57]]
