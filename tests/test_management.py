@@ -714,3 +714,42 @@ def test_cron_trigger_fires_on_schedule(manager):
     rt.advance_time(6500)
     assert len(got) == 3
     assert [e.data[0] % 2000 for e in got] == [0, 0, 0]
+
+
+def test_restart_after_shutdown(manager):
+    """StartStopTestCase shape: a runtime can start → shutdown → start
+    again and keep processing."""
+    rt, got = setup(manager, """
+        define stream S (v int);
+        from S select v insert into O;
+    """)
+    rt.input_handler("S").send([1], timestamp=1)
+    rt.shutdown()
+    rt.start()
+    rt.input_handler("S").send([2], timestamp=2)
+    assert [e.data[0] for e in got] == [1, 2]
+
+
+def test_stream_and_query_callbacks_receive_same_rows(manager):
+    """CallbackTestCase shape: a StreamCallback on the output stream and a
+    QueryCallback on the query observe the same emissions."""
+    from siddhi_tpu import QueryCallback as _QC
+
+    rt = manager.create_siddhi_app_runtime("""
+        define stream S (v int);
+        @info(name='q') from S[v > 1] select v insert into O;
+    """, playback=True)
+    srows, qrows = [], []
+    rt.add_callback("O", StreamCallback(lambda evs: srows.extend(evs)))
+
+    class QC(_QC):
+        def receive(self, ts, cur, exp):
+            if cur:
+                qrows.extend(cur)
+
+    rt.add_query_callback("q", QC())
+    rt.start()
+    for i, v in enumerate([1, 2, 3]):
+        rt.input_handler("S").send([v], timestamp=1000 + i)
+    assert [e.data[0] for e in srows] == [2, 3]
+    assert [e.data[0] for e in qrows] == [2, 3]
