@@ -54,6 +54,14 @@ LAT_CREATION_CAP = int(os.environ.get(
     "BENCH_LAT_CREATION_CAP", max(64, LAT_LANE_BATCH // 4)))
 # detection-latency SLO the closed-loop search reports against
 LAT_BUDGET_MS = float(os.environ.get("BENCH_LAT_BUDGET_MS", 100.0))
+# BENCH_ADAPTIVE=1: the flow subsystem's AIMD controller
+# (siddhi_tpu/flow/adaptive_batch.py) picks the deadline-flush window from
+# observed step latency instead of the hand-tuned BENCH_LAT_WINDOW; the
+# chosen size ships in the JSON as "adaptive_batch_size". Off by default —
+# the recorded bench numbers stay on the static path.
+ADAPTIVE = os.environ.get("BENCH_ADAPTIVE", "") == "1"
+ADAPTIVE_TARGET_MS = float(
+    os.environ.get("BENCH_ADAPTIVE_TARGET_MS", LAT_BUDGET_MS / 2))
 SLOT_CAP = int(os.environ.get("BENCH_SLOT_CAP", 64))
 N_DEVICES_KEYS = 256          # distinct device ids in the synthetic stream
 DEVICE_EVENTS = int(os.environ.get("BENCH_EVENTS", 2_000_000))
@@ -388,6 +396,35 @@ def child_device() -> None:
     # overload bug: capacity varies across the run)
     lstate, ys = lrun_once(lrt.state, wpacked[0])
     fence(lstate)
+    adaptive = None
+    if ADAPTIVE:
+        # converge the window under the AIMD controller, then repack with
+        # the chosen size. Lane shapes are static (LAT_LANE_BATCH), so a
+        # different window only changes fill counts — no recompilation.
+        import jax.numpy as _jnp
+
+        from siddhi_tpu.flow.adaptive_batch import AdaptiveBatchController
+        _amax = window * 4
+        ctrl = AdaptiveBatchController(
+            min_batch=min(max(256, LAT_LANE_BATCH), _amax), max_batch=_amax,
+            target_ms=ADAPTIVE_TARGET_MS, initial=window, cooldown=1)
+        for _ in range(6):
+            w = ctrl.current
+            apacked = _pack_windowed(lrt, lat_events[: w * 8], w)
+            st = lrt.init_state()
+            for b in apacked:
+                t0 = time.perf_counter()
+                st, ys = lrun_once(st, b)
+                int(jax.device_get(_jnp.sum(ys["mask"])))
+                ctrl.observe(int(b["count"]), time.perf_counter() - t0)
+            if ctrl.current == w:
+                break               # operating point converged
+        window = ctrl.current
+        wpacked = _pack_windowed(lrt, lat_events, window)
+        adaptive = ctrl.report()
+        print(f"# adaptive window: {window} events (target "
+              f"{ADAPTIVE_TARGET_MS}ms, observed p99 {adaptive['p99_ms']}ms, "
+              f"static default {LAT_WINDOW})", file=sys.stderr)
     state2 = lrt.init_state()
     t0 = time.perf_counter()
     for b in wpacked:
@@ -458,7 +495,7 @@ def child_device() -> None:
     ort.flush()
     oracle_matches = ort.match_count
 
-    print(json.dumps({
+    child_out = {
         "rate": rate, "matches": matches, "drops": drops,
         "p50_ms": best["p50_ms"], "p99_ms": best["p99_ms"],
         "offered_evps": best["offered_evps"],
@@ -475,7 +512,10 @@ def child_device() -> None:
         "ingress": ingress_kind,
         "fence": "device_get",
         "platform": jax.default_backend(),
-    }))
+    }
+    if adaptive is not None:        # BENCH_ADAPTIVE=1 only: default JSON
+        child_out["adaptive"] = adaptive    # stays byte-identical
+    print(json.dumps(child_out))
 
 
 def child_host() -> None:
@@ -649,6 +689,9 @@ def main() -> None:
                     device["rate"] / (host["rate"] * 15), 2),
             },
         }
+        if device.get("adaptive"):
+            out["adaptive_batch_size"] = device["adaptive"]["batch_size"]
+            out["adaptive"] = device["adaptive"]
         if not oracle_ok:
             notes.append(
                 f"ORACLE MISMATCH: device={device.get('oracle_matches')} "

@@ -100,6 +100,15 @@ class SiddhiAppRuntime:
         self.persistence = PersistenceManager(
             self.ctx, self.snapshot_service, siddhi_context.persistence_store)
 
+        # @app:adaptive(...): device micro-batch flush thresholds adapt to
+        # observed rate/latency — parsed before _build so device bridges can
+        # attach controllers as they compile
+        adaptive_ann = find_annotation(app.annotations, "adaptive")
+        if adaptive_ann is not None:
+            from ..flow.adaptive_batch import parse_adaptive_annotation
+            self.ctx.adaptive_cfg = parse_adaptive_annotation(adaptive_ann)
+        self.flow = None                # FlowSubsystem when @app:wal/@app:backpressure
+
         self._build()
 
     # ------------------------------------------------------------------ build
@@ -248,6 +257,13 @@ class SiddhiAppRuntime:
                 self.partition_runtimes.append(prt)
         # sources & sinks from stream annotations
         self._wire_io()
+        # durable flow control (@app:wal / @app:backpressure) — after
+        # junctions exist and @async dispatchers are configured
+        wants_flow = find_annotation(app.annotations, "wal") is not None \
+            or find_annotation(app.annotations, "backpressure") is not None
+        if wants_flow:
+            from ..flow import build_flow
+            self.flow = build_flow(self)
         self._wire_gauges()
 
     def _wire_gauges(self) -> None:
@@ -271,6 +287,26 @@ class SiddhiAppRuntime:
         for element_id, holder in self.ctx.state_registry.items():
             if not element_id.startswith("device-"):
                 sm.memory_tracker(element_id, lambda h=holder: h)
+        # flow-control gauges: wal_bytes / queue_depth / credits / shed_count
+        if self.flow is not None:
+            for sid, sf in self.flow.streams.items():
+                if sf.wal is not None:
+                    sm.gauge_tracker(f"flow.{sid}.wal_bytes",
+                                     lambda w=sf.wal: w.wal_bytes)
+                if sf.gate is not None:
+                    sm.gauge_tracker(f"flow.{sid}.queue_depth",
+                                     lambda g=sf.gate: g.depth)
+                    sm.gauge_tracker(f"flow.{sid}.credits",
+                                     lambda g=sf.gate: g.credits)
+                sm.gauge_tracker(f"flow.{sid}.shed_count",
+                                 lambda s=sf.stats: s.shed)
+                sm.gauge_tracker(f"flow.{sid}.dropped_oldest",
+                                 lambda s=sf.stats: s.dropped_oldest)
+        for b in self.device_bridges:
+            ctrl = getattr(b.runtime, "batch_controller", None)
+            if ctrl is not None:
+                sm.gauge_tracker(f"device.{b.query_name}.batch_size",
+                                 lambda c=ctrl: c.current)
 
     def _stream_defs(self) -> dict:
         defs = dict(self.app.stream_definitions)
@@ -419,6 +455,8 @@ class SiddhiAppRuntime:
             if stream_id not in self.ctx.stream_junctions:
                 raise KeyError(f"stream '{stream_id}' is not defined")
             ih = InputHandler(stream_id, self.ctx.stream_junctions[stream_id], self.ctx)
+            if self.flow is not None:
+                self.flow.attach(ih)
             self.input_handlers[stream_id] = ih
         return ih
 
@@ -514,6 +552,8 @@ class SiddhiAppRuntime:
                    "table": sc.record_table_handler_manager}[kind]
             if mgr is not None:
                 getattr(mgr, f"unregister_{'record_table' if kind == 'table' else kind}_handler")(hid)
+        if self.flow is not None:
+            self.flow.close()
         self.ctx.statistics_manager.stop_reporting()
         if self.ctx.ticker is not None:
             self.ctx.ticker.stop()
@@ -575,9 +615,14 @@ class SiddhiAppRuntime:
     def persist(self) -> str:
         self._pre_snapshot()
         try:
-            return self.persistence.persist()
+            revision = self.persistence.persist()
         finally:
             self._post_snapshot()
+        if self.flow is not None:
+            # the checkpoint is durable: WAL segments below its watermark
+            # are acked and can be dropped
+            self.flow.on_persisted()
+        return revision
 
     def restore_revision(self, revision: str) -> None:
         self._pre_snapshot()
@@ -729,7 +774,8 @@ class _TableInputHandler:
         self.app_context = app_context
 
     def send(self, rows, timestamp=None) -> None:
-        if rows and not isinstance(rows[0], list):
+        # a bare row may be a list OR a tuple (mirrors InputHandler payloads)
+        if rows and not isinstance(rows[0], (list, tuple)):
             rows = [rows]
         ts = timestamp if timestamp is not None \
             else self.app_context.current_time()

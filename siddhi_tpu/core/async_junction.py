@@ -34,6 +34,8 @@ import logging
 import threading
 from typing import Optional
 
+from ..flow.backpressure import rlock_owned
+
 log = logging.getLogger("siddhi_tpu.async")
 
 # how long a producer waits on a full buffer before growing it instead
@@ -54,6 +56,7 @@ class AsyncDispatcher:
         self.batch_size_max = max(1, batch_size_max)
 
         self._q: collections.deque = collections.deque()
+        self._n_events = 0                  # EVENTS queued (items may be chunks)
         self._cv = threading.Condition()
         self._busy = 0                      # workers currently delivering
         self._stopped = False
@@ -99,6 +102,17 @@ class AsyncDispatcher:
     def buffered_events(self) -> int:
         return len(self._q)
 
+    @property
+    def buffered_event_count(self) -> int:
+        """Queued EVENTS (a ('chunk', [...]) item holds many) — the credit
+        gate's depth unit (``flow/backpressure.py`` counts credits in events,
+        so item-count depth would overrun the bound by the chunk size)."""
+        return self._n_events
+
+    @staticmethod
+    def _item_size(item) -> int:
+        return len(item[1]) if item[0] == "chunk" else 1
+
     def enqueue(self, item) -> None:
         """item: ('event', StreamEvent) | ('chunk', list[StreamEvent]).
 
@@ -112,10 +126,7 @@ class AsyncDispatcher:
         if not self._started:
             self.start()
         root = getattr(self.app_context, "root_lock", None)
-        # RLock._is_owned is CPython-private; if absent, assume the producer
-        # might hold the lock (never block — the pre-r4 behavior)
-        owned = getattr(root, "_is_owned", None)
-        may_block = root is None or (owned is not None and not owned())
+        may_block = root is None or not rlock_owned(root)
         with self._cv:
             while len(self._q) >= self.buffer_size:
                 if may_block and not self._stopped:
@@ -124,10 +135,23 @@ class AsyncDispatcher:
                 self.soft_overflows += 1
                 break
             self._q.append(item)
+            self._n_events += self._item_size(item)
             self.total_enqueued += 1
             if len(self._q) > self.high_water:
                 self.high_water = len(self._q)
             self._cv.notify()
+
+    def drop_oldest(self):
+        """Evict and return the oldest queued item (``('event', ev)`` /
+        ``('chunk', [evs])``), or None when the queue is empty — the
+        DROP_OLDEST overload policy's hook (``flow/backpressure.py``)."""
+        with self._cv:
+            if not self._q:
+                return None
+            item = self._q.popleft()
+            self._n_events -= self._item_size(item)
+            self._cv.notify_all()       # wake producers blocked on full
+            return item
 
     # -- worker side ---------------------------------------------------------
     def _run(self) -> None:
@@ -150,6 +174,10 @@ class AsyncDispatcher:
                               self.junction.definition.id)
             finally:
                 with self._cv:
+                    # credits free only when delivery COMPLETES: an in-flight
+                    # batch still counts against the gate's bound, or the
+                    # gate would over-admit by workers * batch_size_max
+                    self._n_events -= sum(self._item_size(i) for i in batch)
                     self._busy -= 1
                     self._cv.notify_all()   # wake quiesce() waiters
 

@@ -52,6 +52,7 @@ class SiddhiAppContext:
         self.state_registry: dict[str, Any] = {}
         self._element_counter = 0
 
+        self.adaptive_cfg: Optional[dict] = None    # @app:adaptive(...) kwargs
         self.exception_listener: Optional[Callable[[Exception], None]] = None
         self.debugger = None
         self.runtime = None                         # back-ref set by SiddhiAppRuntime

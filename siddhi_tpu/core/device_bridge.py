@@ -28,6 +28,7 @@ from ..query_api import (
     StateInputStream,
 )
 from ..query_api.annotation import find_annotation
+from ..flow.adaptive_batch import AdaptiveFlushMixin
 from .event import Event, EventType, StreamEvent
 
 log = logging.getLogger("siddhi_tpu.device")
@@ -94,8 +95,12 @@ class AsyncDeviceDriver:
                 try:
                     t0 = time.perf_counter()
                     rows = self.rt.process(batch)
-                    self.step_seconds += time.perf_counter() - t0
+                    dt = time.perf_counter() - t0
+                    self.step_seconds += dt
                     self.batches_stepped += 1
+                    observe = getattr(self.rt, "observe_step", None)
+                    if observe is not None:
+                        observe(batch.get("count", 0), dt)
                 except Exception:   # noqa: BLE001 — keep the worker alive;
                     # the error surfaces through the exception listener path
                     log.exception("device step failed")
@@ -176,7 +181,7 @@ class AsyncDeviceDriver:
         self._thread.join(timeout=10.0)
 
 
-class _DeviceRTBase:
+class _DeviceRTBase(AdaptiveFlushMixin):
     """Shared packing→step dispatch for bridge runtimes: a full builder is
     either handed to the async driver (packing overlaps compute) or stepped
     synchronously. Subclasses define ``process(batch) -> rows``."""
@@ -203,7 +208,7 @@ class _DeviceRTBase:
         if self.driver is not None:
             self.driver.submit(b)
             return
-        self.deliver(self.process(b), b.get("last_ts"))
+        self.deliver(self._timed_process(b), b.get("last_ts"))
 
     def finalize(self):
         """Terminal flush at shutdown (kernels that hold an open segment
@@ -468,8 +473,7 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                         self._last_clk = clk if self._last_clk is None \
                             else max(self._last_clk, clk)
                     self.builder.append(row, timestamp)
-                    if self.builder.full:
-                        self.flush()
+                    self._maybe_flush()
 
                 def finalize(self):
                     """Force-close the open timeBatch bucket at shutdown: a
@@ -600,8 +604,7 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
 
                 def send(self, stream_id, row, timestamp=0):
                     self.builder.append(stream_id, row, timestamp)
-                    if self.builder.full:
-                        self.flush()
+                    self._maybe_flush()
 
                 def process(self, b):
                     self.state, out = self.compiled.step(self.state, b)
@@ -642,6 +645,14 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
         log.info("query '%s' falls back to host path: %s", name, e)
         return None
 
+    if app_context.adaptive_cfg is not None:
+        # @app:adaptive: flush thresholds track observed rate/latency; the
+        # query's own batch capacity caps the adjustable range
+        from ..flow.adaptive_batch import AdaptiveBatchController
+        cfg = dict(app_context.adaptive_cfg)
+        cfg["max_batch"] = min(cfg.get("max_batch", batch), batch)
+        cfg["min_batch"] = min(cfg.get("min_batch", 64), cfg["max_batch"])
+        rt.batch_controller = AdaptiveBatchController(**cfg)
     app_context.register_state(f"device-{name}", _BridgeState(bridge))
     return bridge
 

@@ -27,13 +27,17 @@ class StreamEvent:
     (reference ``GroupedComplexEvent``): grouped first/last output rate
     limiters batch per key, not per event stream."""
 
-    __slots__ = ("timestamp", "data", "type", "group_key")
+    __slots__ = ("timestamp", "data", "type", "group_key", "flow_seq")
 
     def __init__(self, timestamp: int, data: list, type: EventType = EventType.CURRENT):
         self.timestamp = timestamp
         self.data = data
         self.type = type
         self.group_key = None
+        # WAL sequence number on flow-controlled ingress events (None
+        # otherwise): the junction advances the stream's applied watermark
+        # with it at delivery (siddhi_tpu/flow)
+        self.flow_seq = None
 
     def copy(self) -> "StreamEvent":
         return StreamEvent(self.timestamp, list(self.data), self.type)

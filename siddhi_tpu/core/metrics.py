@@ -129,6 +129,23 @@ class MemoryUsageTracker:
             return 0
 
 
+class GaugeTracker:
+    """Generic numeric gauge over a callable — the flow subsystem's
+    wal_bytes / queue_depth / credits / shed_count / batch_size readouts
+    (counterpart of the reference's Dropwizard ``Gauge`` registrations)."""
+
+    def __init__(self, name: str, value_fn: Callable[[], float]):
+        self.name = name
+        self._value_fn = value_fn
+
+    @property
+    def value(self):
+        try:
+            return self._value_fn()
+        except Exception:       # noqa: BLE001 — a dead gauge reads 0
+            return 0
+
+
 class Reporter:
     """Reporter SPI: receives the report dict every interval."""
 
@@ -157,6 +174,7 @@ class StatisticsManager:
         self.latency: dict[str, LatencyTracker] = {}
         self.buffered: dict[str, BufferedEventsTracker] = {}
         self.memory: dict[str, MemoryUsageTracker] = {}
+        self.gauges: dict[str, GaugeTracker] = {}
         self.reporter: Optional[Reporter] = None
         self.report_interval_s: float = 60.0
         self._timer: Optional[threading.Timer] = None
@@ -176,6 +194,9 @@ class StatisticsManager:
     def memory_tracker(self, name: str, target_fn) -> MemoryUsageTracker:
         return self.memory.setdefault(
             name, MemoryUsageTracker(name, target_fn))
+
+    def gauge_tracker(self, name: str, value_fn) -> GaugeTracker:
+        return self.gauges.setdefault(name, GaugeTracker(name, value_fn))
 
     def set_level(self, level: Level) -> None:
         self.level = level
@@ -234,6 +255,8 @@ class StatisticsManager:
             "buffered_events": {k: v.buffered
                                 for k, v in self.buffered.items()},
         }
+        if self.gauges:
+            data["gauges"] = {k: v.value for k, v in self.gauges.items()}
         if self.level == Level.DETAIL:
             data["memory_bytes"] = {k: v.bytes
                                     for k, v in self.memory.items()}

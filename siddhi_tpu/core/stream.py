@@ -42,6 +42,7 @@ class StreamJunction:
         self.fault_junction: Optional["StreamJunction"] = None
         self.throughput = 0
         self.dispatcher = None             # AsyncDispatcher when @async
+        self.flow = None                   # StreamFlow when @app:wal/@app:backpressure
 
     def subscribe(self, receiver) -> None:
         if receiver not in self.receivers:
@@ -88,6 +89,10 @@ class StreamJunction:
                 # one faulty query must not starve the other subscribers
                 if first_error is None:
                     first_error = e
+        if self.flow is not None and event.flow_seq is not None:
+            # applied watermark advances under the engine lock: a quiesced
+            # snapshot records a cut at a WAL record boundary
+            self.flow.on_applied(event.flow_seq)
         if first_error is not None:
             self.handle_error(event, first_error)
 
@@ -104,6 +109,10 @@ class StreamJunction:
             except Exception as e:  # noqa: BLE001
                 if first_error is None:
                     first_error = e
+        if self.flow is not None:
+            seqs = [e.flow_seq for e in events if e.flow_seq is not None]
+            if seqs:
+                self.flow.on_applied(max(seqs))
         if first_error is not None:
             self.handle_error(events[-1], first_error)
 
@@ -136,9 +145,13 @@ class InputHandler:
         self.stream_id = stream_id
         self.junction = junction
         self.app_context = app_context
+        self.flow = None                # StreamFlow: WAL + admission gate
 
     def send(self, data, timestamp: Optional[int] = None) -> None:
         """Accepts ``[a, b, c]``, ``Event``, or ``list[Event]``."""
+        if self.flow is not None and not self.flow.replaying:
+            self._send_flow(data, timestamp)
+            return
         if self.junction.dispatcher is not None:
             # async junction: producers only touch the queue mutex — the
             # watermark advances at DELIVERY time on the worker (under the
@@ -180,6 +193,67 @@ class InputHandler:
             else:
                 ts = timestamp if timestamp is not None else self.app_context.current_time()
                 self._send_one(ts, list(data))
+
+    def _send_flow(self, data, timestamp: Optional[int]) -> None:
+        """Flow-controlled ingress: admission (overload policy) + WAL append
+        ahead of delivery, then the vanilla dispatch semantics.
+
+        The stream's flow lock is held from seq assignment through
+        enqueue/delivery so WAL sequence order equals delivery order — a
+        checkpoint watermark can then never cover a logged-but-undelivered
+        lower seq (which recovery would skip, losing the event). Admission
+        runs before the lock: BLOCK may sleep, and under the sync junction
+        the lock order is root_lock → flow.lock everywhere."""
+        chunk = False
+        if isinstance(data, Event):
+            rows, tss = [list(data.data)], [data.timestamp]
+        elif data and isinstance(data[0], Event):
+            rows = [list(ev.data) for ev in data]
+            tss = [ev.timestamp for ev in data]
+            chunk = True
+        else:
+            ts = timestamp if timestamp is not None \
+                else self.app_context.current_time()
+            rows, tss = [list(data)], [ts]
+        for row in rows:
+            self._check_arity(row)       # malformed rows must not hit the WAL
+        if not self.flow.admit(len(rows)):
+            return                       # whole call shed by the gate
+
+        def build():
+            events = [StreamEvent(ts, row, EventType.CURRENT)
+                      for row, ts in zip(rows, tss)]
+            seqs = self.flow.log(rows, tss)
+            if seqs is not None:
+                for ev, seq in zip(events, seqs):
+                    ev.flow_seq = seq
+            return events
+
+        try:
+            if self.junction.dispatcher is not None:
+                with self.flow.lock:
+                    events = build()
+                    if chunk:
+                        self.junction.send_events(events)
+                    else:
+                        self.junction.send_event(events[0])
+                return
+            with self.app_context.root_lock:
+                with self.flow.lock:
+                    events = build()
+                    if chunk:
+                        self.app_context.advance_time(
+                            min(ev.timestamp for ev in events))
+                        self.junction.send_events(events)
+                        self.app_context.advance_time(
+                            max(ev.timestamp for ev in events))
+                    else:
+                        self.app_context.advance_time(events[0].timestamp)
+                        self.junction.send_event(events[0])
+        finally:
+            # the events are queued (depth_fn counts them) or delivery
+            # failed: either way the admission reservation is done
+            self.flow.release(len(rows))
 
     def _check_arity(self, data) -> None:
         defn = self.junction.definition

@@ -1,0 +1,160 @@
+"""Adaptive device micro-batching: batch size from observed rate + latency.
+
+TiLT (PAPERS.md) motivates adapting batch granularity to the observed
+arrival rate instead of a hand-tuned constant — which is exactly what the
+bench's ``BENCH_LAT_WINDOW``-style env knobs do today. The controller runs
+AIMD over the *flush threshold* (a soft fill target ≤ the builder's static
+capacity, so jitted shapes never change):
+
+- every stepped batch reports ``observe(n_events, latency_s)``;
+- if the recent p99 step latency exceeds the target, the threshold halves
+  (multiplicative decrease — drain the pipeline fast under overload);
+- if p99 sits comfortably under the target (< half) and batches are actually
+  filling to the threshold, it grows additively (slow start toward device
+  efficiency);
+- adjustments are rate-limited by a cooldown so one outlier can't thrash
+  the operating point.
+
+The chosen size is exported as the ``batch_size`` gauge and read by the
+device bridges' flush check (:class:`AdaptiveFlushMixin`). A flush
+*deadline* rides along: the suggested maximum time a partial batch may wait
+before being flushed, derived from the latency target and the observed
+arrival rate.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Optional
+
+
+class AdaptiveBatchController:
+    """AIMD controller over the device flush threshold."""
+
+    def __init__(self, min_batch: int = 64, max_batch: int = 8192,
+                 target_ms: float = 25.0, initial: Optional[int] = None,
+                 history: int = 64, cooldown: int = 4):
+        if min_batch < 1 or max_batch < min_batch:
+            raise ValueError(
+                f"bad adaptive batch bounds [{min_batch}, {max_batch}]")
+        self.min_batch = int(min_batch)
+        self.max_batch = int(max_batch)
+        self.target_ms = float(target_ms)
+        self.current = min(self.max_batch,
+                           max(self.min_batch,
+                               int(initial) if initial else self.min_batch))
+        self._lat_ms: collections.deque = collections.deque(maxlen=history)
+        self._cooldown = max(1, int(cooldown))
+        self._since_adjust = 0
+        self.rate_evps = 0.0            # EMA of observed arrival rate
+        self.observations = 0
+        self.adjustments = 0
+
+    # -- feedback --------------------------------------------------------------
+    def observe(self, n_events: int, latency_s: float) -> int:
+        """Report one stepped batch; returns the (possibly new) threshold."""
+        self.observations += 1
+        lat_ms = max(0.0, float(latency_s) * 1e3)
+        self._lat_ms.append(lat_ms)
+        if latency_s > 0 and n_events > 0:
+            inst = n_events / latency_s
+            self.rate_evps = inst if self.rate_evps == 0.0 \
+                else 0.8 * self.rate_evps + 0.2 * inst
+        self._since_adjust += 1
+        if self._since_adjust < self._cooldown:
+            return self.current
+        p99 = self.p99_ms
+        if p99 > self.target_ms:
+            nxt = max(self.min_batch, self.current // 2)
+        elif p99 < self.target_ms * 0.5 and n_events >= self.current:
+            # only grow when batches actually fill the threshold — growing on
+            # a trickle would just add queueing delay
+            nxt = min(self.max_batch,
+                      self.current + max(self.min_batch // 2, 1))
+        else:
+            return self.current
+        if nxt != self.current:
+            self.current = nxt
+            self.adjustments += 1
+        self._since_adjust = 0
+        return self.current
+
+    # -- readouts --------------------------------------------------------------
+    @property
+    def p99_ms(self) -> float:
+        if not self._lat_ms:
+            return 0.0
+        xs = sorted(self._lat_ms)
+        return xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+
+    @property
+    def flush_deadline_ms(self) -> float:
+        """How long a partial batch may wait before a deadline flush: the
+        latency budget left after one step at current p99, floored so the
+        deadline never collapses to busy-flushing."""
+        return max(1.0, self.target_ms - self.p99_ms)
+
+    def report(self) -> dict:
+        return {
+            "batch_size": self.current,
+            "min": self.min_batch,
+            "max": self.max_batch,
+            "target_ms": self.target_ms,
+            "p99_ms": round(self.p99_ms, 3),
+            "rate_evps": round(self.rate_evps),
+            "flush_deadline_ms": round(self.flush_deadline_ms, 3),
+            "observations": self.observations,
+            "adjustments": self.adjustments,
+        }
+
+
+class AdaptiveFlushMixin:
+    """Device-runtime hooks shared by every bridge runtime (stream/join
+    bridges in ``core/device_bridge.py``, the NFA runtime in ``tpu/nfa.py``):
+    flush when the builder hits its hard capacity OR the controller's soft
+    threshold, and feed sync-path step timings to the controller. Expects the
+    host class to provide ``builder`` (with ``full`` and ``__len__``),
+    ``flush()`` and ``process(batch)``."""
+
+    batch_controller = None     # AdaptiveBatchController via @app:adaptive
+
+    def _maybe_flush(self) -> None:
+        """Flush on the hard capacity OR the adaptive soft threshold (jitted
+        shapes stay static at capacity; only the fill level changes)."""
+        c = self.batch_controller
+        if self.builder.full or (c is not None
+                                 and len(self.builder) >= c.current):
+            self.flush()
+
+    def observe_step(self, n_events: int, latency_s: float) -> None:
+        """Feed one stepped batch's latency to the adaptive controller (the
+        async driver reports its own step timing through this hook)."""
+        c = self.batch_controller
+        if c is not None:
+            c.observe(n_events, latency_s)
+
+    def _timed_process(self, batch: dict):
+        """Sync-path ``process(batch)``, timed for the controller."""
+        if self.batch_controller is None:
+            return self.process(batch)
+        t0 = time.perf_counter()
+        rows = self.process(batch)
+        self.observe_step(batch.get("count", 0), time.perf_counter() - t0)
+        return rows
+
+
+def parse_adaptive_annotation(ann) -> dict:
+    """``@app:adaptive(target.ms='25', min='64', initial='256')`` → config
+    kwargs for :class:`AdaptiveBatchController` (``max`` defaults to each
+    query's own batch capacity at attach time)."""
+    cfg = {}
+    if ann.get("target.ms"):
+        cfg["target_ms"] = float(ann.get("target.ms"))
+    if ann.get("min"):
+        cfg["min_batch"] = int(ann.get("min"))
+    if ann.get("max"):
+        cfg["max_batch"] = int(ann.get("max"))
+    if ann.get("initial"):
+        cfg["initial"] = int(ann.get("initial"))
+    return cfg

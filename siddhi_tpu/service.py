@@ -9,6 +9,12 @@ on request. Endpoints (stdlib http.server, threaded; no framework deps):
     POST   /siddhi-apps                      body = SiddhiQL text → deploy+start
     GET    /siddhi-apps                      list deployed app names
     GET    /siddhi-apps/{name}/status        {"state": "running"|"stopped"}
+    GET    /siddhi-apps/{name}/flow          flow-control stats (WAL bytes,
+                                             watermarks, queue depth/credits,
+                                             shed counts, adaptive batch size)
+    POST   /siddhi-apps/{name}/recover       checkpoint restore + WAL replay
+                                             (flow/recovery.py); body may be
+                                             JSON {"revision": "..."}
     DELETE /siddhi-apps/{name}               undeploy (shutdown + forget)
     POST   /siddhi-apps/{name}/streams/{sid} body = JSON {"data": [...],
                                              "timestamp": ms?} → send event
@@ -63,6 +69,10 @@ class SiddhiService:
                         and parts[2] == "streams":
                     code, payload = service.send_event(
                         parts[1], parts[3], self._body().decode())
+                elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                        and parts[2] == "recover":
+                    code, payload = service.recover(
+                        parts[1], self._body().decode())
                 else:
                     code, payload = 404, {"status": "ERROR",
                                           "message": "unknown path"}
@@ -76,6 +86,10 @@ class SiddhiService:
                 elif len(parts) == 3 and parts[0] == "siddhi-apps" \
                         and parts[2] == "status":
                     code, payload = service.status(parts[1])
+                    self._reply(code, payload)
+                elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                        and parts[2] == "flow":
+                    code, payload = service.flow_stats(parts[1])
                     self._reply(code, payload)
                 else:
                     self._reply(404, {"status": "ERROR",
@@ -159,6 +173,48 @@ class SiddhiService:
         except Exception as e:
             return 400, {"status": "ERROR", "message": str(e)}
         return 200, {"status": "OK", "message": "event sent"}
+
+    def flow_stats(self, name: str) -> tuple[int, dict]:
+        """Flow-control observability: WAL/backpressure stats plus any
+        adaptive device batch sizes."""
+        rt = self.runtimes.get(name)
+        if rt is None:
+            return 404, {"status": "ERROR",
+                         "message": f"no app '{name}' deployed"}
+        flow = getattr(rt, "flow", None)
+        payload = {"status": "OK"}
+        payload.update(flow.stats_report() if flow is not None
+                       else {"enabled": False, "streams": {}})
+        adaptive = {}
+        for bridge in getattr(rt, "device_bridges", []):
+            ctrl = getattr(bridge.runtime, "batch_controller", None)
+            if ctrl is not None:
+                adaptive[bridge.query_name] = ctrl.report()
+        if adaptive:
+            payload["adaptive"] = adaptive
+        return 200, payload
+
+    def recover(self, name: str, body: str = "") -> tuple[int, dict]:
+        """Restore the latest (or a named) persisted revision and replay the
+        WAL suffix — the crash-recovery entry point for deployed apps."""
+        rt = self.runtimes.get(name)
+        if rt is None:
+            return 404, {"status": "ERROR",
+                         "message": f"no app '{name}' deployed"}
+        revision = None
+        if body.strip():
+            try:
+                revision = json.loads(body).get("revision")
+            except (ValueError, AttributeError):
+                return 400, {"status": "ERROR",
+                             "message": "body must be JSON like "
+                                        '{"revision": "..."} or empty'}
+        try:
+            from .flow.recovery import recover as _recover
+            report = _recover(rt, revision)
+        except Exception as e:
+            return 400, {"status": "ERROR", "message": str(e)}
+        return 200, {"status": "OK", **report}
 
     # -- lifecycle -------------------------------------------------------------
     @property

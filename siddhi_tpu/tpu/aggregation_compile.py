@@ -22,6 +22,8 @@ Aggregator coverage: sum / count / avg / min / max / stdDev (mergeable
 partials). distinctCount and set-valued aggregators are not losslessly
 mergeable from device lanes and raise ``DeviceCompileError`` → the host
 interpreter keeps them (same fallback contract as ``@device`` queries).
+Integer-typed sum/avg lanes accumulate in int64 (exact, matching the host's
+int64 sums); float lanes and stdDev moments accumulate in f64.
 
 Null policy: device columns encode None as 0 (``BatchSchema.encode_value``),
 so device-side aggregation treats missing numerics as 0 whereas the host
@@ -141,12 +143,12 @@ class CompiledAggregation:
             for fn in filter_fns:
                 mask = jnp.logical_and(mask, fn(cols))
 
-            # composite run key: group columns mixed into one int64 (used
-            # only for SORTING; exact values are gathered at run leaders)
-            key_mix = jnp.zeros((B,), jnp.int64)
-            for name, _t in group_cols:
-                key_mix = key_mix * jnp.int64(0x100000001B3) \
-                    ^ cols[name].astype(jnp.int64)
+            # group-by sort keys: the raw per-column values. No hashed mix —
+            # an int64 FNV-style mix of 2+ columns (one of which may be a raw
+            # LONG) can collide across distinct key tuples and silently merge
+            # two groups into one run; sorting on the columns themselves and
+            # comparing them directly at run boundaries cannot
+            gkeys = [cols[name].astype(jnp.int64) for name, _t in group_cols]
 
             agg_vals = []
             for s in specs:
@@ -154,6 +156,12 @@ class CompiledAggregation:
                     agg_vals.append(None)
                 elif s["kind"] == "count":
                     agg_vals.append(jnp.ones((B,), jnp.float64))
+                elif s["kind"] in ("sum", "avg") and \
+                        s["arg_t"] in (DataType.INT, DataType.LONG):
+                    # integer lanes accumulate exactly in int64 (mirrors
+                    # query_compile's _IACC split) — f64 partials diverge
+                    # from the host's int64-exact sums past 2^53
+                    agg_vals.append(s["fn"](cols).astype(jnp.int64))
                 else:
                     agg_vals.append(s["fn"](cols).astype(jnp.float64))
             proj_vals = {s["name"]: s["fn"](cols)
@@ -162,12 +170,14 @@ class CompiledAggregation:
 
             def one_duration(seg):
                 segm = jnp.where(mask, seg, _TS_POS)
-                order = jnp.lexsort((key_mix, segm))
+                order = jnp.lexsort((*gkeys, segm))
                 sseg = segm[order]
-                skey = key_mix[order]
                 pos = jnp.arange(B)
-                first = (pos == 0) | (sseg != jnp.roll(sseg, 1)) \
-                    | (skey != jnp.roll(skey, 1))
+                # run boundary: bucket OR any raw group column changes
+                first = (pos == 0) | (sseg != jnp.roll(sseg, 1))
+                for gk in gkeys:
+                    sg = gk[order]
+                    first = first | (sg != jnp.roll(sg, 1))
                 rid = jnp.cumsum(first) - 1
                 accepted = sseg < _TS_POS
                 n_runs = jnp.sum((first & accepted).astype(jnp.int32))
@@ -190,7 +200,8 @@ class CompiledAggregation:
                     if s["kind"] == "value":
                         out[f"last_{nm}"] = proj_vals[nm][order][last_c]
                         continue
-                    av = jnp.where(mask, agg_vals[i], 0.0)[order]
+                    av = jnp.where(mask, agg_vals[i],
+                                   jnp.zeros((), agg_vals[i].dtype))[order]
                     if s["kind"] in ("sum", "avg", "count", "stdDev"):
                         out[f"sum_{nm}"] = jax.ops.segment_sum(
                             av, rid, num_segments=B)
@@ -268,7 +279,8 @@ class CompiledAggregation:
                         continue
                     row[nm] = {
                         "n": int(fetched["count"][di][r]),
-                        "sum": float(fetched[f"sum_{nm}"][di][r])
+                        # .item() keeps int64 lanes integral (exact merge)
+                        "sum": fetched[f"sum_{nm}"][di][r].item()
                         if f"sum_{nm}" in fetched else None,
                         "sq": float(fetched[f"sq_{nm}"][di][r])
                         if f"sq_{nm}" in fetched else None,

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.pattern import CompiledPattern, PatternCompiler
+from ..flow.adaptive_batch import AdaptiveFlushMixin
 from ..query_api import (
     Query,
     StateInputStream,
@@ -1780,7 +1781,7 @@ def _decode_scalar(nfa: DeviceNFACompiler, name: str, v, t: DataType):
     return v
 
 
-class DeviceNFARuntime:
+class DeviceNFARuntime(AdaptiveFlushMixin):
     """Micro-batching front end over a compiled NFA."""
 
     def __init__(self, app_or_text, slot_capacity: int = 64,
@@ -1806,8 +1807,7 @@ class DeviceNFARuntime:
 
     def send(self, stream_id: str, row: list, timestamp: int) -> None:
         self.builder.append(stream_id, row, timestamp)
-        if self.builder.full:
-            self.flush()
+        self._maybe_flush()
 
     def process(self, batch: dict) -> list[list]:
         """Device step + decode (async driver's worker entry)."""
@@ -1831,7 +1831,7 @@ class DeviceNFARuntime:
             self.driver.submit(batch)
             return None
         if decode:
-            rows = self.process(batch)
+            rows = self._timed_process(batch)
             self.deliver(rows)
             return rows
         self.state, ys = self.compiler.step(self.state, batch)

@@ -1,0 +1,670 @@
+"""Durable flow-control subsystem tests (``siddhi_tpu/flow``).
+
+Pins the tentpole contracts:
+
+- WAL roundtrip / torn-tail truncation / acked-segment truncation;
+- kill-and-replay exactly-once: a WAL-enabled app abandoned mid-stream
+  (no shutdown — a real crash leaves no hook) and recovered via
+  ``flow.recovery.recover`` emits byte-identical output versus an
+  uninterrupted run, for a filter query AND an 8-state pattern;
+- backpressure overload policies on a stalled consumer: BLOCK never drops,
+  DROP_OLDEST keeps the newest ``capacity`` events, SHED counts what it
+  drops — all observable through the StatisticsManager gauges;
+- seeded crash-recovery fuzz across random query shapes and cut points
+  (``test_snapshot_fuzz.py`` style);
+- adaptive micro-batch controller AIMD behavior and its device wiring.
+"""
+
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core import FileSystemPersistenceStore
+from siddhi_tpu.flow.adaptive_batch import AdaptiveBatchController
+from siddhi_tpu.flow.backpressure import (
+    CreditGate,
+    FlowStats,
+    OverloadPolicy,
+)
+from siddhi_tpu.flow.recovery import recover
+from siddhi_tpu.flow.wal import WriteAheadLog
+
+
+# ---------------------------------------------------------------------------
+# WAL unit level
+# ---------------------------------------------------------------------------
+
+def test_wal_roundtrip(tmp_path):
+    w = WriteAheadLog(str(tmp_path), "app", "S", "sdl")
+    assert w.append([["a", 1.5, 2]], [100]) == 1
+    assert w.append([["b", 2.5, 3], ["c", 0.5, 4]], [200, 201]) == 2
+    w.close()
+
+    w2 = WriteAheadLog(str(tmp_path), "app", "S", "sdl")
+    assert w2.next_seq == 4          # reopen continues the sequence
+    assert list(w2.replay()) == [
+        (1, ["a", 1.5, 2], 100),
+        (2, ["b", 2.5, 3], 200),
+        (3, ["c", 0.5, 4], 201),
+    ]
+    # a record straddling the watermark is trimmed, not skipped or repeated
+    assert list(w2.replay(from_seq=3)) == [(3, ["c", 0.5, 4], 201)]
+    recs = list(w2.replay_records(3))
+    assert len(recs) == 1 and recs[0][2] == 3
+    w2.close()
+
+
+def test_wal_torn_tail(tmp_path):
+    w = WriteAheadLog(str(tmp_path), "app", "S", "l")
+    w.append([[1]], [10])
+    w.append([[2]], [20])
+    path = os.path.join(w.dir, w._segments()[-1])
+    w.close()
+    # crash mid-write: a partial record header+garbage at the tail
+    with open(path, "ab") as f:
+        f.write(b"\x00\x00\x00\xffTORN")
+
+    w2 = WriteAheadLog(str(tmp_path), "app", "S", "l")
+    assert [s for s, _r, _t in w2.replay()] == [1, 2]
+    assert w2.next_seq == 3
+    w2.close()
+
+
+def test_wal_corrupt_crc(tmp_path):
+    w = WriteAheadLog(str(tmp_path), "app", "S", "l")
+    w.append([[1]], [10])
+    w.append([[2]], [20])
+    path = os.path.join(w.dir, w._segments()[-1])
+    w.close()
+    # flip one payload byte of the LAST record: crc mismatch drops it
+    with open(path, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        last = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([last[0] ^ 0xFF]))
+
+    w2 = WriteAheadLog(str(tmp_path), "app", "S", "l")
+    assert [s for s, _r, _t in w2.replay()] == [1]
+    assert w2.next_seq == 2          # the torn record is re-appendable
+    w2.close()
+
+
+def test_wal_rotation_and_truncation(tmp_path):
+    # segment_bytes=1: every append rolls → one single-row record per segment
+    w = WriteAheadLog(str(tmp_path), "app", "S", "l", segment_bytes=1)
+    for i in range(1, 6):
+        w.append([[i]], [i * 10])
+    assert len(w._segments()) == 5
+    # segments 1..3 are fully covered by watermark 3
+    assert w.truncate_through(3) == 3
+    assert [s for s, _r, _t in w.replay()] == [4, 5]
+    # the active segment survives even when fully covered
+    assert w.truncate_through(10) == 1
+    assert len(w._segments()) == 1
+    assert [s for s, _r, _t in w.replay()] == [5]
+    w.close()
+
+
+def test_wal_rejects_object_streams(tmp_path):
+    from siddhi_tpu.flow.wal import stream_wire_types
+    from siddhi_tpu.query_api.definition import DataType, StreamDefinition
+
+    sd = StreamDefinition("S").attribute("o", DataType.OBJECT)
+    with pytest.raises(ValueError):
+        stream_wire_types(sd)
+
+
+# ---------------------------------------------------------------------------
+# kill-and-replay exactly-once (engine level)
+# ---------------------------------------------------------------------------
+
+def _wal_filter_app(wal_dir):
+    return f"""
+@app(name='walFilter')
+@app:wal(dir='{wal_dir}', segment.bytes='256')
+define stream S (sym string, price double, vol long);
+from S[price > 10.0] select sym, price insert into Out;
+"""
+
+
+def _wal_pattern_app(wal_dir, n_states=8):
+    states = " -> ".join(
+        f"e{i}=S[v > e{i - 1}.v]" if i > 1 else "e1=S[v > 90.0]"
+        for i in range(1, n_states + 1))
+    sel = ", ".join(f"e{i}.v as v{i}" for i in range(1, n_states + 1))
+    return f"""
+@app(name='walPattern')
+@app:wal(dir='{wal_dir}')
+define stream S (dev string, v double);
+from every {states} within 4000
+select {sel} insert into Out;
+"""
+
+
+def _start(app_text, persist_dir):
+    m = SiddhiManager()
+    m.set_persistence_store(FileSystemPersistenceStore(str(persist_dir)))
+    rt = m.create_siddhi_app_runtime(app_text)
+    out = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: out.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    return m, rt, out
+
+
+def _kill_replay_roundtrip(tmp_path, app_fn, events, persist_at, kill_at):
+    """Common harness: straight run vs persist→crash→recover→resume run.
+    Returns (straight_output, stitched_output)."""
+    wal_a, wal_b = tmp_path / "wal_a", tmp_path / "wal_b"
+    persist_dir = tmp_path / "persist"
+
+    m, rt, straight = _start(app_fn(wal_a), tmp_path / "persist_a")
+    ih = rt.input_handler("S")
+    for row, ts in events:
+        ih.send(list(row), timestamp=ts)
+    rt.shutdown()
+    m.shutdown()
+
+    app = app_fn(wal_b)
+    m1, rt1, out1 = _start(app, persist_dir)
+    ih1 = rt1.input_handler("S")
+    for row, ts in events[:persist_at]:
+        ih1.send(list(row), timestamp=ts)
+    rt1.persist()
+    n_at_persist = len(out1)
+    for row, ts in events[persist_at:kill_at]:
+        ih1.send(list(row), timestamp=ts)
+    # crash: the runtime is abandoned — no shutdown, no flush hook
+
+    m2, rt2, out2 = _start(app, persist_dir)
+    report = recover(rt2)
+    assert report["replayed"]["S"] == kill_at - persist_at
+    assert report["watermarks"]["S"] == kill_at
+    ih2 = rt2.input_handler("S")
+    for row, ts in events[kill_at:]:
+        ih2.send(list(row), timestamp=ts)
+    rt2.shutdown()
+    m2.shutdown()
+    return straight, out1[:n_at_persist] + out2
+
+
+def test_kill_replay_filter_exactly_once(tmp_path):
+    events = [(["A", float(i), i], 1000 + i * 10) for i in range(40)]
+    straight, stitched = _kill_replay_roundtrip(
+        tmp_path, _wal_filter_app, events, persist_at=15, kill_at=25)
+    assert len(straight) == 29       # prices 11..39 pass the filter
+    assert stitched == straight      # no lost, no duplicated events
+
+
+def test_kill_replay_pattern_exactly_once(tmp_path):
+    # noisy stream with embedded 8-rise ramps above the 90.0 seed threshold
+    rng = random.Random(7)
+    events = []
+    ts = 1000
+    for k in range(120):
+        if k % 15 < 8:
+            v = 91.0 + (k % 15) + rng.random()      # rising ramp segment
+        else:
+            v = rng.uniform(0.0, 85.0)              # noise below the seed
+        events.append((["d1", v], ts))
+        ts += rng.randrange(5, 40)
+    straight, stitched = _kill_replay_roundtrip(
+        tmp_path, _wal_pattern_app, events, persist_at=40, kill_at=70)
+    assert len(straight) >= 3        # the workload actually matches
+    assert stitched == straight
+
+
+def test_kill_replay_without_checkpoint(tmp_path):
+    """Crash before the first persist(): the whole WAL replays from seq 1
+    against the app's initial state."""
+    wal_dir = tmp_path / "wal"
+    persist_dir = tmp_path / "persist"
+    events = [(["A", float(i), i], 1000 + i) for i in range(20)]
+
+    m1, rt1, out1 = _start(_wal_filter_app(wal_dir), persist_dir)
+    ih1 = rt1.input_handler("S")
+    for row, ts in events[:12]:
+        ih1.send(list(row), timestamp=ts)
+    # crash without ever persisting
+
+    m2, rt2, out2 = _start(_wal_filter_app(wal_dir), persist_dir)
+    report = recover(rt2)
+    assert report["revision"] is None
+    assert report["replayed"]["S"] == 12
+    ih2 = rt2.input_handler("S")
+    for row, ts in events[12:]:
+        ih2.send(list(row), timestamp=ts)
+    assert out2 == out1 + [("A", float(i)) for i in range(12, 20) if i > 10]
+    rt2.shutdown()
+    m2.shutdown()
+
+
+def test_wal_truncates_after_persist(tmp_path):
+    """persist() acks the checkpointed prefix: covered WAL segments drop."""
+    wal_dir = tmp_path / "wal"
+    m, rt, _out = _start(_wal_filter_app(wal_dir), tmp_path / "persist")
+    ih = rt.input_handler("S")
+    for i in range(50):              # 256-byte segments → several rotations
+        ih.send(["A", float(i), i], timestamp=1000 + i)
+    wal = rt.flow.streams["S"].wal
+    segs_before = len(wal._segments())
+    assert segs_before > 1
+    rt.persist()
+    assert len(wal._segments()) < segs_before
+    # everything the checkpoint covers is gone; the tail is still replayable
+    assert rt.flow.streams["S"].seq_applied == 50
+    rt.shutdown()
+    m.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# backpressure policies (stalled consumer)
+# ---------------------------------------------------------------------------
+
+def _bp_app(policy, capacity=4):
+    return f"""
+@app(name='bpApp')
+@app:backpressure(capacity='{capacity}', policy='{policy}')
+@async(buffer.size='1024', workers='1', batch.size.max='1')
+define stream S (v long);
+from S select v insert into Out;
+"""
+
+
+class _StalledConsumer:
+    """Blocks the async worker inside the first delivery until released."""
+
+    def __init__(self):
+        self.delivered = []
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def __call__(self, evs):
+        self.entered.set()
+        self.release.wait(timeout=20)
+        self.delivered.extend(e.data[0] for e in evs)
+
+    def drain(self, n, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while len(self.delivered) < n and time.monotonic() < deadline:
+            time.sleep(0.01)
+        return self.delivered
+
+
+def _bp_setup(policy, capacity=4):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(_bp_app(policy, capacity))
+    consumer = _StalledConsumer()
+    rt.add_callback("Out", StreamCallback(consumer))
+    rt.start()
+    ih = rt.input_handler("S")
+    ih.send([0])                     # worker pops it and blocks in-callback
+    assert consumer.entered.wait(timeout=10)
+    return m, rt, ih, consumer
+
+
+def test_backpressure_shed(tmp_path):
+    m, rt, ih, consumer = _bp_setup("shed", capacity=4)
+    stats = rt.flow.streams["S"].stats
+    for i in range(1, 20):
+        ih.send([i])
+    # the stalled in-flight event 0 still holds a credit (credits free only
+    # when delivery COMPLETES), so 3 more queue and the remaining 16 shed
+    assert stats.shed == 16
+    gauges = rt.ctx.statistics_manager.gauges
+    assert gauges["flow.S.shed_count"].value == 16
+    assert gauges["flow.S.queue_depth"].value == 4
+    assert gauges["flow.S.credits"].value == 0
+    assert rt.ctx.statistics_manager.report()["gauges"][
+        "flow.S.shed_count"] == 16
+    consumer.release.set()
+    assert consumer.drain(4) == [0, 1, 2, 3]
+    rt.shutdown()
+    m.shutdown()
+
+
+def test_backpressure_drop_oldest(tmp_path):
+    m, rt, ih, consumer = _bp_setup("drop_oldest", capacity=4)
+    stats = rt.flow.streams["S"].stats
+    for i in range(1, 20):
+        ih.send([i])
+    # the stalled in-flight event 0 pins one credit, so the queue keeps the
+    # NEWEST capacity-1 events; everything older was evicted to make room
+    assert stats.dropped_oldest == 16
+    assert stats.shed == 0
+    consumer.release.set()
+    assert consumer.drain(4) == [0, 17, 18, 19]
+    assert rt.ctx.statistics_manager.gauges[
+        "flow.S.dropped_oldest"].value == 16
+    rt.shutdown()
+    m.shutdown()
+
+
+def test_backpressure_block_never_drops(tmp_path):
+    m, rt, ih, consumer = _bp_setup("block", capacity=4)
+    stats = rt.flow.streams["S"].stats
+
+    def produce():
+        for i in range(1, 10):
+            ih.send([i])
+
+    producer = threading.Thread(target=produce, daemon=True)
+    producer.start()
+    time.sleep(0.3)
+    assert producer.is_alive()       # gated: waiting for credits
+    assert stats.shed == 0 and stats.dropped_oldest == 0
+    consumer.release.set()
+    producer.join(timeout=10)
+    assert not producer.is_alive()
+    # lossless and in order
+    assert consumer.drain(10) == list(range(10))
+    assert stats.shed == 0 and stats.dropped_oldest == 0
+    assert stats.blocked_ns > 0
+    rt.shutdown()
+    m.shutdown()
+
+
+def test_credit_gate_block_timeout_forces():
+    depth = {"v": 10}
+    gate = CreditGate(4, OverloadPolicy.BLOCK, depth_fn=lambda: depth["v"],
+                      max_wait_s=0.05)
+    assert gate.admit(1) is True     # BLOCK never drops: forced in
+    assert gate.stats.forced == 1
+    assert gate.credits == 0
+
+
+def test_credit_gate_block_never_waits_under_engine_lock():
+    """An in-engine producer (root_lock held) must force in immediately —
+    waiting would deadlock the drain path that needs the same lock."""
+    gate = CreditGate(4, OverloadPolicy.BLOCK, depth_fn=lambda: 10,
+                      lock_owned_fn=lambda: True)
+    t0 = time.monotonic()
+    assert gate.admit(1) is True
+    assert time.monotonic() - t0 < 0.5
+    assert gate.stats.forced == 1
+
+
+def test_backpressure_counts_chunk_events(tmp_path):
+    """Credits are counted in EVENTS: a chunked send of k events consumes k
+    credits, not one (queue items may be whole chunks)."""
+    from siddhi_tpu.core.event import Event
+
+    m, rt, ih, consumer = _bp_setup("shed", capacity=4)
+    stats = rt.flow.streams["S"].stats
+    # in-flight event 0 holds 1 credit; the 3-event chunk takes the other 3
+    ih.send([Event(0, [1]), Event(0, [2]), Event(0, [3])])  # one chunk item
+    gauges = rt.ctx.statistics_manager.gauges
+    assert gauges["flow.S.queue_depth"].value == 4
+    assert gauges["flow.S.credits"].value == 0
+    ih.send([4])                     # over capacity: shed
+    assert stats.shed == 1
+    consumer.release.set()
+    assert consumer.drain(4) == [0, 1, 2, 3]
+    rt.shutdown()
+    m.shutdown()
+
+
+def test_wal_concurrent_producers(tmp_path):
+    """Sequence order equals delivery order under concurrent producers: the
+    quiesced watermark is contiguous (no logged-but-skipped seq on replay)."""
+    m, rt, _out = _start(_wal_filter_app(tmp_path / "wal"),
+                         tmp_path / "persist")
+    ih = rt.input_handler("S")
+
+    def produce(base):
+        for i in range(100):
+            ih.send(["A", float(base + i), i])
+
+    threads = [threading.Thread(target=produce, args=(t * 1000,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    sf = rt.flow.streams["S"]
+    assert sf.wal.next_seq == 401
+    assert sf.seq_applied == 400     # every assigned seq was delivered
+    rt.shutdown()
+    m.shutdown()
+
+
+def test_credit_gate_reservation():
+    """admit() holds a credit reservation until release(): two producers
+    racing through the admit→enqueue window cannot over-admit past capacity
+    even while the queue itself still reads empty."""
+    gate = CreditGate(4, OverloadPolicy.SHED, depth_fn=lambda: 0)
+    assert gate.admit(3) is True     # reserved, nothing queued yet
+    assert gate.credits == 1
+    assert gate.admit(2) is False    # 3 reserved + 2 > 4 even at depth 0
+    assert gate.stats.shed == 2
+    gate.release(3)
+    assert gate.credits == 4
+    assert gate.admit(2) is True
+    gate.release(2)
+
+
+def test_wal_reseq_after_restore_with_fresh_wal_dir(tmp_path):
+    """A checkpoint restored against a fresh/relocated WAL dir must renumber
+    above the restored watermark — otherwise post-restore events get seqs the
+    watermark already covers and a later recovery silently skips them."""
+    import shutil
+
+    wal_dir, persist_dir = tmp_path / "wal", tmp_path / "persist"
+    m, rt, out = _start(_wal_filter_app(wal_dir), persist_dir)
+    ih = rt.input_handler("S")
+    for i in range(10):
+        ih.send(["A", 20.0 + i, i], timestamp=1000 + i)
+    rt.persist()
+    rt.shutdown()
+    m.shutdown()
+    shutil.rmtree(wal_dir)           # WAL relocated/cleaned; checkpoint kept
+
+    m2, rt2, out2 = _start(_wal_filter_app(wal_dir), persist_dir)
+    report = recover(rt2)
+    assert report["replayed"]["S"] == 0
+    sf = rt2.flow.streams["S"]
+    assert sf.wal.next_seq == 11     # renumbered past the restored watermark
+    ih2 = rt2.input_handler("S")
+    for i in range(5):
+        ih2.send(["B", 30.0 + i, i], timestamp=2000 + i)
+    assert sf.seq_applied == 15      # the new events advance the watermark
+    # crash + recover again: nothing above the watermark is lost
+    m3, rt3, out3 = _start(_wal_filter_app(wal_dir), persist_dir)
+    report3 = recover(rt3)
+    assert report3["replayed"]["S"] == 5
+    # only the WAL suffix re-emits; the first 10 live inside the checkpoint
+    assert [r[0] for r in out3] == ["B"] * 5
+    rt3.shutdown()
+    m3.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# seeded crash-recovery fuzz (test_snapshot_fuzz.py style)
+# ---------------------------------------------------------------------------
+
+_FUZZ_BODIES = [
+    "from S[v > 50.0] select v insert into Out;",
+    "from S#window.length(4) select v insert into Out;",
+    "from S#window.lengthBatch(5) select sum(v) as s insert into Out;",
+    "from every e1=S[v > 80.0] -> e2=S[v > e1.v] -> e3=S[v > e2.v] "
+    "within 1000 select e1.v as a, e3.v as c insert into Out;",
+]
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_crash_recovery_fuzz(tmp_path, seed):
+    rng = random.Random(9000 + seed)
+    body = rng.choice(_FUZZ_BODIES)
+
+    def app(wal_dir):
+        return (f"@app(name='fuzzApp')\n@app:wal(dir='{wal_dir}')\n"
+                f"define stream S (v double);\n{body}\n")
+
+    events, ts = [], 1000
+    for _ in range(60):
+        events.append(([rng.uniform(0.0, 100.0)], ts))
+        ts += rng.randrange(1, 30)
+    persist_at = rng.randrange(5, 40)
+    kill_at = rng.randrange(persist_at, 55)
+    straight, stitched = _kill_replay_roundtrip(
+        tmp_path, app, events, persist_at, kill_at)
+    assert stitched == straight, (body, persist_at, kill_at)
+
+
+# ---------------------------------------------------------------------------
+# adaptive micro-batching
+# ---------------------------------------------------------------------------
+
+def test_adaptive_controller_aimd():
+    c = AdaptiveBatchController(min_batch=64, max_batch=1024, target_ms=10.0,
+                                initial=512, cooldown=1)
+    # sustained over-target latency: multiplicative decrease to the floor
+    for _ in range(8):
+        c.observe(c.current, 0.050)
+    assert c.current == 64
+    # latency recovers well under target AND batches fill: additive growth
+    c._lat_ms.clear()
+    before = c.current
+    for _ in range(8):
+        c.observe(c.current, 0.001)
+    assert c.current > before
+    assert c.current <= 1024
+    rep = c.report()
+    assert rep["batch_size"] == c.current
+    assert rep["adjustments"] > 0
+    assert rep["flush_deadline_ms"] >= 1.0
+
+
+def test_adaptive_controller_no_growth_on_trickle():
+    c = AdaptiveBatchController(min_batch=64, max_batch=1024, target_ms=10.0,
+                                initial=128, cooldown=1)
+    for _ in range(8):
+        c.observe(3, 0.001)          # fast but nearly-empty batches
+    assert c.current == 128          # growing would only add queueing delay
+
+
+def test_adaptive_device_query(tmp_path):
+    """@app:adaptive attaches a controller to @device query bridges; the
+    chosen batch size is a StatisticsManager gauge and query results are
+    unchanged."""
+    app = """
+@app(name='adaptiveApp')
+@app:adaptive(target.ms='50', min='2')
+define stream S (sym string, price double, vol long);
+@device(batch='8')
+from S[price > 0.0] select sym, price insert into Out;
+"""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: got.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    assert rt.ctx.adaptive_cfg == {"target_ms": 50.0, "min_batch": 2}
+    assert rt.device_bridges, "query did not take the device path"
+    ctrl = rt.device_bridges[0].runtime.batch_controller
+    assert ctrl is not None
+    assert ctrl.max_batch <= 8       # capped by the query's own capacity
+    ih = rt.input_handler("S")
+    for i in range(32):
+        ih.send(["A", float(i + 1), i], timestamp=1000 + i)
+    rt.flush_device()
+    assert len(got) == 32
+    assert ctrl.observations > 0
+    gauges = rt.ctx.statistics_manager.gauges
+    key = [k for k in gauges if k.endswith(".batch_size")]
+    assert key and gauges[key[0]].value == ctrl.current
+    rt.shutdown()
+    m.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# service surface + satellite regression
+# ---------------------------------------------------------------------------
+
+def test_flow_stats_report(tmp_path):
+    m, rt, _out = _start(_wal_filter_app(tmp_path / "wal"),
+                         tmp_path / "persist")
+    ih = rt.input_handler("S")
+    for i in range(5):
+        ih.send(["A", float(i + 20), i])
+    report = rt.flow.stats_report()
+    assert report["enabled"] is True
+    s = report["streams"]["S"]
+    assert s["watermark"] == 5 and s["accepted"] == 5
+    assert s["wal_bytes"] > 0 and s["next_seq"] == 6
+    rt.shutdown()
+    m.shutdown()
+
+
+def test_service_flow_endpoints(tmp_path):
+    """GET /siddhi-apps/{name}/flow and POST .../recover on a deployed app."""
+    import http.client
+    import json as _json
+
+    from siddhi_tpu.service import SiddhiService
+
+    svc = SiddhiService()
+    svc.start()
+    try:
+        def req(method, path, body=None):
+            conn = http.client.HTTPConnection("127.0.0.1", svc.port,
+                                              timeout=10)
+            conn.request(method, path, body=body)
+            resp = conn.getresponse()
+            data = _json.loads(resp.read().decode())
+            conn.close()
+            return resp.status, data
+
+        code, data = req("POST", "/siddhi-apps",
+                         _wal_filter_app(tmp_path / "wal"))
+        assert code == 200, data
+        name = data["name"]
+        for i in range(5):
+            code, _d = req("POST", f"/siddhi-apps/{name}/streams/S",
+                           _json.dumps({"data": ["A", float(i + 20), i]}))
+            assert code == 200
+
+        code, data = req("GET", f"/siddhi-apps/{name}/flow")
+        assert code == 200 and data["enabled"] is True
+        assert data["streams"]["S"]["watermark"] == 5
+        assert data["streams"]["S"]["wal_bytes"] > 0
+
+        # everything already applied: recovery replays nothing, reports state
+        code, data = req("POST", f"/siddhi-apps/{name}/recover")
+        assert code == 200, data
+        assert data["replayed"] == {"S": 0}
+        assert data["watermarks"] == {"S": 5}
+    finally:
+        svc.stop()
+
+
+def test_table_input_handler_accepts_tuples():
+    """Satellite regression: a bare TUPLE row must behave like a bare list
+    row (one row, not a row-per-element explosion)."""
+    app = """
+define stream Q (sym string);
+define table T (sym string, price double);
+from Q join T on Q.sym == T.sym
+select T.sym as sym, T.price as price insert into Out;
+"""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: got.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    tih = rt.table_input_handler("T")
+    tih.send(("IBM", 75.0))                 # bare tuple row
+    tih.send(["WSO2", 55.0])                # bare list row
+    tih.send([("ORCL", 30.0), ["MSFT", 40.0]])   # mixed batch
+    ih = rt.input_handler("Q")
+    for sym in ("IBM", "WSO2", "ORCL", "MSFT"):
+        ih.send([sym])
+    assert got == [("IBM", 75.0), ("WSO2", 55.0),
+                   ("ORCL", 30.0), ("MSFT", 40.0)]
+    m.shutdown()
