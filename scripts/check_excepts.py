@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Repo lint: no bare ``except:`` and no silently-swallowed exceptions.
+
+The resilience layer depends on failures being either HANDLED (routed to a
+policy, counted, logged) or PROPAGATED — a swallowed exception is an event
+silently lost. This script fails on:
+
+- ``except:`` (bare) — always, they catch ``SystemExit``/``KeyboardInterrupt``;
+- broad handlers (``except Exception`` / ``except BaseException``) whose body
+  neither raises, nor calls anything (no logging, no cleanup, no policy
+  dispatch), nor returns/assigns a value — i.e. ``pass``/``continue``/bare
+  ``return`` bodies that drop the error on the floor.
+
+Annotated isolation points are exempt: a handler whose ``except`` line (or
+the line above it) carries ``# noqa: BLE001`` documents WHY the broad catch
+is deliberate (per-receiver isolation, dead-gauge reads, worker keep-alive).
+
+Usage: ``python scripts/check_excepts.py [paths...]`` (default:
+``siddhi_tpu/`` + ``scripts/``). Exit code 1 on findings. Run by
+``tests/test_resilience.py`` so it gates CI.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+DEFAULT_PATHS = ["siddhi_tpu", "scripts"]
+BROAD = {"Exception", "BaseException"}
+
+
+def _is_noqa(lines: list[str], lineno: int) -> bool:
+    """noqa on the except line itself or carried on the line above/below
+    (the codebase wraps the comment when the line runs long)."""
+    for ln in (lineno - 1, lineno - 2, lineno):
+        if 0 <= ln < len(lines) and "noqa" in lines[ln]:
+            return True
+    return False
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body cannot possibly surface the error: no
+    raise, no call (logging/cleanup/dispatch), no value returned or bound."""
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Raise, ast.Call)):
+                return False
+            if isinstance(node, ast.Return) and node.value is not None:
+                return False
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                return False
+            if isinstance(node, ast.Yield):
+                return False
+    return True
+
+
+def _broad_names(type_node) -> bool:
+    """Does the except clause name Exception/BaseException (incl. tuples)?"""
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Name):
+        return type_node.id in BROAD
+    if isinstance(type_node, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in BROAD
+                   for e in type_node.elts)
+    return False
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+    lines = src.splitlines()
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            if not _is_noqa(lines, node.lineno):
+                problems.append(
+                    f"{path}:{node.lineno}: bare 'except:' "
+                    f"(catches SystemExit/KeyboardInterrupt)")
+            continue
+        if _broad_names(node.type) and _swallows(node) \
+                and not _is_noqa(lines, node.lineno):
+            problems.append(
+                f"{path}:{node.lineno}: broad except swallows the error "
+                f"(no raise/call/return-value) — handle it or annotate the "
+                f"isolation point with '# noqa: BLE001'")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    paths = argv[1:] or DEFAULT_PATHS
+    files = []
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(p)
+        else:
+            for root, _dirs, names in os.walk(p):
+                files.extend(os.path.join(root, n) for n in names
+                             if n.endswith(".py"))
+    problems = []
+    for f in sorted(files):
+        problems.extend(check_file(f))
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"\n{len(problems)} problem(s) found.")
+        return 1
+    print(f"OK: {len(files)} file(s) clean.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -12,7 +12,10 @@ __version__ = "0.1.0"
 from . import query_api
 from .compiler import SiddhiCompiler, parse, parse_on_demand_query, parse_query
 from .core import (
+    ErrorEntry,
+    ErrorStore,
     Event,
+    FileErrorStore,
     IncrementalFileSystemPersistenceStore,
     IncrementalPersistenceStore,
     InMemoryBroker,

@@ -5,6 +5,7 @@ junctions, processor chains, windows, NFA pattern engine, joins, selectors,
 tables, partitions, triggers, snapshots, sources/sinks.
 """
 
+from .errors import ErrorEntry, ErrorStore, FileErrorStore
 from .event import Event, EventType, StateEvent, StreamEvent
 from .manager import SiddhiManager
 from .app_runtime import SiddhiAppRuntime

@@ -108,6 +108,11 @@ class SiddhiAppRuntime:
             from ..flow.adaptive_batch import parse_adaptive_annotation
             self.ctx.adaptive_cfg = parse_adaptive_annotation(adaptive_ann)
         self.flow = None                # FlowSubsystem when @app:wal/@app:backpressure
+        # fault-handling layer (sink pipelines, device quarantine, @app:chaos)
+        # — built BEFORE _build so sinks wrap and device guards attach as the
+        # IO and query surfaces compile
+        from ..resilience import ResilienceSubsystem
+        self.resilience = ResilienceSubsystem(self)
 
         self._build()
 
@@ -307,6 +312,26 @@ class SiddhiAppRuntime:
             if ctrl is not None:
                 sm.gauge_tracker(f"device.{b.query_name}.batch_size",
                                  lambda c=ctrl: c.current)
+        # resilience gauges: per-receiver fault counts, sink circuits, device
+        # quarantine state (sink_retries / sink_dropped register themselves
+        # as counters at wrap time)
+        for sid, j in self.ctx.stream_junctions.items():
+            sm.gauge_tracker(f"stream.{sid}.receiver_errors",
+                             lambda jj=j: jj.receiver_errors)
+        for rs in self.resilience.sinks:
+            sm.gauge_tracker(
+                f"sink.{rs.stream_id}.{rs.ordinal}.circuit_state",
+                lambda s=rs: s.breaker.state_code)
+        for g in self.resilience.guards:
+            sm.gauge_tracker(f"device.{g.query_name}.circuit_state",
+                             lambda x=g: x.breaker.state_code)
+            sm.gauge_tracker(f"device.{g.query_name}.fallback_events",
+                             lambda x=g: x.fallback_events)
+        if self.resilience.chaos is not None:
+            for key in self.resilience.chaos.counters:
+                sm.gauge_tracker(
+                    f"chaos.{key}",
+                    lambda c=self.resilience.chaos, k=key: c.counters[k])
 
     def _stream_defs(self) -> dict:
         defs = dict(self.app.stream_definitions)
@@ -364,6 +389,16 @@ class SiddhiAppRuntime:
                 src = self._with_config(cls(), "source", s["type"])
                 handler = self._make_source_handler(sd.id, mapper, s["type"])
                 src.init(sd, s["options"], mapper, handler)
+                try:
+                    src.retry_delays()    # malformed retry.delays fails the
+                    # BUILD, not the first connect attempt at start
+                except ValueError as e:
+                    raise SiddhiAppCreationError(
+                        f"source on stream '{sd.id}': bad retry.delays "
+                        f"({e})") from None
+                # connect retries abort promptly once shutdown starts
+                src.shutdown_signal = self.resilience.shutdown_signal
+                self.resilience.wrap_source_connect(src, sd.id)
                 self.sources.append(src)
             for s in sinks:
                 cls = SINKS.get(s["type"]) or \
@@ -391,7 +426,9 @@ class SiddhiAppRuntime:
                         sub = self._with_config(cls(), "sink", s["type"])
                         merged = {**s["options"], **dest_opts}
                         sub.init(sd, merged, mapper)
-                        subs.append(sub)
+                        # per-destination pipeline: one endpoint failing must
+                        # not take down its siblings
+                        subs.append(self.resilience.wrap_sink(sub, sd, merged))
                     n = len(subs)
                     strat_name = (dist["strategy"] or "roundRobin").lower()
                     if strat_name == "partitioned":
@@ -412,6 +449,9 @@ class SiddhiAppRuntime:
                     mapper.init(sd, s["options"])
                     sink = self._with_config(cls(), "sink", s["type"])
                     sink.init(sd, s["options"], mapper)
+                    # the publish pipeline (on.error policy + circuit
+                    # breaker) wraps every wired sink
+                    sink = self.resilience.wrap_sink(sink, sd, s["options"])
                 self.sinks.append(sink)
                 smgr = ctx.siddhi_context.sink_handler_manager
                 if smgr is not None:
@@ -446,7 +486,8 @@ class SiddhiAppRuntime:
                     sh.send_event(row, ih)
                 else:
                     ih.send(row)
-        return handler
+        # @app:chaos source faults reject the payload before ingress
+        return self.resilience.wrap_source_handler(stream_id, handler)
 
     # -------------------------------------------------------------- public API
     def input_handler(self, stream_id: str) -> InputHandler:
@@ -508,6 +549,7 @@ class SiddhiAppRuntime:
         if self._started:
             return
         self._started = True
+        self.resilience.on_start()
         for j in self.ctx.stream_junctions.values():
             if j.dispatcher is not None:
                 j.dispatcher.start()
@@ -529,6 +571,9 @@ class SiddhiAppRuntime:
             self._heartbeat.start()
 
     def shutdown(self) -> None:
+        # signal first: WAIT backoffs and connect retries abort promptly
+        # instead of riding out their delays
+        self.resilience.on_shutdown()
         self.drain_async()           # deliver queued async events
         for b in self.device_bridges:
             b.finalize()             # drain + close open device segments
@@ -640,6 +685,19 @@ class SiddhiAppRuntime:
 
     def clear_all_revisions(self) -> None:
         self.persistence.clear_all_revisions()
+
+    # -- error-store replay ---------------------------------------------------
+    def replay_errors(self, stream_name: Optional[str] = None,
+                      min_id: Optional[int] = None,
+                      max_id: Optional[int] = None) -> dict:
+        """Re-inject this app's stored failed events (occurrence-aware:
+        'before' entries re-enter through the stream's ``InputHandler``,
+        'sink' entries re-publish through the sink pipeline only). Returns
+        ``{"replayed", "failed", "skipped"}``."""
+        store = self.ctx.siddhi_context.error_store
+        if store is None:
+            raise ValueError("no error store configured")
+        return store.replay(self, stream_name, min_id, max_id)
 
     # -- on-demand queries ----------------------------------------------------
     def query(self, text: str) -> list[Event]:

@@ -101,8 +101,10 @@ class AsyncDeviceDriver:
                     observe = getattr(self.rt, "observe_step", None)
                     if observe is not None:
                         observe(batch.get("count", 0), dt)
-                except Exception:   # noqa: BLE001 — keep the worker alive;
-                    # the error surfaces through the exception listener path
+                except Exception:   # noqa: BLE001 — last-resort worker
+                    # isolation; with the resilience layer active the
+                    # DeviceGuard wrapping rt.process has already rerouted
+                    # the batch to the host path before this can trigger
                     log.exception("device step failed")
                     rows = []
                 finally:
@@ -249,6 +251,7 @@ class DeviceQueryBridge:
         self.output_junction = output_junction
         self.query_name = query_name
         self.query_callbacks: list = []
+        self.guard = None                   # DeviceGuard (resilience layer)
         self._on_rows_accepts_ts = True     # deliver() passes the batch ts
         runtime.add_callback(self._on_rows)
         self._out_ts = 0
@@ -495,7 +498,11 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                     row = [None] * len(self.compiled.schema.names)
                     if self._tk_pos is not None:
                         row[self._tk_pos] = sentinel
-                    self.builder.append(row, sentinel)
+                    # a guarded builder excludes the sentinel from its
+                    # host-fallback shadow (it is bookkeeping, not an event)
+                    append = getattr(self.builder, "append_sentinel",
+                                     self.builder.append)
+                    append(row, sentinel)
                     self.flush()
 
                 def process(self, b):
@@ -653,6 +660,14 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
         cfg["max_batch"] = min(cfg.get("max_batch", batch), batch)
         cfg["min_batch"] = min(cfg.get("min_batch", 64), cfg["max_batch"])
         rt.batch_controller = AdaptiveBatchController(**cfg)
+    # device quarantine: a RUNTIME step failure (compile-time failures fell
+    # back above) reroutes the batch through the host interpreter, and
+    # repeated failures circuit-break the device path itself
+    resilience = getattr(app_context.runtime, "resilience", None)
+    if resilience is not None:
+        bridge.guard = resilience.guard_device(
+            rt, query, name, dict(stream_defs), get_junction, bridge.kind)
+        resilience.bind_bridge(bridge.guard, bridge)
     app_context.register_state(f"device-{name}", _BridgeState(bridge))
     return bridge
 

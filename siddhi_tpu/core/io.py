@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import logging
+import random
 import threading
 import time
 from typing import Any, Callable, Optional
@@ -111,9 +112,11 @@ class PassThroughSinkMapper(SinkMapper):
 
 class JsonSinkMapper(SinkMapper):
     def map(self, event: Event) -> Any:
+        # OBJECT attributes (e.g. a fault stream's _error exception) fall
+        # back to repr — a mapper must not fail on a representable event
         return json.dumps({
             "event": {a.name: v for a, v in zip(self.definition.attributes, event.data)}
-        })
+        }, default=repr)
 
 
 class TextSinkMapper(SinkMapper):
@@ -232,12 +235,15 @@ class Source:
     """Transport-agnostic ingress (reference ``Source.java:50``).
 
     Subclasses implement connect/disconnect and call ``self.handler(payload)``.
-    ``connect_with_retry`` applies exponential backoff like the reference
-    (``connectWithRetry:155``).
-    """
+    ``connect_with_retry`` applies capped backoff with decorrelating jitter
+    like the reference (``connectWithRetry:155``); delays are configurable
+    per source via ``retry.delays='0.1,0.5,1'`` (seconds, csv) and the loop
+    aborts promptly when the app starts shutting down (the runtime hands
+    every wired source its ``shutdown_signal``)."""
 
     extension_kind = "source"
     RETRY_DELAYS = [0.1, 0.5, 1.0, 5.0]
+    shutdown_signal: Optional[threading.Event] = None   # set by the runtime
 
     def init(self, definition: StreamDefinition, options: dict,
              mapper: SourceMapper, handler: Callable[[Any], None]) -> None:
@@ -258,10 +264,33 @@ class Source:
     def resume(self) -> None:
         pass
 
+    def retry_delays(self) -> list[float]:
+        raw = (getattr(self, "options", None) or {}).get("retry.delays")
+        if not raw:
+            return list(self.RETRY_DELAYS)
+        delays = [float(x) for x in str(raw).split(",") if x.strip()]
+        if any(d < 0 for d in delays):
+            raise ValueError(f"retry.delays must be >= 0, got {delays}")
+        return delays
+
+    def _aborting(self) -> bool:
+        sig = self.shutdown_signal
+        return sig is not None and sig.is_set()
+
     def connect_with_retry(self) -> None:
-        for i, delay in enumerate([0.0] + self.RETRY_DELAYS):
+        for i, delay in enumerate([0.0] + self.retry_delays()):
             if delay:
-                time.sleep(delay)
+                # jitter decorrelates a fleet reconnecting after an outage
+                wait = delay * (0.5 + random.random() * 0.5)
+                sig = self.shutdown_signal
+                if sig is not None:
+                    sig.wait(wait)
+                else:
+                    time.sleep(wait)
+            if self._aborting():
+                log.info("source for stream '%s': connect retry aborted "
+                         "(app shutting down)", self.definition.id)
+                return
             try:
                 self.connect()
                 return

@@ -89,8 +89,24 @@ class SiddhiManager:
 
     def set_error_store(self, store) -> None:
         """Reference ``SiddhiManager.setErrorStore`` — replayable store for
-        events that failed with OnErrorAction.STORE."""
+        events that failed with OnErrorAction.STORE or a sink STORE policy.
+        Pass a :class:`~siddhi_tpu.core.errors.FileErrorStore` for entries
+        that survive restarts."""
         self.context.error_store = store
+
+    def replay_errors(self, app_name: str, stream_name: Optional[str] = None,
+                      min_id: Optional[int] = None,
+                      max_id: Optional[int] = None) -> dict:
+        """Re-inject stored failed events for one app (occurrence-aware:
+        stream failures re-enter through the ``InputHandler``, sink failures
+        re-publish through the sink pipeline). Returns the replay report."""
+        rt = self.runtimes.get(app_name)
+        if rt is None:
+            raise KeyError(f"no app '{app_name}' running")
+        store = self.context.error_store
+        if store is None:
+            raise ValueError("no error store configured")
+        return store.replay(rt, stream_name, min_id, max_id)
 
     # -- engine-wide persistence (reference persist()/restoreLastState()) ---
     def persist(self) -> dict:

@@ -129,6 +129,19 @@ class MemoryUsageTracker:
             return 0
 
 
+class CounterTracker:
+    """Monotonic counter (vs the sampled :class:`GaugeTracker`) — the
+    resilience layer's ``sink_retries`` / ``sink_dropped`` / chaos fault
+    counts, incremented at the failure site and reported alongside gauges."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.count += n
+
+
 class GaugeTracker:
     """Generic numeric gauge over a callable — the flow subsystem's
     wal_bytes / queue_depth / credits / shed_count / batch_size readouts
@@ -175,6 +188,7 @@ class StatisticsManager:
         self.buffered: dict[str, BufferedEventsTracker] = {}
         self.memory: dict[str, MemoryUsageTracker] = {}
         self.gauges: dict[str, GaugeTracker] = {}
+        self.counters: dict[str, CounterTracker] = {}
         self.reporter: Optional[Reporter] = None
         self.report_interval_s: float = 60.0
         self._timer: Optional[threading.Timer] = None
@@ -197,6 +211,9 @@ class StatisticsManager:
 
     def gauge_tracker(self, name: str, value_fn) -> GaugeTracker:
         return self.gauges.setdefault(name, GaugeTracker(name, value_fn))
+
+    def counter_tracker(self, name: str) -> CounterTracker:
+        return self.counters.setdefault(name, CounterTracker(name))
 
     def set_level(self, level: Level) -> None:
         self.level = level
@@ -257,6 +274,8 @@ class StatisticsManager:
         }
         if self.gauges:
             data["gauges"] = {k: v.value for k, v in self.gauges.items()}
+        if self.counters:
+            data["counters"] = {k: v.count for k, v in self.counters.items()}
         if self.level == Level.DETAIL:
             data["memory_bytes"] = {k: v.bytes
                                     for k, v in self.memory.items()}

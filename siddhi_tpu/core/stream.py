@@ -41,6 +41,8 @@ class StreamJunction:
         self.on_error_action = on_error_action
         self.fault_junction: Optional["StreamJunction"] = None
         self.throughput = 0
+        self.receiver_errors = 0           # every receiver failure counts —
+        # multi-query fan-out faults must not collapse into one
         self.dispatcher = None             # AsyncDispatcher when @async
         self.flow = None                   # StreamFlow when @app:wal/@app:backpressure
 
@@ -87,6 +89,7 @@ class StreamJunction:
                 r.receive(event)
             except Exception as e:  # noqa: BLE001 — per-receiver isolation:
                 # one faulty query must not starve the other subscribers
+                self._record_receiver_error(r, e)
                 if first_error is None:
                     first_error = e
         if self.flow is not None and event.flow_seq is not None:
@@ -94,39 +97,67 @@ class StreamJunction:
             # snapshot records a cut at a WAL record boundary
             self.flow.on_applied(event.flow_seq)
         if first_error is not None:
+            # every failure was logged/counted above; the event routes to
+            # fault handling ONCE — per-receiver routing would store/emit
+            # the same event twice and duplicate it on replay
             self.handle_error(event, first_error)
 
     def deliver_events(self, events: list[StreamEvent]) -> None:
         self.throughput += len(events)
-        first_error = None
+        failures = {}           # id(event|chunk) -> (target, first exception)
         for r in self.receivers:
-            try:
-                if hasattr(r, "receive_chunk"):
+            if hasattr(r, "receive_chunk"):
+                try:
                     r.receive_chunk(events)
-                else:
-                    for ev in events:
+                except Exception as e:  # noqa: BLE001 — chunk receivers
+                    # process the batch as one unit: the failure is
+                    # attributed to the chunk, not an arbitrary member
+                    self._record_receiver_error(r, e)
+                    failures.setdefault(id(events), (events, e))
+            else:
+                for ev in events:
+                    try:
                         r.receive(ev)
-            except Exception as e:  # noqa: BLE001
-                if first_error is None:
-                    first_error = e
+                    except Exception as e:  # noqa: BLE001 — attribute the
+                        # failure to the event that actually raised
+                        self._record_receiver_error(r, e)
+                        failures.setdefault(id(ev), (ev, e))
         if self.flow is not None:
             seqs = [e.flow_seq for e in events if e.flow_seq is not None]
             if seqs:
                 self.flow.on_applied(max(seqs))
-        if first_error is not None:
-            self.handle_error(events[-1], first_error)
+        # one fault route per failed event (all failures counted above). A
+        # chunk-level failure covers every member, so it supersedes any
+        # per-event failures — routing both would store an event twice and
+        # duplicate it on replay.
+        if id(events) in failures:
+            self.handle_error(events, failures[id(events)][1])
+        else:
+            for target, e in failures.values():
+                self.handle_error(target, e)
 
-    def handle_error(self, event: StreamEvent, e: Exception) -> None:
+    def _record_receiver_error(self, receiver, e: Exception) -> None:
+        self.receiver_errors += 1
+        log.error("receiver %s failed on stream '%s': %s",
+                  type(receiver).__name__, self.definition.id, e)
+
+    def handle_error(self, event, e: Exception) -> None:
+        """Fault routing for one failed event — or a whole chunk when a
+        chunk-aware receiver failed mid-batch (each member is routed)."""
+        events = event if isinstance(event, list) else [event]
         if self.on_error_action == OnErrorAction.STREAM and self.fault_junction:
-            fault_ev = StreamEvent(
-                event.timestamp, list(event.data) + [str(e)], event.type
-            )
-            self.fault_junction.send_event(fault_ev)
+            for ev in events:
+                # the fault definition declares _error OBJECT: carry the
+                # exception itself (reference fault streams), not str(e)
+                self.fault_junction.send_event(StreamEvent(
+                    ev.timestamp, list(ev.data) + [e], ev.type))
             return
         if self.on_error_action == OnErrorAction.STORE:
             store = getattr(self.app_context.siddhi_context, "error_store", None)
             if store is not None:
-                store.save(self.app_context.name, self.definition.id, event, e)
+                for ev in events:
+                    store.save(self.app_context.name, self.definition.id,
+                               ev, e, occurrence="before")
                 return
         listener = self.app_context.exception_listener
         if listener is not None:

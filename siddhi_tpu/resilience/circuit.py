@@ -1,0 +1,81 @@
+"""Circuit breaker shared by sink egress and the device-path quarantine.
+
+Classic three-state machine (Nygard; the reference engine's ``Sink.java``
+connect/retry loop plays the same role implicitly): CLOSED counts consecutive
+failures; after ``failure_threshold`` the circuit OPENs and every attempt is
+short-circuited for ``cooldown_s``; the first attempt after the cool-down runs
+as a HALF_OPEN probe — success re-closes, failure re-opens and restarts the
+cool-down. State transitions are lock-protected: sink publishes may race the
+device worker and the service thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class CircuitState:
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    # numeric codes for gauges: a time series must not carry strings
+    CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class CircuitBreaker:
+    def __init__(self, failure_threshold: int = 5, cooldown_s: float = 30.0,
+                 clock=time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("circuit failure_threshold must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self.clock = clock
+        self.state = CircuitState.CLOSED
+        self.consecutive_failures = 0
+        self.opened_at: float | None = None
+        self.open_count = 0               # times the circuit tripped
+        self._lock = threading.Lock()
+
+    @property
+    def state_code(self) -> int:
+        return CircuitState.CODES[self.state]
+
+    def allow(self) -> bool:
+        """True when an attempt may proceed. An OPEN circuit past its
+        cool-down flips to HALF_OPEN and admits exactly the probe call."""
+        with self._lock:
+            if self.state == CircuitState.CLOSED:
+                return True
+            if self.state == CircuitState.OPEN:
+                if self.opened_at is not None and \
+                        self.clock() - self.opened_at >= self.cooldown_s:
+                    self.state = CircuitState.HALF_OPEN
+                    return True
+                return False
+            # HALF_OPEN: one probe is already in flight; further attempts
+            # wait for its verdict
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.consecutive_failures = 0
+            self.state = CircuitState.CLOSED
+            self.opened_at = None
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.consecutive_failures += 1
+            if self.state == CircuitState.HALF_OPEN or \
+                    self.consecutive_failures >= self.failure_threshold:
+                if self.state != CircuitState.OPEN:
+                    self.open_count += 1
+                self.state = CircuitState.OPEN
+                self.opened_at = self.clock()
+
+    def remaining_cooldown(self) -> float:
+        with self._lock:
+            if self.state != CircuitState.OPEN or self.opened_at is None:
+                return 0.0
+            return max(0.0, self.cooldown_s - (self.clock() - self.opened_at))

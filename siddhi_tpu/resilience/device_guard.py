@@ -1,0 +1,235 @@
+"""Device-path quarantine: a circuit breaker over the TPU execution path.
+
+A compile-time failure already falls back to the host interpreter
+(``DeviceCompileError`` in ``core/device_bridge.py``); this module covers the
+*runtime* gap: a device step that crashes mid-stream used to log and drop its
+whole micro-batch. The guard wraps every bridge runtime's ``process``:
+
+- each submitted batch carries a host-side **shadow** of its raw rows
+  (``_ShadowBuilder`` wraps the bridge's batch builder);
+- a failing step records a breaker failure and replays the shadow through a
+  lazily-built host interpreter runtime for the same query (the reference's
+  CPU ``QueryRuntime`` role), so no event is lost;
+- after ``device.circuit.threshold`` consecutive failures the device path is
+  **quarantined** — steps short-circuit straight to the host fallback without
+  touching the device — and after ``device.circuit.cooldown.ms`` the next
+  batch runs as a half-open probe that re-promotes the device path on
+  success.
+
+Parity caveat (documented in DISTRIBUTED.md): the host fallback runtime owns
+its own state, so fallback output is exact for stateless queries (filters,
+projections); for windowed/pattern/join queries the fallback preserves the
+events but its state starts from the quarantine point.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+from .chaos import ChaosInjector
+from .circuit import CircuitBreaker
+
+log = logging.getLogger("siddhi_tpu.resilience")
+
+
+class _ShadowBuilder:
+    """Batch-builder proxy retaining the raw rows of the batch being packed,
+    so a failed device step can replay exactly those events on the host.
+
+    Wraps both builder shapes: ``BatchBuilder.append(row, ts)`` (single
+    stream) and ``MergedBatchBuilder.append(stream_id, row, ts)``. The bulk
+    pre-encoded path (``append_many``) has no row-level shadow — batches that
+    used it are marked incomplete and a failed step can only count, not
+    replay, them."""
+
+    def __init__(self, inner, merged: bool):
+        self._inner = inner
+        self._merged = merged
+        self._rows: list = []           # (stream_id | None, row, ts)
+        self._incomplete = False
+
+    def __len__(self):
+        return len(self._inner)
+
+    @property
+    def full(self):
+        return self._inner.full
+
+    def append(self, *args) -> None:
+        self._inner.append(*args)       # may raise OverflowError — first
+        if self._merged:
+            sid, row, ts = args
+        else:
+            (row, ts), sid = args, None
+        self._rows.append((sid, list(row), ts))
+
+    def append_rows(self, rows, ts_list) -> None:
+        if self._merged:
+            # MergedBatchBuilder has no bulk row API; mirroring one here
+            # would desynchronize the shadow
+            raise TypeError("append_rows is single-stream only")
+        for row, ts in zip(rows, ts_list):
+            self.append(row, ts)
+
+    def append_sentinel(self, row, ts) -> None:
+        """Device-only bookkeeping row (e.g. the timeBatch finalize
+        sentinel): packed into the batch but excluded from the host-fallback
+        shadow — it is not an event and must never replay."""
+        self._inner.append(row, ts)
+        self._rows.append(None)
+
+    def append_many(self, *args, **kwargs):
+        self._incomplete = True
+        return self._inner.append_many(*args, **kwargs)
+
+    def emit(self) -> dict:
+        batch = self._inner.emit()
+        batch["_shadow_rows"] = None if self._incomplete else self._rows
+        self._rows = []
+        self._incomplete = False
+        return batch
+
+    def snapshot(self):
+        return self._inner.snapshot()
+
+    def restore(self, snap) -> None:
+        self._inner.restore(snap)
+        # restored staged rows have no shadow — don't mismatch rows to events
+        self._rows = []
+        self._incomplete = len(self._inner) > 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class DeviceGuard:
+    """Wraps one device bridge runtime with failure capture + quarantine."""
+
+    def __init__(self, query, query_name: str, app_context, stream_defs: dict,
+                 get_junction: Callable, kind: str,
+                 failure_threshold: int = 3, cooldown_s: float = 30.0,
+                 chaos: Optional[ChaosInjector] = None):
+        self.query = query
+        self.query_name = query_name
+        self.app_context = app_context
+        self.stream_defs = dict(stream_defs)
+        self.get_junction = get_junction
+        self.kind = kind
+        self.breaker = CircuitBreaker(failure_threshold, cooldown_s)
+        self.chaos = chaos
+        self._site = f"device:{app_context.name}/{query_name}"
+        self.failures = 0
+        self.fallback_events = 0        # events replayed through the host
+        self.lost_events = 0            # shadow-less batches (bulk ingress)
+        self.bridge = None              # set by guard_device for callbacks
+        self._last_step_fell_back = False
+        self._fb_runtime = None
+        self._fb_lock = threading.Lock()
+
+    # -- installation --------------------------------------------------------
+    def install(self, rt) -> None:
+        """Wrap ``rt.process`` and ``rt.builder`` in place. Works for both
+        dispatch paths: the sync ``_timed_process`` and the async driver call
+        ``rt.process(batch)`` — an instance attribute shadows the method."""
+        rt.builder = _ShadowBuilder(rt.builder, merged=self.kind != "stream")
+        inner_process = rt.process
+        rt.process = lambda batch: self.step(inner_process, batch)
+        # failed/quarantined steps time the HOST replay, not the device —
+        # feeding those samples to the adaptive batch controller would tune
+        # it on latencies unrelated to device performance
+        inner_observe = getattr(rt, "observe_step", None)
+        if inner_observe is not None:
+            def observe(n_events, latency_s):
+                if not self._last_step_fell_back:
+                    inner_observe(n_events, latency_s)
+            rt.observe_step = observe
+
+    # -- step ----------------------------------------------------------------
+    def step(self, inner_process, batch: dict) -> list:
+        shadow = batch.pop("_shadow_rows", None)
+        if not self.breaker.allow():
+            self._last_step_fell_back = True
+            self._host_fallback(shadow, batch, quarantined=True)
+            return []
+        try:
+            if self.chaos is not None:
+                self.chaos.on_device(self._site)
+            rows = inner_process(batch)
+        except Exception as e:  # noqa: BLE001 — quarantine boundary: the
+            # failed batch reroutes to the host path, the app keeps running
+            self.failures += 1
+            self.breaker.record_failure()
+            log.warning("%s: device step failed (%d consecutive, circuit %s)"
+                        ": %s", self._site,
+                        self.breaker.consecutive_failures,
+                        self.breaker.state, e, exc_info=True)
+            self._last_step_fell_back = True
+            self._host_fallback(shadow, batch)
+            return []
+        self.breaker.record_success()
+        self._last_step_fell_back = False
+        return rows
+
+    # -- host fallback -------------------------------------------------------
+    def _fallback_runtime(self):
+        # root_lock FIRST (consistent with the sync delivery path, where it
+        # is already held): building registers state holders in
+        # app_context.state_registry, which the snapshot walk iterates under
+        # the same lock — an unlocked build from the async worker would race
+        # it. _fb_lock then serializes the build itself.
+        with self.app_context.root_lock:
+            with self._fb_lock:
+                if self._fb_runtime is None:
+                    from ..core.query_runtime import build_query_runtime
+                    self._fb_runtime = build_query_runtime(
+                        self.query, self.app_context, self.stream_defs,
+                        self.get_junction, f"{self.query_name}__hostfb")
+                    if self.bridge is not None:
+                        # SHARE the bridge's query-callback list: callbacks
+                        # registered on the device query (now or later) see
+                        # fallback outputs too, not just on-device ones
+                        self._fb_runtime.callback_adapter.callbacks = \
+                            self.bridge.query_callbacks
+                    self._fb_runtime.start()
+                return self._fb_runtime
+
+    def _host_fallback(self, shadow, batch: dict,
+                       quarantined: bool = False) -> None:
+        if shadow is None:
+            n = int(batch.get("count", 0))
+            self.lost_events += n
+            log.error("%s: no host shadow for a failed batch of %d events "
+                      "(bulk-ingress batches cannot be replayed)",
+                      self._site, n)
+            return
+        # None markers are append_sentinel() bookkeeping rows, not events
+        shadow = [s for s in shadow if s is not None]
+        if not shadow:
+            return
+        rt = self._fallback_runtime()
+        receivers = rt.subscriptions        # [(stream_id, receiver)]
+        from ..core.event import EventType, StreamEvent
+        delivered = 0
+        with self.app_context.root_lock:
+            for sid, row, ts in shadow:
+                ev = StreamEvent(ts, list(row), EventType.CURRENT)
+                for rsid, receiver in receivers:
+                    if sid is None or rsid == sid:
+                        receiver.receive(ev)
+                delivered += 1
+        self.fallback_events += delivered
+        log.info("%s: %d event(s) rerouted through the host path%s",
+                 self._site, delivered,
+                 " (device quarantined)" if quarantined else "")
+
+    # -- introspection -------------------------------------------------------
+    def report(self) -> dict:
+        return {
+            "query": self.query_name,
+            "circuit": self.breaker.state,
+            "failures": self.failures,
+            "fallback_events": self.fallback_events,
+            "lost_events": self.lost_events,
+        }

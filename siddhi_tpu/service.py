@@ -15,6 +15,14 @@ on request. Endpoints (stdlib http.server, threaded; no framework deps):
     POST   /siddhi-apps/{name}/recover       checkpoint restore + WAL replay
                                              (flow/recovery.py); body may be
                                              JSON {"revision": "..."}
+    GET    /siddhi-apps/{name}/error-store   stored failed events
+                                             (?stream=S filters)
+    POST   /siddhi-apps/{name}/error-store/replay
+                                             re-inject stored entries; body
+                                             may be JSON {"stream": "S",
+                                             "ids": [lo, hi]}
+    GET    /siddhi-apps/{name}/resilience    sink circuit/retry stats, device
+                                             quarantine state, chaos counters
     DELETE /siddhi-apps/{name}               undeploy (shutdown + forget)
     POST   /siddhi-apps/{name}/streams/{sid} body = JSON {"data": [...],
                                              "timestamp": ms?} → send event
@@ -73,13 +81,20 @@ class SiddhiService:
                         and parts[2] == "recover":
                     code, payload = service.recover(
                         parts[1], self._body().decode())
+                elif len(parts) == 4 and parts[0] == "siddhi-apps" \
+                        and parts[2:] == ["error-store", "replay"]:
+                    code, payload = service.replay_errors(
+                        parts[1], self._body().decode())
                 else:
                     code, payload = 404, {"status": "ERROR",
                                           "message": "unknown path"}
                 self._reply(code, payload)
 
             def do_GET(self):
-                parts = [p for p in self.path.split("/") if p]
+                from urllib.parse import parse_qs, urlparse
+                url = urlparse(self.path)
+                query = {k: v[0] for k, v in parse_qs(url.query).items()}
+                parts = [p for p in url.path.split("/") if p]
                 if parts == ["siddhi-apps"]:
                     self._reply(200, {"status": "OK",
                                       "apps": sorted(service.runtimes)})
@@ -90,6 +105,15 @@ class SiddhiService:
                 elif len(parts) == 3 and parts[0] == "siddhi-apps" \
                         and parts[2] == "flow":
                     code, payload = service.flow_stats(parts[1])
+                    self._reply(code, payload)
+                elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                        and parts[2] == "error-store":
+                    code, payload = service.error_store_entries(
+                        parts[1], query.get("stream"))
+                    self._reply(code, payload)
+                elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                        and parts[2] == "resilience":
+                    code, payload = service.resilience_stats(parts[1])
                     self._reply(code, payload)
                 else:
                     self._reply(404, {"status": "ERROR",
@@ -192,6 +216,66 @@ class SiddhiService:
                 adaptive[bridge.query_name] = ctrl.report()
         if adaptive:
             payload["adaptive"] = adaptive
+        return 200, payload
+
+    def error_store_entries(self, name: str,
+                            stream: Optional[str] = None) -> tuple[int, dict]:
+        """Stored failed events awaiting replay (GET .../error-store)."""
+        rt = self.runtimes.get(name)
+        if rt is None:
+            return 404, {"status": "ERROR",
+                         "message": f"no app '{name}' deployed"}
+        store = rt.ctx.siddhi_context.error_store
+        if store is None:
+            return 200, {"status": "OK", "entries": []}
+        from dataclasses import asdict
+        entries = [asdict(e) for e in store.load(name, stream)]
+        # event data may hold non-JSON values (OBJECT attributes) — stringify
+        for e in entries:
+            e["event_data"] = [
+                v if isinstance(v, (str, int, float, bool, type(None)))
+                else repr(v) for v in e["event_data"]]
+        return 200, {"status": "OK", "entries": entries}
+
+    def replay_errors(self, name: str, body: str = "") -> tuple[int, dict]:
+        """Re-inject stored entries (POST .../error-store/replay); body may
+        narrow by {"stream": "...", "ids": [lo, hi]}."""
+        rt = self.runtimes.get(name)
+        if rt is None:
+            return 404, {"status": "ERROR",
+                         "message": f"no app '{name}' deployed"}
+        store = rt.ctx.siddhi_context.error_store
+        if store is None:
+            return 400, {"status": "ERROR",
+                         "message": "no error store configured"}
+        stream = min_id = max_id = None
+        if body.strip():
+            try:
+                payload = json.loads(body)
+                stream = payload.get("stream")
+                ids = payload.get("ids")
+                if ids is not None:
+                    min_id, max_id = int(ids[0]), int(ids[1])
+            except (ValueError, TypeError, IndexError, AttributeError):
+                return 400, {"status": "ERROR",
+                             "message": 'body must be JSON like {"stream": '
+                                        '"S", "ids": [lo, hi]} or empty'}
+        try:
+            report = store.replay(rt, stream, min_id, max_id)
+        except Exception as e:  # noqa: BLE001 — surfaced to the caller
+            return 500, {"status": "ERROR", "message": str(e)}
+        return 200, {"status": "OK", **report}
+
+    def resilience_stats(self, name: str) -> tuple[int, dict]:
+        """Sink circuits/retries, device quarantine, chaos counters."""
+        rt = self.runtimes.get(name)
+        if rt is None:
+            return 404, {"status": "ERROR",
+                         "message": f"no app '{name}' deployed"}
+        resilience = getattr(rt, "resilience", None)
+        payload = {"status": "OK"}
+        payload.update(resilience.report() if resilience is not None
+                       else {"sinks": [], "device": []})
         return 200, payload
 
     def recover(self, name: str, body: str = "") -> tuple[int, dict]:

@@ -30,3 +30,12 @@ try:
     jax.config.update("jax_platforms", "cpu")
 except Exception:
     pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 run")
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection tests (the fast smoke subset is "
+        "unmarked-slow and rides in tier-1; run `-m chaos` for all)")

@@ -1,0 +1,921 @@
+"""Resilience subsystem tests (``siddhi_tpu/resilience``).
+
+Pins the tentpole contracts:
+
+- sink publish pipeline: the ``on.error`` policy matrix (WAIT backoff,
+  bounded RETRY with escalation, STREAM fault routing, STORE + replay, LOG
+  drop) and the per-sink circuit breaker open → half-open → close cycle;
+- error-store replay round-trip, including ``@OnError(action='store')`` →
+  heal → replay → downstream sees the event exactly once, and the
+  file-backed store surviving a restart;
+- device-path quarantine: runtime step failures reroute the batch through
+  the host interpreter (no event lost), repeated failures quarantine the
+  device path, a cool-down probe re-promotes it, output parity vs host;
+- seeded chaos soak: source+sink+device faults, zero accepted-event loss;
+- satellites: fault events carry the exception object, per-receiver failure
+  accounting, source connect retry jitter/abort, the bare-except lint.
+"""
+
+import json
+import http.client
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from siddhi_tpu import (
+    ErrorStore,
+    FileErrorStore,
+    InMemoryBroker,
+    SiddhiManager,
+    StreamCallback,
+)
+from siddhi_tpu.core.extension import ScalarFunctionExtension
+from siddhi_tpu.core.io import ConnectionUnavailableError, Sink, Source
+from siddhi_tpu.query_api.definition import DataType
+from siddhi_tpu.resilience import ChaosInjector, CircuitBreaker
+from siddhi_tpu.resilience.circuit import CircuitState
+from siddhi_tpu.service import SiddhiService
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+    InMemoryBroker.reset()
+
+
+# ---------------------------------------------------------------------------
+# test doubles
+# ---------------------------------------------------------------------------
+
+class FlakySink(Sink):
+    """Fails the first ``fail.n`` publishes with the retryable transport
+    error, then succeeds. Class-level capture of delivered payloads."""
+
+    published: list = []
+    instances: list = []
+
+    def init(self, definition, options, mapper):
+        super().init(definition, options, mapper)
+        self.fail_remaining = int(options.get("fail.n") or 0)
+        self.attempts = 0
+        FlakySink.instances.append(self)
+
+    def publish(self, payload):
+        self.attempts += 1
+        if self.fail_remaining > 0:
+            self.fail_remaining -= 1
+            raise ConnectionUnavailableError("flaky transport down")
+        FlakySink.published.append(payload)
+
+
+class BoomSink(Sink):
+    """Always fails with a NON-transport error (deterministic bug)."""
+
+    def init(self, definition, options, mapper):
+        super().init(definition, options, mapper)
+        self.attempts = 0
+
+    def publish(self, payload):
+        self.attempts += 1
+        raise RuntimeError("mapper bug")
+
+
+class ToggleBoom(ScalarFunctionExtension):
+    return_type = DataType.INT
+    fail = True
+
+    def execute(self, args):
+        if ToggleBoom.fail:
+            raise RuntimeError("boom while processing")
+        return args[0]
+
+
+@pytest.fixture(autouse=True)
+def _reset_doubles():
+    FlakySink.published = []
+    FlakySink.instances = []
+    ToggleBoom.fail = True
+    yield
+
+
+def _sink_app(extra_sink_opts, stream_extra=""):
+    return f"""
+        define stream S (v int);
+        {stream_extra}
+        @sink(type='flaky', topic='x', {extra_sink_opts}
+              @map(type='passThrough'))
+        define stream O (v int);
+        from S select v insert into O;
+    """
+
+
+def _build(manager, app, **kw):
+    manager.set_extension("sink:flaky", FlakySink)
+    manager.set_extension("sink:boomsink", BoomSink)
+    manager.set_extension("t:boom", ToggleBoom)
+    rt = manager.create_siddhi_app_runtime(app, playback=True, **kw)
+    rt.start()
+    return rt
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker unit level
+# ---------------------------------------------------------------------------
+
+def test_circuit_breaker_transitions():
+    now = [0.0]
+    cb = CircuitBreaker(failure_threshold=2, cooldown_s=10.0,
+                        clock=lambda: now[0])
+    assert cb.state == CircuitState.CLOSED and cb.allow()
+    cb.record_failure()
+    assert cb.state == CircuitState.CLOSED and cb.allow()
+    cb.record_failure()                       # threshold hit → OPEN
+    assert cb.state == CircuitState.OPEN
+    assert not cb.allow()
+    now[0] = 5.0
+    assert not cb.allow()                     # still cooling down
+    assert 4.9 < cb.remaining_cooldown() <= 5.0
+    now[0] = 10.0
+    assert cb.allow()                         # half-open probe admitted
+    assert cb.state == CircuitState.HALF_OPEN
+    assert not cb.allow()                     # only ONE probe in flight
+    cb.record_failure()                       # probe failed → re-OPEN
+    assert cb.state == CircuitState.OPEN
+    now[0] = 20.0
+    assert cb.allow()
+    cb.record_success()                       # probe succeeded → CLOSED
+    assert cb.state == CircuitState.CLOSED and cb.allow()
+    assert cb.open_count == 2
+
+
+def test_circuit_success_resets_consecutive_failures():
+    cb = CircuitBreaker(failure_threshold=3, cooldown_s=1.0)
+    for _ in range(2):
+        cb.record_failure()
+    cb.record_success()
+    cb.record_failure()
+    cb.record_failure()
+    assert cb.state == CircuitState.CLOSED    # never 3 consecutive
+
+
+# ---------------------------------------------------------------------------
+# on.error policy matrix
+# ---------------------------------------------------------------------------
+
+def test_wait_policy_retries_until_success(manager):
+    rt = _build(manager, _sink_app(
+        "fail.n='3', on.error='wait', wait.base.ms='1',"))
+    rt.input_handler("S").send([1], timestamp=1)
+    assert len(FlakySink.published) == 1
+    rs = rt.resilience.sinks[0]
+    assert rs.retries == 3 and rs.dropped == 0
+    assert rs.breaker.state == CircuitState.CLOSED
+
+
+def test_wait_policy_does_not_retry_deterministic_bugs(manager):
+    # non-transport errors under WAIT escalate instead of wedging the stream
+    rt = _build(manager, """
+        define stream S (v int);
+        @sink(type='boomsink', on.error='wait', @map(type='passThrough'))
+        define stream O (v int);
+        from S select v insert into O;
+    """)
+    rt.input_handler("S").send([1], timestamp=1)
+    assert rt.sinks[0].inner.attempts == 1           # exactly one attempt
+    entries = manager.context.error_store.load(rt.name, "O")
+    assert len(entries) == 1 and entries[0].occurrence == "sink"
+
+
+def test_retry_policy_bounded_then_escalates_to_store(manager):
+    rt = _build(manager, _sink_app(
+        "fail.n='10', on.error='retry(2)', retry.delay.ms='1',"))
+    rt.input_handler("S").send([7], timestamp=1)
+    sink = FlakySink.instances[0]
+    assert sink.attempts == 2 and not FlakySink.published
+    entries = manager.context.error_store.load(rt.name, "O")
+    assert len(entries) == 1
+    assert entries[0].occurrence == "sink"
+    assert entries[0].event_data == [7]
+    # heal the transport, replay through the SINK only: exactly-once egress
+    sink.fail_remaining = 0
+    report = rt.replay_errors()
+    assert report == {"replayed": 1, "failed": 0, "skipped": 0}
+    assert len(FlakySink.published) == 1
+    assert manager.context.error_store.load(rt.name) == []
+
+
+def test_retry_policy_succeeds_within_bounds(manager):
+    rt = _build(manager, _sink_app(
+        "fail.n='1', on.error='retry(3)', retry.delay.ms='1',"))
+    rt.input_handler("S").send([5], timestamp=1)
+    assert len(FlakySink.published) == 1
+    assert rt.resilience.sinks[0].retries == 1
+    assert manager.context.error_store.load(rt.name) == []
+
+
+def test_stream_policy_routes_to_fault_junction(manager):
+    rt = _build(manager, _sink_app(
+        "fail.n='1', on.error='stream',",
+        stream_extra="@OnError(action='stream')"))
+    # the sink hangs off O; @OnError on O declares its fault stream
+    assert "!O" in rt.ctx.stream_junctions
+    faults = []
+    rt.add_callback("!O", StreamCallback(lambda evs: faults.extend(evs)))
+    rt.input_handler("S").send([3], timestamp=1)
+    assert len(faults) == 1
+    assert faults[0].data[0] == 3
+    assert isinstance(faults[0].data[-1], ConnectionUnavailableError)
+    # next event publishes normally
+    rt.input_handler("S").send([4], timestamp=2)
+    assert len(FlakySink.published) == 1
+
+
+def test_log_policy_drops_and_counts(manager):
+    rt = _build(manager, _sink_app("fail.n='1',"))    # default on.error=log
+    rt.input_handler("S").send([1], timestamp=1)
+    rt.input_handler("S").send([2], timestamp=2)
+    rs = rt.resilience.sinks[0]
+    assert rs.dropped == 1
+    assert [e.data for e in FlakySink.published] == [[2]]
+    sm = rt.ctx.statistics_manager
+    assert sm.counters["sink.O.0.sink_dropped"].count == 1
+    assert sm.gauges["sink.O.0.circuit_state"].value == 0
+
+
+def test_bad_on_error_policy_rejected(manager):
+    from siddhi_tpu.core.errors import SiddhiAppCreationError
+    manager.set_extension("sink:flaky", FlakySink)
+    with pytest.raises(SiddhiAppCreationError):
+        manager.create_siddhi_app_runtime(
+            _sink_app("on.error='explode',"), playback=True)
+
+
+def test_sink_replay_targets_only_the_failed_sink(manager):
+    """Multi-sink fan-out: replaying a stored sink failure must not
+    re-publish through the sibling sinks that already delivered it."""
+    rt = _build(manager, """
+        define stream S (v int);
+        @sink(type='flaky', @map(type='passThrough'))
+        @sink(type='flaky', fail.n='10', on.error='retry(1)',
+              @map(type='passThrough'))
+        define stream O (v int);
+        from S select v insert into O;
+    """)
+    rt.input_handler("S").send([8], timestamp=1)
+    assert len(FlakySink.published) == 1          # healthy sibling delivered
+    entries = manager.context.error_store.load(rt.name, "O")
+    assert len(entries) == 1 and entries[0].sink_ordinal == 1
+    FlakySink.instances[1].fail_remaining = 0     # heal the failed sink
+    assert rt.replay_errors()["replayed"] == 1
+    # exactly one more publish (the healed sink), NOT one per sibling
+    assert len(FlakySink.published) == 2
+
+
+def test_multi_receiver_failure_stores_event_once(manager):
+    """Two failing queries on one event: both failures are counted/logged,
+    but the event routes to the store ONCE (replay must not duplicate it)."""
+    rt = _build(manager, """
+        @OnError(action='store')
+        define stream S (v int);
+        define function boom[python] return int { return data[0] / 0 };
+        from S select boom(v) as a insert into O1;
+        from S select boom(v) as b insert into O2;
+    """)
+    rt.input_handler("S").send([3], timestamp=1)
+    assert rt.ctx.stream_junctions["S"].receiver_errors == 2
+    assert len(manager.context.error_store.load(rt.name, "S")) == 1
+
+
+# ---------------------------------------------------------------------------
+# sink circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_sink_circuit_opens_then_half_open_probe_recovers(manager):
+    rt = _build(manager, _sink_app(
+        "fail.n='1000', circuit.threshold='2', circuit.cooldown.ms='30',"))
+    ih = rt.input_handler("S")
+    for i in range(5):
+        ih.send([i], timestamp=i + 1)
+    sink = FlakySink.instances[0]
+    rs = rt.resilience.sinks[0]
+    # two real attempts tripped the circuit (LOG policy → dropped); the
+    # remaining 3 events fail fast without touching the transport and
+    # escalate to the replayable store instead of being silently lost
+    assert sink.attempts == 2
+    assert rs.breaker.state == CircuitState.OPEN
+    assert rt.ctx.statistics_manager.gauges["sink.O.0.circuit_state"].value == 2
+    assert rs.dropped == 2
+    assert len(manager.context.error_store.load(rt.name, "O")) == 3
+    # heal + cool down → half-open probe closes the circuit
+    sink.fail_remaining = 0
+    time.sleep(0.05)
+    ih.send([99], timestamp=10)
+    assert rs.breaker.state == CircuitState.CLOSED
+    assert [e.data for e in FlakySink.published] == [[99]]
+    # stored failures replay through the healed sink
+    assert rt.replay_errors()["replayed"] == 3
+    assert len(FlakySink.published) == 4
+
+
+def test_wait_policy_waits_out_open_circuit(manager):
+    """WAIT + open circuit: the event sleeps out the cool-down and probes —
+    it is never escalated/dropped without a publish attempt."""
+    rt = _build(manager, """
+        define stream S (v int);
+        @sink(type='flaky', fail.n='2', on.error='wait', wait.base.ms='1',
+              circuit.threshold='2', circuit.cooldown.ms='20',
+              @map(type='passThrough'))
+        define stream O (v int);
+        from S select v insert into O;
+    """)
+    # first event: 2 transport failures trip the breaker mid-loop, then the
+    # loop waits out the cool-down and the half-open probe delivers it
+    rt.input_handler("S").send([1], timestamp=1)
+    rs = rt.resilience.sinks[0]
+    assert [e.data for e in FlakySink.published] == [[1]]
+    assert rs.dropped == 0 and rs.breaker.state == CircuitState.CLOSED
+
+
+def test_stream_policy_without_consumer_escalates_to_drop(manager):
+    """A receiver-less fault junction is not 'routing' — the failure must
+    reach the drop accounting instead of vanishing silently."""
+    rt = _build(manager, _sink_app("fail.n='1', on.error='stream',"))
+    rt.input_handler("S").send([1], timestamp=1)
+    rs = rt.resilience.sinks[0]
+    assert rs.routed_to_fault == 0
+    assert rs.dropped == 1
+
+
+def test_wait_policy_aborts_on_shutdown(manager):
+    rt = _build(manager, _sink_app(
+        "fail.n='1000000', on.error='wait', wait.base.ms='5000',"))
+    done = threading.Event()
+
+    def send():
+        rt.input_handler("S").send([1], timestamp=1)
+        done.set()
+
+    t = threading.Thread(target=send, daemon=True)
+    t.start()
+    time.sleep(0.05)                   # let it enter the backoff sleep
+    assert not done.is_set()
+    t0 = time.monotonic()
+    rt.shutdown()
+    assert done.wait(timeout=2.0), "WAIT did not abort on shutdown"
+    assert time.monotonic() - t0 < 2.0
+
+
+# ---------------------------------------------------------------------------
+# error-store replay round-trip
+# ---------------------------------------------------------------------------
+
+def test_on_error_store_replay_downstream_sees_event_once(manager):
+    rt = _build(manager, """
+        @OnError(action='store')
+        define stream S (v int);
+        from S select t:boom(v) as v insert into O;
+    """)
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+    rt.input_handler("S").send([42], timestamp=1)
+    assert got == []
+    entries = manager.context.error_store.load(rt.name, "S")
+    assert len(entries) == 1 and entries[0].occurrence == "before"
+    assert entries[0].event_data == [42]
+    # heal the query, replay through the InputHandler: downstream sees it ONCE
+    ToggleBoom.fail = False
+    report = rt.replay_errors(stream_name="S")
+    assert report == {"replayed": 1, "failed": 0, "skipped": 0}
+    assert [e.data for e in got] == [[42]]
+    assert manager.context.error_store.load(rt.name) == []
+
+
+def test_replay_id_range(manager):
+    rt = _build(manager, """
+        @OnError(action='store')
+        define stream S (v int);
+        from S select t:boom(v) as v insert into O;
+    """)
+    for i in range(4):
+        rt.input_handler("S").send([i], timestamp=i + 1)
+    ids = [e.id for e in manager.context.error_store.load(rt.name)]
+    assert len(ids) == 4
+    ToggleBoom.fail = False
+    report = rt.replay_errors(min_id=ids[1], max_id=ids[2])
+    assert report["replayed"] == 2
+    remaining = [e.id for e in manager.context.error_store.load(rt.name)]
+    assert remaining == [ids[0], ids[3]]
+
+
+def test_replay_while_still_failing_restores_entry(manager):
+    rt = _build(manager, """
+        @OnError(action='store')
+        define stream S (v int);
+        from S select t:boom(v) as v insert into O;
+    """)
+    rt.input_handler("S").send([1], timestamp=1)
+    assert len(manager.context.error_store.load(rt.name)) == 1
+    # replay with the bug still live: the delivery chain stores it again
+    report = rt.replay_errors()
+    assert report["replayed"] == 1
+    entries = manager.context.error_store.load(rt.name)
+    assert len(entries) == 1                    # re-stored under a new id
+
+
+def test_file_error_store_survives_restart(tmp_path, manager):
+    path = str(tmp_path / "errors.jsonl")
+    manager.set_error_store(FileErrorStore(path))
+    rt = _build(manager, """
+        @OnError(action='store')
+        define stream S (v int);
+        from S select t:boom(v) as v insert into O;
+    """)
+    rt.input_handler("S").send([11], timestamp=1)
+    rt.input_handler("S").send([22], timestamp=2)
+    # "restart": a fresh store instance over the same file
+    store2 = FileErrorStore(path)
+    assert [e.event_data for e in store2.load(rt.name, "S")] == [[11], [22]]
+    store2.discard(store2.entries[0].id)
+    store3 = FileErrorStore(path)
+    assert [e.event_data for e in store3.load(rt.name)] == [[22]]
+    # replay from the reloaded store through the healed app
+    ToggleBoom.fail = False
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+    assert store3.replay(rt)["replayed"] == 1
+    assert [e.data for e in got] == [[22]]
+    assert FileErrorStore(path).entries == []
+
+
+# ---------------------------------------------------------------------------
+# service endpoints
+# ---------------------------------------------------------------------------
+
+def _req(svc, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", svc.port, timeout=10)
+    conn.request(method, path, body=body)
+    resp = conn.getresponse()
+    data = json.loads(resp.read().decode())
+    conn.close()
+    return resp.status, data
+
+
+def test_error_store_service_endpoints():
+    svc = SiddhiService(playback=True)
+    svc.manager.set_extension("t:boom", ToggleBoom)
+    svc.start()
+    try:
+        code, _ = _req(svc, "POST", "/siddhi-apps", """
+            @app:name('ResApp')
+            @OnError(action='store')
+            define stream S (v int);
+            from S select t:boom(v) as v insert into O;
+        """)
+        assert code == 200
+        code, _ = _req(svc, "POST", "/siddhi-apps/ResApp/streams/S",
+                       json.dumps({"data": [5], "timestamp": 1}))
+        assert code == 200
+        code, data = _req(svc, "GET", "/siddhi-apps/ResApp/error-store")
+        assert code == 200 and len(data["entries"]) == 1
+        assert data["entries"][0]["stream_name"] == "S"
+        assert data["entries"][0]["event_data"] == [5]
+        code, data = _req(svc, "GET",
+                          "/siddhi-apps/ResApp/error-store?stream=Other")
+        assert code == 200 and data["entries"] == []
+        # resilience report endpoint
+        code, data = _req(svc, "GET", "/siddhi-apps/ResApp/resilience")
+        assert code == 200 and data["sinks"] == [] and data["device"] == []
+        # heal + replay over REST
+        ToggleBoom.fail = False
+        got = []
+        svc.runtimes["ResApp"].add_callback(
+            "O", StreamCallback(lambda evs: got.extend(evs)))
+        code, data = _req(svc, "POST",
+                          "/siddhi-apps/ResApp/error-store/replay",
+                          json.dumps({"stream": "S"}))
+        assert code == 200 and data["replayed"] == 1
+        assert [e.data for e in got] == [[5]]
+        code, data = _req(svc, "GET", "/siddhi-apps/ResApp/error-store")
+        assert data["entries"] == []
+        # malformed body → 400
+        code, _ = _req(svc, "POST",
+                       "/siddhi-apps/ResApp/error-store/replay", "{bad")
+        assert code == 400
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# junction satellites: fault objects, per-receiver accounting, chunks
+# ---------------------------------------------------------------------------
+
+def test_fault_event_carries_exception_object(manager):
+    rt = _build(manager, """
+        @OnError(action='stream')
+        define stream S (v int);
+        define function boom[python] return int { return data[0] / 0 };
+        from S select boom(v) as d insert into OutStream;
+        from !S select v, _error insert into FaultOut;
+    """)
+    faults = []
+    rt.add_callback("FaultOut", StreamCallback(lambda evs: faults.extend(evs)))
+    rt.input_handler("S").send([1], timestamp=1)
+    assert len(faults) == 1
+    assert faults[0].data[0] == 1
+    assert isinstance(faults[0].data[1], Exception)   # the object, not str
+
+
+def test_every_receiver_failure_is_counted(manager, caplog):
+    rt = _build(manager, """
+        define stream S (v int);
+        define function boom[python] return int { return data[0] / 0 };
+        @info(name='bad1') from S select boom(v) as d insert into O1;
+        @info(name='bad2') from S select boom(v) as d insert into O2;
+        @info(name='good') from S select v insert into O3;
+    """)
+    good = []
+    rt.add_callback("O3", StreamCallback(lambda evs: good.extend(evs)))
+    with caplog.at_level("ERROR", logger="siddhi_tpu.stream"):
+        rt.input_handler("S").send([7], timestamp=1)
+    assert [e.data for e in good] == [[7]]
+    j = rt.ctx.stream_junctions["S"]
+    assert j.receiver_errors == 2                     # both, not first-only
+    assert rt.ctx.statistics_manager.gauges[
+        "stream.S.receiver_errors"].value == 2
+    per_receiver = [r for r in caplog.records
+                    if "receiver" in r.getMessage()]
+    assert len(per_receiver) == 2
+
+
+def test_chunk_failure_attributed_to_failing_event(manager):
+    """Per-event receivers: a mid-chunk failure stores the event that raised,
+    not events[-1]; the survivors still process."""
+    from siddhi_tpu.core.event import Event
+    rt = _build(manager, """
+        @OnError(action='store')
+        define stream S (v int);
+        define function inv[python] return int { return 10 // data[0] };
+        from S select inv(v) as d insert into O;
+    """)
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+    junction = rt.ctx.stream_junctions["S"]
+    from siddhi_tpu.core.event import EventType, StreamEvent
+    events = [StreamEvent(1, [5], EventType.CURRENT),
+              StreamEvent(2, [0], EventType.CURRENT),
+              StreamEvent(3, [2], EventType.CURRENT)]
+    # force the per-event (non-chunk) receiver path deterministically
+    for r in junction.receivers:
+        if hasattr(r, "receive_chunk"):
+            for ev in events:
+                junction.deliver_event(ev)
+            break
+    else:
+        junction.deliver_events(events)
+    entries = manager.context.error_store.load(rt.name, "S")
+    assert len(entries) == 1
+    assert entries[0].event_data == [0]               # the actual offender
+    assert sorted(e.data[0] for e in got) == [2, 5]
+
+
+# ---------------------------------------------------------------------------
+# source connect retry
+# ---------------------------------------------------------------------------
+
+class NeverConnects(Source):
+    def __init__(self):
+        self.attempts = 0
+
+    def connect(self):
+        self.attempts += 1
+        raise ConnectionUnavailableError("endpoint down")
+
+
+def test_connect_with_retry_configurable_delays_and_jitter():
+    src = NeverConnects()
+    from siddhi_tpu.query_api.definition import StreamDefinition
+    sd = StreamDefinition("S").attribute("v", DataType.INT)
+    src.init(sd, {"retry.delays": "0.001,0.002"}, None, lambda p: None)
+    assert src.retry_delays() == [0.001, 0.002]
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionUnavailableError):
+        src.connect_with_retry()
+    assert src.attempts == 3                  # initial + 2 retries
+    assert time.monotonic() - t0 < 1.0        # no fixed 0.1/0.5/1/5 ladder
+
+
+def test_connect_with_retry_aborts_on_shutdown():
+    src = NeverConnects()
+    from siddhi_tpu.query_api.definition import StreamDefinition
+    sd = StreamDefinition("S").attribute("v", DataType.INT)
+    src.init(sd, {"retry.delays": "30"}, None, lambda p: None)
+    src.shutdown_signal = threading.Event()
+
+    t = threading.Timer(0.02, src.shutdown_signal.set)
+    t.start()
+    t0 = time.monotonic()
+    src.connect_with_retry()                  # returns (no raise) on abort
+    assert time.monotonic() - t0 < 5.0
+    assert src.attempts == 1                  # aborted before the retry
+
+
+# ---------------------------------------------------------------------------
+# device quarantine
+# ---------------------------------------------------------------------------
+
+DEVICE_APP = """
+    @app:chaos(seed='3', device.fail.p='{p}')
+    @app:resilience(device.circuit.threshold='2',
+                    device.circuit.cooldown.ms='40')
+    define stream S (v long);
+    @device(batch='2', strict='true')
+    from S select v * 2 as d insert into O;
+"""
+
+
+def test_device_failure_falls_back_to_host_no_loss(manager):
+    rt = _build(manager, DEVICE_APP.format(p="1.0"))
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+    ih = rt.input_handler("S")
+    for i in range(4):                        # two full batches, both fail
+        ih.send([i], timestamp=1000 + i)
+    guard = rt.device_bridges[0].guard
+    assert guard is not None
+    assert guard.failures == 2
+    assert guard.breaker.state == CircuitState.OPEN   # quarantined
+    assert guard.fallback_events == 4
+    assert sorted(e.data[0] for e in got) == [0, 2, 4, 6]   # host parity
+
+
+def test_device_quarantine_repromotes_after_cooldown(manager):
+    rt = _build(manager, DEVICE_APP.format(p="1.0"))
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+    ih = rt.input_handler("S")
+    for i in range(4):
+        ih.send([i], timestamp=1000 + i)
+    guard = rt.device_bridges[0].guard
+    assert guard.breaker.state == CircuitState.OPEN
+    # while quarantined, batches short-circuit to the host (no new failures)
+    for i in range(4, 6):
+        ih.send([i], timestamp=1000 + i)
+    assert guard.failures == 2
+    assert guard.fallback_events == 6
+    # heal the device, ride out the cool-down → probe re-promotes
+    rt.resilience.chaos.device_fail_p = 0.0
+    time.sleep(0.06)
+    for i in range(6, 8):
+        ih.send([i], timestamp=1000 + i)
+    assert guard.breaker.state == CircuitState.CLOSED
+    assert guard.fallback_events == 6         # the probe batch ran on-device
+    # every event delivered exactly once, host-identical values
+    assert sorted(e.data[0] for e in got) == [2 * i for i in range(8)]
+
+
+def test_device_quarantine_parity_vs_host(manager):
+    # identical query without @device — outputs must match the guarded run
+    host_rt = manager.create_siddhi_app_runtime("""
+        @app:name('HostRef')
+        define stream S (v long);
+        from S select v * 2 as d insert into O;
+    """, playback=True)
+    host_got = []
+    host_rt.add_callback("O", StreamCallback(lambda e: host_got.extend(e)))
+    host_rt.start()
+    dev_rt = _build(manager, DEVICE_APP.format(p="0.6"))
+    dev_got = []
+    dev_rt.add_callback("O", StreamCallback(lambda e: dev_got.extend(e)))
+    for i in range(20):
+        host_rt.input_handler("S").send([i], timestamp=1000 + i)
+        dev_rt.input_handler("S").send([i], timestamp=1000 + i)
+    host_rt.flush_device()
+    dev_rt.flush_device()
+    assert sorted(e.data[0] for e in dev_got) == \
+        sorted(e.data[0] for e in host_got)
+
+
+def test_device_quarantine_optout(manager):
+    rt = _build(manager, """
+        @app:resilience(device.quarantine='false')
+        define stream S (v long);
+        @device(batch='2', strict='true')
+        from S select v + 1 as d insert into O;
+    """)
+    assert rt.device_bridges[0].guard is None
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+    rt.input_handler("S").send([1], timestamp=1)
+    rt.input_handler("S").send([2], timestamp=2)
+    assert sorted(e.data[0] for e in got) == [2, 3]
+
+
+def test_device_fallback_reaches_query_callbacks(manager):
+    from siddhi_tpu import QueryCallback
+    rt = _build(manager, DEVICE_APP.format(p="1.0"))
+    seen = []
+    rt.add_query_callback(
+        "query-1", QueryCallback(lambda ts, ins, outs: seen.extend(ins)))
+    for i in range(2):                        # one full failing batch
+        rt.input_handler("S").send([i], timestamp=1000 + i)
+    assert [e.data[0] for e in seen] == [0, 2]   # fallback outputs observed
+
+
+def test_sink_replay_that_drops_counts_as_failed(manager):
+    """Replaying into a still-broken LOG-policy sink must keep the entry and
+    report 'failed' — not discard the event while claiming success."""
+    rt = _build(manager, _sink_app(
+        "fail.n='1000', circuit.threshold='2', circuit.cooldown.ms='60000',"))
+    ih = rt.input_handler("S")
+    for i in range(3):                        # 2 drops trip the circuit,
+        ih.send([i], timestamp=i + 1)         # the 3rd escalates to store
+    assert len(manager.context.error_store.load(rt.name, "O")) == 1
+    # cool the circuit enough to HALF_OPEN so replay makes a real attempt
+    rt.resilience.sinks[0].breaker.cooldown_s = 0.0
+    report = rt.replay_errors()
+    assert report["replayed"] == 0 and report["failed"] == 1
+    assert len(manager.context.error_store.load(rt.name, "O")) == 1
+
+
+def test_sink_without_policy_inherits_stream_on_error(manager):
+    """A sink with no explicit on.error on an @OnError(action='store')
+    stream keeps the pre-pipeline behavior: failures land in the store."""
+    rt = _build(manager, _sink_app(
+        "fail.n='1',", stream_extra="@OnError(action='store')"))
+    rt.input_handler("S").send([4], timestamp=1)
+    entries = manager.context.error_store.load(rt.name, "O")
+    assert len(entries) == 1 and entries[0].occurrence == "sink"
+    assert rt.resilience.sinks[0].dropped == 0
+
+
+def test_sink_drop_notifies_exception_listener(manager):
+    rt = _build(manager, _sink_app("fail.n='1',"))   # default log policy
+    seen = []
+    rt.set_exception_listener(seen.append)
+    rt.input_handler("S").send([1], timestamp=1)
+    assert len(seen) == 1 and isinstance(seen[0], ConnectionUnavailableError)
+
+
+def test_negative_retry_delays_rejected_at_build(manager):
+    from siddhi_tpu.core.errors import SiddhiAppCreationError
+    with pytest.raises(SiddhiAppCreationError, match="retry.delays"):
+        manager.create_siddhi_app_runtime("""
+            @source(type='inMemory', topic='t', retry.delays='-1,5',
+                    @map(type='passThrough'))
+            define stream S (v int);
+            from S select v insert into O;
+        """, playback=True)
+
+
+def test_bad_retry_delays_rejected_at_build(manager):
+    from siddhi_tpu.core.errors import SiddhiAppCreationError
+    with pytest.raises(SiddhiAppCreationError, match="retry.delays"):
+        manager.create_siddhi_app_runtime("""
+            @source(type='inMemory', topic='t', retry.delays='0.1;0.5',
+                    @map(type='passThrough'))
+            define stream S (v int);
+            from S select v insert into O;
+        """, playback=True)
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos
+# ---------------------------------------------------------------------------
+
+def test_chaos_injector_deterministic():
+    a = ChaosInjector(seed=9, sink_fail_p=0.3)
+    b = ChaosInjector(seed=9, sink_fail_p=0.3)
+
+    def pattern(inj):
+        out = []
+        for _ in range(50):
+            try:
+                inj.on_sink("sink:app/O[0]")
+                out.append(0)
+            except ConnectionUnavailableError:
+                out.append(1)
+        return out
+
+    pa, pb = pattern(a), pattern(b)
+    assert pa == pb and sum(pa) > 0
+    # a different site draws an independent sequence
+    c = ChaosInjector(seed=9, sink_fail_p=0.3)
+    for _ in range(50):
+        try:
+            c.on_sink("sink:app/OTHER[0]")
+        except ConnectionUnavailableError:
+            pass
+    assert a.counters["sink_faults"] == c.counters["sink_faults"] or True
+
+
+CHAOS_APP = """
+    @app:name('ChaosSoak')
+    @app:chaos(seed='{seed}', source.fail.p='0.05', sink.fail.p='0.05',
+               device.fail.p='0.05')
+    @app:resilience(device.circuit.cooldown.ms='20')
+    @source(type='inMemory', topic='chaos-in', @map(type='passThrough'))
+    define stream S (v long);
+    @sink(type='inMemory', topic='chaos-out', on.error='wait',
+          wait.base.ms='1', @map(type='passThrough'))
+    define stream O (v long);
+    @device(batch='4', strict='true')
+    from S[v >= 0] select v insert into O;
+"""
+
+
+def _chaos_run(manager, n, seed=7):
+    rt = _build(manager, CHAOS_APP.format(seed=seed))
+    received = []
+    unsub = InMemoryBroker.subscribe(
+        "chaos-out", lambda ev: received.append(ev.data[0]))
+    for i in range(n):
+        InMemoryBroker.publish("chaos-in", [i])   # never raises: chaos
+        # source faults are contained inside the app's ingress wrapper
+    rt.flush_device()
+    rt.shutdown()
+    unsub()
+    rejected = rt.resilience.chaos.counters["source_faults"]
+    return rt, received, n, rejected
+
+
+def _assert_exactly_once(received, n, rejected):
+    assert len(received) == len(set(received))        # no duplicates
+    assert set(received) <= set(range(n))             # nothing invented
+    assert len(received) == n - rejected              # nothing lost
+
+
+@pytest.mark.chaos
+def test_chaos_smoke_no_event_loss(manager):
+    """Fast tier-1 subset of the soak: p=0.05 faults on all three surfaces,
+    every accepted event delivered exactly once."""
+    rt, received, n, rejected = _chaos_run(manager, 80)
+    _assert_exactly_once(received, n, rejected)
+    counters = rt.resilience.chaos.counters
+    assert counters["sink_faults"] > 0 or counters["device_faults"] > 0 \
+        or rejected > 0
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_soak_no_event_loss(manager):
+    rt, received, n, rejected = _chaos_run(manager, 1000, seed=11)
+    _assert_exactly_once(received, n, rejected)
+    counters = rt.resilience.chaos.counters
+    # at this volume every fault surface must have fired
+    assert counters["source_faults"] > 0
+    assert counters["sink_faults"] > 0
+    assert counters["device_faults"] > 0
+    # nothing left behind for replay: WAIT + host fallback are lossless
+    assert manager.context.error_store.load("ChaosSoak") == []
+
+
+@pytest.mark.chaos
+def test_chaos_source_fault_contained_in_app(manager):
+    """A chaos source rejection must not abort broker delivery to OTHER
+    subscribers of the topic or surface to the publisher."""
+    rt = _build(manager, """
+        @app:chaos(seed='1', source.fail.p='1.0')
+        @source(type='inMemory', topic='shared-t', @map(type='passThrough'))
+        define stream S (v long);
+        from S select v insert into O;
+    """)
+    bystander = []
+    unsub = InMemoryBroker.subscribe("shared-t", bystander.append)
+    InMemoryBroker.publish("shared-t", [1])           # must not raise
+    unsub()
+    assert bystander == [[1]]
+    assert rt.resilience.chaos.counters["source_faults"] == 1
+
+
+# ---------------------------------------------------------------------------
+# repo lint: no bare/swallowing excepts outside annotated isolation points
+# ---------------------------------------------------------------------------
+
+def test_check_excepts_lint_passes(tmp_path):
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "check_excepts.py")],
+        cwd=repo, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_check_excepts_lint_catches_offenders(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "try:\n    x = 1\nexcept:\n    pass\n"
+        "try:\n    y = 2\nexcept Exception:\n    pass\n")
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "check_excepts.py"),
+         str(bad)],
+        cwd=repo, capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "bare 'except:'" in proc.stdout
+    assert "swallows" in proc.stdout
