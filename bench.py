@@ -60,6 +60,10 @@ LAT_BUDGET_MS = float(os.environ.get("BENCH_LAT_BUDGET_MS", 100.0))
 # chosen size ships in the JSON as "adaptive_batch_size". Off by default —
 # the recorded bench numbers stay on the static path.
 ADAPTIVE = os.environ.get("BENCH_ADAPTIVE", "") == "1"
+# BENCH_METRICS=1: the host child enables BASIC statistics and the final
+# JSON line carries a "metrics_snapshot" (percentile latencies, gauges)
+# alongside the timings; default output stays byte-identical
+BENCH_METRICS = os.environ.get("BENCH_METRICS", "") == "1"
 ADAPTIVE_TARGET_MS = float(
     os.environ.get("BENCH_ADAPTIVE_TARGET_MS", LAT_BUDGET_MS / 2))
 SLOT_CAP = int(os.environ.get("BENCH_SLOT_CAP", 64))
@@ -526,6 +530,9 @@ def child_host() -> None:
     events = gen_events(max(BASELINE_EVENTS, ORACLE_EVENTS))
     m = SiddhiManager()
     rt = m.create_siddhi_app_runtime(make_app(), playback=True)
+    if BENCH_METRICS:
+        from siddhi_tpu.core.metrics import Level
+        rt.set_statistics_level(Level.BASIC)
     n_matches = 0
 
     def on_out(evs):
@@ -543,11 +550,16 @@ def child_host() -> None:
     # continue the identical prefix to the oracle horizon (not timed)
     for dev, v, ts in events[BASELINE_EVENTS:ORACLE_EVENTS]:
         ih.send([dev, v], timestamp=ts)
+    child_out = {"rate": rate, "oracle_matches": n_matches}
+    if BENCH_METRICS:
+        # final statistics snapshot (percentile latencies, throughput,
+        # flow/resilience gauges) rides alongside the timings
+        child_out["metrics"] = rt.ctx.statistics_manager.report()
     m.shutdown()
     print(f"# interpreter: {BASELINE_EVENTS} events in {dt:.3f}s -> "
           f"{rate:,.0f} ev/s; oracle matches over {ORACLE_EVENTS}: "
           f"{n_matches}", file=sys.stderr)
-    print(json.dumps({"rate": rate, "oracle_matches": n_matches}))
+    print(json.dumps(child_out))
 
 
 # ---------------------------------------------------------------------------
@@ -708,6 +720,8 @@ def main() -> None:
         out = {"metric": metric, "value": 0, "unit": "events/sec",
                "vs_baseline": 0.0, "device_ok": False}
     out["smoke"] = smoke_field
+    if BENCH_METRICS and host and host.get("metrics"):
+        out["metrics_snapshot"] = host["metrics"]
     if notes:
         out["notes"] = notes
     print(json.dumps(out))

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Repo lint: the Prometheus exposition surface stays well-formed.
+
+Deploys a representative app exercising every metric family (async
+streams, flow control, device offload, resilient sinks, latency
+histograms), renders the exposition, and enforces:
+
+- every metric name is ``snake_case`` and ``siddhi_tpu``-prefixed;
+- every label name is ``snake_case`` and every sample line parses;
+- each (metric, labels) sample appears exactly once per app — a tracker
+  registered twice per app would double-expose here;
+- ``# TYPE`` is declared exactly once per family, before its samples;
+- histogram bucket counts are cumulative (monotone, ``+Inf`` == count).
+
+Usage: ``python scripts/check_metric_names.py``. Exit code 1 on findings.
+Run by ``tests/test_observability.py`` so it gates CI (the
+``check_excepts.py`` pattern).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# runnable as `python scripts/check_metric_names.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+METRIC_RE = re.compile(r"^siddhi_tpu_[a-z][a-z0-9_]*$")
+LABEL_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
+SAMPLE_RE = re.compile(
+    r"^(?P<name>[A-Za-z_:][A-Za-z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$")
+LABEL_PAIR_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+APP = """
+@app(name='LintApp', statistics='detail')
+@app:backpressure(capacity='64', policy='shed')
+@app:trace(sample='1/1')
+@async(buffer.size='32')
+define stream S (v double);
+@sink(type='inMemory', topic='lint_t', @map(type='passThrough'))
+define stream O (t double);
+@device(batch='32')
+from S#window.length(16) select sum(v) as t insert into O;
+"""
+
+
+def build_exposition() -> str:
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.observability import render
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(APP, playback=True)
+    rt.start()
+    ih = rt.input_handler("S")
+    for i in range(40):
+        ih.send([float(i)], timestamp=1000 + i)
+    rt.drain_async()
+    rt.flush_device()
+    text = render([rt.ctx.statistics_manager])
+    m.shutdown()
+    return text
+
+
+def check(text: str) -> list[str]:
+    problems: list[str] = []
+    typed: dict[str, str] = {}
+    seen_samples: set[tuple] = set()
+    histograms: dict[tuple, list[tuple[float, float]]] = {}
+    hist_counts: dict[tuple, float] = {}
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            name, mtype = parts[2], parts[3]
+            if not METRIC_RE.match(name):
+                problems.append(
+                    f"line {lineno}: metric '{name}' is not snake_case "
+                    f"siddhi_tpu_*")
+            if name in typed:
+                problems.append(
+                    f"line {lineno}: duplicate TYPE for '{name}'")
+            typed[name] = mtype
+            continue
+        if line.startswith("#"):
+            problems.append(f"line {lineno}: unknown comment form: {line}")
+            continue
+        m = SAMPLE_RE.match(line)
+        if m is None:
+            problems.append(f"line {lineno}: unparseable sample: {line}")
+            continue
+        name = m.group("name")
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        if base not in typed and name not in typed:
+            problems.append(
+                f"line {lineno}: sample '{name}' has no TYPE declaration "
+                f"above it")
+            base = name
+        family = base if base in typed else name
+        labels = {}
+        raw = m.group("labels") or ""
+        consumed = sum(len(p.group(0)) for p in LABEL_PAIR_RE.finditer(raw))
+        if len(raw.replace(",", "")) != consumed:
+            problems.append(f"line {lineno}: malformed labels: {{{raw}}}")
+        for p in LABEL_PAIR_RE.finditer(raw):
+            k, v = p.group(1), p.group(2)
+            if not LABEL_RE.match(k):
+                problems.append(
+                    f"line {lineno}: label '{k}' is not snake_case")
+            labels[k] = v
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            problems.append(
+                f"line {lineno}: non-numeric value {m.group('value')!r}")
+            continue
+        key = (name, tuple(sorted(labels.items())))
+        if key in seen_samples:
+            problems.append(
+                f"line {lineno}: duplicate sample {name}{dict(labels)} — "
+                f"a metric must be registered exactly once per app")
+        seen_samples.add(key)
+        # histogram structure
+        if typed.get(family) == "histogram":
+            series = tuple(sorted((k, v) for k, v in labels.items()
+                                  if k != "le"))
+            if name.endswith("_bucket"):
+                le = labels.get("le")
+                b = float("inf") if le == "+Inf" else float(le)
+                histograms.setdefault((family, series), []).append((b, value))
+            elif name.endswith("_count"):
+                hist_counts[(family, series)] = value
+
+    for (family, series), buckets in histograms.items():
+        buckets.sort(key=lambda x: x[0])
+        last = -1.0
+        for le, cum in buckets:
+            if cum < last:
+                problems.append(
+                    f"{family}{dict(series)}: bucket le={le} count {cum} "
+                    f"not cumulative")
+            last = cum
+        if buckets and buckets[-1][0] != float("inf"):
+            problems.append(f"{family}{dict(series)}: missing +Inf bucket")
+        total = hist_counts.get((family, series))
+        if buckets and total is not None and buckets[-1][1] != total:
+            problems.append(
+                f"{family}{dict(series)}: +Inf bucket {buckets[-1][1]} "
+                f"!= _count {total}")
+    return problems
+
+
+def main() -> int:
+    text = build_exposition()
+    problems = check(text)
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"\n{len(problems)} problem(s) found.")
+        return 1
+    n = sum(1 for ln in text.splitlines()
+            if ln and not ln.startswith("#"))
+    print(f"OK: {n} sample(s) clean.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,6 +108,11 @@ class SiddhiAppRuntime:
             from ..flow.adaptive_batch import parse_adaptive_annotation
             self.ctx.adaptive_cfg = parse_adaptive_annotation(adaptive_ann)
         self.flow = None                # FlowSubsystem when @app:wal/@app:backpressure
+        # observability BEFORE _build: the @app:trace tracer must exist on
+        # the context while queries, sinks and device bridges compile their
+        # instrumentation points
+        from ..observability import ObservabilitySubsystem
+        self.observability = ObservabilitySubsystem(self)
         # fault-handling layer (sink pipelines, device quarantine, @app:chaos)
         # — built BEFORE _build so sinks wrap and device guards attach as the
         # IO and query surfaces compile
@@ -115,6 +120,9 @@ class SiddhiAppRuntime:
         self.resilience = ResilienceSubsystem(self)
 
         self._build()
+        # gauges/probes over the finished surfaces (bridges, junctions,
+        # sources) — after _build so every element exists
+        self.observability.wire()
 
     # ------------------------------------------------------------------ build
     def _build(self) -> None:
@@ -560,6 +568,7 @@ class SiddhiAppRuntime:
         if not getattr(self, "_defer_sources", False):
             for src in self.sources:
                 src.connect_with_retry()
+        self.observability.on_start()
         self.ctx.statistics_manager.start_reporting()
         if not self.ctx.timestamp_generator.playback:
             self.ctx.ticker = SystemTicker(self.ctx.scheduler)
@@ -599,6 +608,7 @@ class SiddhiAppRuntime:
                 getattr(mgr, f"unregister_{'record_table' if kind == 'table' else kind}_handler")(hid)
         if self.flow is not None:
             self.flow.close()
+        self.observability.on_shutdown()
         self.ctx.statistics_manager.stop_reporting()
         if self.ctx.ticker is not None:
             self.ctx.ticker.stop()

@@ -57,6 +57,8 @@ class SiddhiAppContext:
         self.debugger = None
         self.runtime = None                         # back-ref set by SiddhiAppRuntime
         self.statistics_manager = None
+        self.tracer = None          # PipelineTracer when @app:trace (hot
+        # paths gate on one attribute, like flow/debugger)
 
     # -- ids -----------------------------------------------------------------
     def element_id(self, prefix: str) -> str:

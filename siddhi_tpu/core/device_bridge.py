@@ -17,6 +17,7 @@ playback watermark advancement).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Optional
 
 from ..query_api import (
@@ -94,23 +95,35 @@ class AsyncDeviceDriver:
             try:
                 try:
                     t0 = time.perf_counter()
+                    stepped = False
+                    dt = 0.0
                     rows = self.rt.process(batch)
+                    stepped = True
                     dt = time.perf_counter() - t0
                     self.step_seconds += dt
                     self.batches_stepped += 1
-                    observe = getattr(self.rt, "observe_step", None)
-                    if observe is not None:
-                        observe(batch.get("count", 0), dt)
                 except Exception:   # noqa: BLE001 — last-resort worker
                     # isolation; with the resilience layer active the
                     # DeviceGuard wrapping rt.process has already rerouted
                     # the batch to the host path before this can trigger
                     log.exception("device step failed")
                     rows = []
+                    dt = time.perf_counter() - t0
                 finally:
-                    with self._cv:
-                        self._stepping = False
-                        self._cv.notify_all()
+                    try:
+                        # the probe must see EVERY consumed batch (success
+                        # or not) or its FIFO trace groups desynchronize
+                        observe = getattr(self.rt, "observe_step", None)
+                        if observe is not None:
+                            observe(batch.get("count", 0), dt,
+                                    device_path=stepped)
+                    except Exception:   # noqa: BLE001 — a raising observer
+                        # must not kill the sole device worker
+                        log.exception("step observer failed")
+                    finally:
+                        with self._cv:
+                            self._stepping = False
+                            self._cv.notify_all()
                 if rows:
                     with self.app_context.root_lock:
                         # stamp outputs with the batch's own last event time —
@@ -157,6 +170,8 @@ class AsyncDeviceDriver:
         event sent so far. Call without the engine lock."""
         with self.app_context.root_lock:
             if len(self.rt.builder):
+                self.rt._seal()     # trace group closes WITH the emit,
+                # under the lock producers pack under
                 self.submit(self.rt.builder.emit())
         self.quiesce()
 
@@ -206,6 +221,7 @@ class _DeviceRTBase(AdaptiveFlushMixin):
     def flush(self):
         if len(self.builder) == 0:
             return
+        self._seal()            # trace group closes exactly at the emit
         b = self.builder.emit()
         if self.driver is not None:
             self.driver.submit(b)
@@ -252,6 +268,7 @@ class DeviceQueryBridge:
         self.query_name = query_name
         self.query_callbacks: list = []
         self.guard = None                   # DeviceGuard (resilience layer)
+        self.probe = None                   # DeviceStepProbe (observability)
         self._on_rows_accepts_ts = True     # deliver() passes the batch ts
         runtime.add_callback(self._on_rows)
         self._out_ts = 0
@@ -278,13 +295,25 @@ class DeviceQueryBridge:
     def on_event(self, stream_id: str, event: StreamEvent) -> None:
         if event.type != EventType.CURRENT:
             return
+        probe = self.probe
+        if probe is not None and probe.tracer is not None:
+            # register BEFORE packing: a capacity flush inside send() steps
+            # the batch this event is part of, closing the span right away
+            tr = probe.tracer.active
+            if tr is not None:
+                probe.pending.append((tr, time.perf_counter_ns()))
         self._out_ts = event.timestamp
         if self.kind == "stream":
             self.runtime.send(event.data, timestamp=event.timestamp)
         else:                       # 'nfa' | 'join': merged multi-stream batch
             self.runtime.send(stream_id, event.data, event.timestamp)
 
-    def flush(self) -> None:
+    def flush(self, cause: str = "drain") -> None:
+        if len(self.runtime.builder):
+            # cause accounting only — the trace-group seal happens at the
+            # emit itself (runtime.flush / driver.flush_sync, under the
+            # engine lock), so groups can never drift from batches
+            self.runtime._count_flush(cause)
         if self.driver is not None:
             # async: submit the partial batch and drain the device queue.
             # Must not hold the engine lock (the worker's delivery needs it).
@@ -295,7 +324,7 @@ class DeviceQueryBridge:
     def finalize(self) -> None:
         """Shutdown barrier: emit what an open device segment still holds
         (timeBatch terminal bucket — advisor r3)."""
-        self.flush()
+        self.flush(cause="final")
         fin = getattr(self.runtime, "finalize", None)
         if fin is not None:
             fin()
@@ -652,6 +681,7 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
         log.info("query '%s' falls back to host path: %s", name, e)
         return None
 
+    bridge.batch_capacity = batch       # pad-ratio denominator (observability)
     if app_context.adaptive_cfg is not None:
         # @app:adaptive: flush thresholds track observed rate/latency; the
         # query's own batch capacity caps the adjustable range

@@ -27,7 +27,8 @@ class StreamEvent:
     (reference ``GroupedComplexEvent``): grouped first/last output rate
     limiters batch per key, not per event stream."""
 
-    __slots__ = ("timestamp", "data", "type", "group_key", "flow_seq")
+    __slots__ = ("timestamp", "data", "type", "group_key", "flow_seq",
+                 "trace")
 
     def __init__(self, timestamp: int, data: list, type: EventType = EventType.CURRENT):
         self.timestamp = timestamp
@@ -38,6 +39,10 @@ class StreamEvent:
         # otherwise): the junction advances the stream's applied watermark
         # with it at delivery (siddhi_tpu/flow)
         self.flow_seq = None
+        # sampled observability Trace riding an @async enqueue — the
+        # delivery worker re-activates it (siddhi_tpu/observability);
+        # synchronous paths propagate thread-locally and never stamp it
+        self.trace = None
 
     def copy(self) -> "StreamEvent":
         return StreamEvent(self.timestamp, list(self.data), self.type)

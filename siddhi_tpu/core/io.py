@@ -244,6 +244,9 @@ class Source:
     extension_kind = "source"
     RETRY_DELAYS = [0.1, 0.5, 1.0, 5.0]
     shutdown_signal: Optional[threading.Event] = None   # set by the runtime
+    connect_attempts = 0        # cumulative, incl. retries — exposed as the
+    # siddhi_tpu_source_connect_attempts_total metric (a climbing count on a
+    # running app is a flapping transport)
 
     def init(self, definition: StreamDefinition, options: dict,
              mapper: SourceMapper, handler: Callable[[Any], None]) -> None:
@@ -292,6 +295,7 @@ class Source:
                          "(app shutting down)", self.definition.id)
                 return
             try:
+                self.connect_attempts += 1
                 self.connect()
                 return
             except ConnectionUnavailableError as e:

@@ -9,10 +9,19 @@ Reference: ``core/util/statistics/`` SPI (``ThroughputTracker``,
 ``sys.getsizeof``-based with a pytree fast path for device state, where the
 honest figure is the HBM bytes of the arrays).
 
+Latency trackers are log-bucketed histograms
+(:mod:`siddhi_tpu.observability.histogram`) — p50/p90/p99/p99.9, not just
+the average — taken/closed with explicit tokens so concurrent or
+re-entrant measurements on one tracker can't mis-pair
+(``t = tracker.start(); ...; tracker.stop(t)``). The reference-style
+``mark_in``/``mark_out`` pair survives as a deprecated single-slot shim.
+
 Reporters: ``@app(statistics='true')`` enables BASIC; @app elements
 ``statistics.reporter`` ('log' | 'console' | registered name) and
 ``statistics.interval`` (seconds) configure periodic emission — the analog
-of the reference's Dropwizard reporter wiring.
+of the reference's Dropwizard reporter wiring. Machine scraping goes
+through :mod:`siddhi_tpu.observability.prometheus` instead
+(``GET /siddhi-apps/{name}/metrics``).
 """
 
 from __future__ import annotations
@@ -23,6 +32,8 @@ import sys
 import threading
 import time
 from typing import Callable, Optional
+
+from ..observability.histogram import LogHistogram
 
 log = logging.getLogger("siddhi_tpu.metrics")
 
@@ -43,41 +54,102 @@ class ThroughputTracker:
 
 
 class LatencyTracker:
+    """Latency distribution over one site (histogram-backed).
+
+    Token API: ``t = tracker.start(); ...; tracker.stop(t)`` — tokens are
+    plain ``perf_counter_ns`` values, so overlapping measurements from any
+    number of threads pair correctly."""
+
     def __init__(self, name: str):
         self.name = name
-        self.total_ns = 0
-        self.count = 0
-        self._start: Optional[int] = None
+        self.hist = LogHistogram()
+        self._start: Optional[int] = None       # deprecated-shim slot only
+        self._shim_warned = False
 
+    def start(self) -> int:
+        return time.perf_counter_ns()
+
+    def stop(self, token: int) -> int:
+        """Close a measurement opened by :meth:`start`; returns the ns."""
+        dt_ns = time.perf_counter_ns() - token
+        self.hist.record(dt_ns / 1e9)
+        return dt_ns
+
+    def record_seconds(self, seconds: float) -> None:
+        """Record an externally-timed sample (device step durations)."""
+        self.hist.record(seconds)
+
+    # -- deprecated single-slot shim ------------------------------------------
     def mark_in(self) -> None:
-        self._start = time.perf_counter_ns()
+        """Deprecated: single-slot pairing drops/mis-pairs overlapping
+        measurements — use the ``start()``/``stop(token)`` API."""
+        if not self._shim_warned:
+            self._shim_warned = True
+            log.warning("LatencyTracker('%s').mark_in/mark_out is "
+                        "deprecated; use t = start(); stop(t)", self.name)
+        self._start = self.start()
 
     def mark_out(self) -> None:
         if self._start is not None:
-            self.total_ns += time.perf_counter_ns() - self._start
-            self.count += 1
+            self.stop(self._start)
             self._start = None
+
+    # -- readouts --------------------------------------------------------------
+    @property
+    def count(self) -> int:
+        return self.hist.count
+
+    @property
+    def total_ns(self) -> int:
+        return int(self.hist.sum * 1e9)
 
     @property
     def avg_ms(self) -> float:
-        return (self.total_ns / self.count) / 1e6 if self.count else 0.0
+        c = self.hist.count
+        return (self.hist.sum / c) * 1e3 if c else 0.0
+
+    def percentiles_ms(self) -> dict:
+        s = self.hist.snapshot()
+        return {"count": s["count"], "avg_ms": s["avg"] * 1e3,
+                "p50_ms": s["p50"] * 1e3, "p90_ms": s["p90"] * 1e3,
+                "p99_ms": s["p99"] * 1e3, "p999_ms": s["p999"] * 1e3,
+                "max_ms": s["max"] * 1e3}
 
 
-class BufferedEventsTracker:
+class _GaugeErrorMixin:
+    """A dead gauge reads 0 — but COUNTED and logged once, never silently
+    (a zero that is really a failure must be distinguishable)."""
+
+    on_error: Optional[Callable[[], None]] = None
+    _error_logged = False
+
+    def _gauge_failed(self, e: Exception):
+        if self.on_error is not None:
+            self.on_error()
+        if not self._error_logged:
+            self._error_logged = True
+            log.warning("gauge '%s' failed (reads 0 from now on a failure): "
+                        "%s", self.name, e)
+        return 0
+
+
+class BufferedEventsTracker(_GaugeErrorMixin):
     """Gauge over a queue-depth callable (reference
     ``BufferedEventsTracker.java`` / ``StreamJunction.getBufferedEvents:359``
     — async junction ring occupancy)."""
 
-    def __init__(self, name: str, depth_fn: Callable[[], int]):
+    def __init__(self, name: str, depth_fn: Callable[[], int],
+                 on_error: Optional[Callable[[], None]] = None):
         self.name = name
         self._depth_fn = depth_fn
+        self.on_error = on_error
 
     @property
     def buffered(self) -> int:
         try:
             return int(self._depth_fn())
-        except Exception:       # noqa: BLE001 — a dead gauge reads 0
-            return 0
+        except Exception as e:  # noqa: BLE001 — counted dead-gauge read
+            return self._gauge_failed(e)
 
 
 # shared back-references every element holds — following them would charge
@@ -113,20 +185,22 @@ def _deep_size(obj, seen: set, depth: int = 0) -> int:
     return size
 
 
-class MemoryUsageTracker:
+class MemoryUsageTracker(_GaugeErrorMixin):
     """Gauge over a state-holder (reference
     ``memory/SiddhiMemoryUsageMetric.java``'s object-graph walker)."""
 
-    def __init__(self, name: str, target_fn: Callable[[], object]):
+    def __init__(self, name: str, target_fn: Callable[[], object],
+                 on_error: Optional[Callable[[], None]] = None):
         self.name = name
         self._target_fn = target_fn
+        self.on_error = on_error
 
     @property
     def bytes(self) -> int:
         try:
             return _deep_size(self._target_fn(), set())
-        except Exception:       # noqa: BLE001
-            return 0
+        except Exception as e:  # noqa: BLE001 — counted dead-gauge read
+            return self._gauge_failed(e)
 
 
 class CounterTracker:
@@ -142,21 +216,23 @@ class CounterTracker:
         self.count += n
 
 
-class GaugeTracker:
+class GaugeTracker(_GaugeErrorMixin):
     """Generic numeric gauge over a callable — the flow subsystem's
     wal_bytes / queue_depth / credits / shed_count / batch_size readouts
     (counterpart of the reference's Dropwizard ``Gauge`` registrations)."""
 
-    def __init__(self, name: str, value_fn: Callable[[], float]):
+    def __init__(self, name: str, value_fn: Callable[[], float],
+                 on_error: Optional[Callable[[], None]] = None):
         self.name = name
         self._value_fn = value_fn
+        self.on_error = on_error
 
     @property
     def value(self):
         try:
             return self._value_fn()
-        except Exception:       # noqa: BLE001 — a dead gauge reads 0
-            return 0
+        except Exception as e:  # noqa: BLE001 — counted dead-gauge read
+            return self._gauge_failed(e)
 
 
 class Reporter:
@@ -193,27 +269,59 @@ class StatisticsManager:
         self.report_interval_s: float = 60.0
         self._timer: Optional[threading.Timer] = None
         self._reporting = False
+        self._generation = 0        # invalidates stale tick re-arms
         self._lock = threading.Lock()
+        # failed gauge reads land here (and log once per gauge) so a dead
+        # gauge is distinguishable from a true zero
+        self.gauge_errors = CounterTracker("app.gauge_errors")
+        self.counters["app.gauge_errors"] = self.gauge_errors
 
+    # registration runs at deploy time while the reporter timer may already
+    # be iterating — every mutation of the tracker dicts takes the lock,
+    # and report()/exposition snapshot under it
     def throughput_tracker(self, name: str) -> ThroughputTracker:
-        return self.throughput.setdefault(name, ThroughputTracker(name))
+        with self._lock:
+            return self.throughput.setdefault(name, ThroughputTracker(name))
 
     def latency_tracker(self, name: str) -> LatencyTracker:
-        return self.latency.setdefault(name, LatencyTracker(name))
+        with self._lock:
+            return self.latency.setdefault(name, LatencyTracker(name))
 
     def buffered_tracker(self, name: str, depth_fn) -> BufferedEventsTracker:
-        return self.buffered.setdefault(
-            name, BufferedEventsTracker(name, depth_fn))
+        with self._lock:
+            return self.buffered.setdefault(
+                name, BufferedEventsTracker(name, depth_fn,
+                                            self.gauge_errors.inc))
 
     def memory_tracker(self, name: str, target_fn) -> MemoryUsageTracker:
-        return self.memory.setdefault(
-            name, MemoryUsageTracker(name, target_fn))
+        with self._lock:
+            return self.memory.setdefault(
+                name, MemoryUsageTracker(name, target_fn,
+                                         self.gauge_errors.inc))
 
     def gauge_tracker(self, name: str, value_fn) -> GaugeTracker:
-        return self.gauges.setdefault(name, GaugeTracker(name, value_fn))
+        with self._lock:
+            return self.gauges.setdefault(
+                name, GaugeTracker(name, value_fn, self.gauge_errors.inc))
 
     def counter_tracker(self, name: str) -> CounterTracker:
-        return self.counters.setdefault(name, CounterTracker(name))
+        with self._lock:
+            return self.counters.setdefault(name, CounterTracker(name))
+
+    def snapshot_trackers(self) -> dict:
+        """Point-in-time shallow copies of every tracker dict — iterate
+        these, not the live dicts, so deploy-time registration can't mutate
+        mid-walk (values are evaluated OUTSIDE the lock: memory walkers and
+        gauges may be slow or re-entrant)."""
+        with self._lock:
+            return {
+                "throughput": dict(self.throughput),
+                "latency": dict(self.latency),
+                "buffered": dict(self.buffered),
+                "memory": dict(self.memory),
+                "gauges": dict(self.gauges),
+                "counters": dict(self.counters),
+            }
 
     def set_level(self, level: Level) -> None:
         self.level = level
@@ -232,9 +340,15 @@ class StatisticsManager:
             self.report_interval_s = float(interval_s)
 
     def start_reporting(self) -> None:
-        if self.reporter is None or self._timer is not None:
+        if self.reporter is None:
             return
-        self._reporting = True
+        with self._lock:
+            if self._timer is not None:     # chain already armed — checked
+                return                      # under the lock: two concurrent
+            # starts must not arm two chains
+            self._reporting = True
+            self._generation += 1
+            gen = self._generation
 
         def tick():
             if self.level != Level.OFF and self.reporter is not None:
@@ -244,14 +358,18 @@ class StatisticsManager:
                     log.exception("statistics reporter failed")
             with self._lock:
                 # a stop racing an in-flight tick would otherwise cancel the
-                # already-fired timer while this re-arm keeps the chain alive
-                if not self._reporting:
+                # already-fired timer while this re-arm keeps the chain
+                # alive; the generation check keeps a stale tick from
+                # re-arming alongside a chain started AFTER that stop
+                if not self._reporting or self._generation != gen:
                     return
                 self._timer = threading.Timer(self.report_interval_s, tick)
                 self._timer.daemon = True
                 self._timer.start()
 
         with self._lock:
+            if not self._reporting or self._generation != gen:
+                return                      # stopped before the first arm
             self._timer = threading.Timer(self.report_interval_s, tick)
             self._timer.daemon = True
             self._timer.start()
@@ -264,19 +382,26 @@ class StatisticsManager:
                 self._timer = None
 
     def report(self) -> dict:
+        snap = self.snapshot_trackers()
         data = {
             "app": self.app_name,
             "level": self.level.name,
-            "throughput": {k: v.count for k, v in self.throughput.items()},
-            "latency_avg_ms": {k: v.avg_ms for k, v in self.latency.items()},
+            "throughput": {k: v.count for k, v in snap["throughput"].items()},
+            "latency_avg_ms": {k: v.avg_ms
+                               for k, v in snap["latency"].items()},
             "buffered_events": {k: v.buffered
-                                for k, v in self.buffered.items()},
+                                for k, v in snap["buffered"].items()},
         }
-        if self.gauges:
-            data["gauges"] = {k: v.value for k, v in self.gauges.items()}
-        if self.counters:
-            data["counters"] = {k: v.count for k, v in self.counters.items()}
+        if snap["latency"]:
+            data["latency"] = {k: v.percentiles_ms()
+                               for k, v in snap["latency"].items()}
+        if snap["gauges"]:
+            data["gauges"] = {k: v.value for k, v in snap["gauges"].items()}
+        counters = {k: v.count for k, v in snap["counters"].items()
+                    if v.count or k != "app.gauge_errors"}
+        if counters:
+            data["counters"] = counters
         if self.level == Level.DETAIL:
             data["memory_bytes"] = {k: v.bytes
-                                    for k, v in self.memory.items()}
+                                    for k, v in snap["memory"].items()}
         return data

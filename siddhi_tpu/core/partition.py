@@ -54,7 +54,9 @@ class PartitionInstance:
             name = q.name() or f"{partition.name}-query-{i}"
             rt = build_query_runtime(
                 q, app_context, partition.stream_defs,
-                self._get_junction, f"{name}-k{key}", inner_defs=self.inner_defs)
+                self._get_junction, f"{name}-k{key}", inner_defs=self.inner_defs,
+                metric_name=name)   # one histogram per LOGICAL query: a
+            # tracker per partition key would grow without bound
             self.query_runtimes.append(rt)
             for sid, receiver in rt.subscriptions:
                 ist = q.input_stream

@@ -7,6 +7,7 @@ Reference: ``core/util/parser/`` — ``QueryParser.parse`` (QueryParser.java:90)
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Optional
 
 from ..query_api import (
@@ -214,6 +215,90 @@ class StreamReceiver:
         self.head.process(list(events))
 
 
+class ObservedReceiver:
+    """Outermost receiver wrapper: per-query end-to-end latency (the
+    ``query.{name}`` histogram, reference ``LatencyTracker`` sites around
+    ``StreamJunction`` delivery) plus the ``query`` trace span. One level
+    check per event when statistics are OFF and no trace is active."""
+
+    def __init__(self, inner, app_context, query_name: str,
+                 metric_name: Optional[str] = None):
+        from .metrics import Level
+        self._off = Level.OFF
+        self.inner = inner
+        self.app_context = app_context
+        self.query_name = query_name
+        sm = app_context.statistics_manager      # None on bare contexts
+        # metric_name caps cardinality: partition key instances share the
+        # LOGICAL query's histogram (a per-key tracker per partition key
+        # would grow without bound), while trace spans keep the full name
+        self.tracker = sm.latency_tracker(
+            f"query.{metric_name or query_name}") if sm is not None else None
+
+    def _observing(self):
+        ctx = self.app_context
+        tracer = ctx.tracer
+        tr = tracer.active if tracer is not None else None
+        sm = ctx.statistics_manager
+        return (sm is not None and sm.level is not self._off
+                and self.tracker is not None), tr
+
+    def receive(self, event: StreamEvent) -> None:
+        track, tr = self._observing()
+        if not track and tr is None:
+            self.inner.receive(event)
+            return
+        t0 = time.perf_counter_ns()
+        try:
+            self.inner.receive(event)
+        finally:
+            dt = time.perf_counter_ns() - t0
+            if track:
+                self.tracker.record_seconds(dt / 1e9)
+            if tr is not None:
+                tr.add_span("query", self.query_name, dt, 1)
+
+    def receive_chunk(self, events: list[StreamEvent]) -> None:
+        track, tr = self._observing()
+        if not track and tr is None:
+            self.inner.receive_chunk(events)
+            return
+        t0 = time.perf_counter_ns()
+        try:
+            self.inner.receive_chunk(events)
+        finally:
+            dt = time.perf_counter_ns() - t0
+            if track:
+                self.tracker.record_seconds(dt / 1e9)
+            if tr is not None:
+                tr.add_span("query", self.query_name, dt, len(events))
+
+
+class _StageProcessor(Processor):
+    """Trace-only pass-through: when a sampled trace is active, times the
+    chain from here down as one ``stage`` span (span durations nest, like
+    a span tree)."""
+
+    def __init__(self, app_context, stage: str, detail: str):
+        super().__init__()
+        self.app_context = app_context
+        self.stage = stage
+        self.detail = detail
+
+    def process(self, events):
+        tracer = self.app_context.tracer
+        tr = tracer.active if tracer is not None else None
+        if tr is None:
+            self.forward(events)
+            return
+        t0 = time.perf_counter_ns()
+        try:
+            self.forward(events)
+        finally:
+            tr.add_span(self.stage, self.detail,
+                        time.perf_counter_ns() - t0, len(events))
+
+
 class _ChainHead(Processor):
     def process(self, events):
         self.forward(events)
@@ -241,6 +326,9 @@ def build_single_chain(stream: SingleInputStream, definition: StreamDefinition,
             tail = tail.set_next(FilterProcessor(cond))
         elif isinstance(h, Window):
             window_proc = make_window_processor(h, eff_def, app_context, query_id)
+            if app_context.tracer is not None:
+                tail = tail.set_next(_StageProcessor(
+                    app_context, "window", h.name or "empty"))
             tail = tail.set_next(window_proc)
         elif isinstance(h, StreamFunction):
             proc, eff_def = make_stream_function(h, eff_def, app_context)
@@ -271,10 +359,14 @@ class QueryRuntime:
 
 def build_query_runtime(query: Query, app_context, stream_defs: dict,
                         get_junction: Callable, name: str,
-                        inner_defs: Optional[dict] = None) -> QueryRuntime:
+                        inner_defs: Optional[dict] = None,
+                        metric_name: Optional[str] = None) -> QueryRuntime:
     """Construct a QueryRuntime. ``get_junction(stream_id, inner)`` resolves
-    junctions (partition-local for inner streams)."""
+    junctions (partition-local for inner streams). ``metric_name`` (default:
+    ``name``) keys the latency histogram — partition key instances pass the
+    logical query name so cardinality stays bounded."""
     rt = QueryRuntime(query, name)
+    rt.metric_name = metric_name or name
     qid = name
     ist = query.input_stream
 
@@ -314,9 +406,14 @@ def build_query_runtime(query: Query, app_context, stream_defs: dict,
         selector.current_on = ef != OutputEventsFor.EXPIRED_EVENTS
         selector.expired_on = ef != OutputEventsFor.CURRENT_EVENTS
         app_context.register_state(selector.element_id, selector)
+        if app_context.tracer is not None:
+            tail = tail.set_next(_StageProcessor(app_context, "selector",
+                                                 name))
         tail.set_next(_SelectorBridge(selector))
         from .debugger import DebuggedReceiver
-        receiver = DebuggedReceiver(StreamReceiver(head), name, app_context)
+        receiver = ObservedReceiver(
+            DebuggedReceiver(StreamReceiver(head), name, app_context),
+            app_context, name, rt.metric_name)
         rt.subscriptions.append((sid_eff, receiver))
 
     elif isinstance(ist, StateInputStream):
@@ -337,8 +434,10 @@ def build_query_runtime(query: Query, app_context, stream_defs: dict,
         from .debugger import DebuggedReceiver
         from .pattern import PatternStreamReceiver
         for sid in compiled.stream_ids:
-            rt.subscriptions.append((sid, DebuggedReceiver(
-                PatternStreamReceiver(pattern_rt, sid), name, app_context)))
+            rt.subscriptions.append((sid, ObservedReceiver(
+                DebuggedReceiver(PatternStreamReceiver(pattern_rt, sid),
+                                 name, app_context),
+                app_context, name, rt.metric_name)))
 
     elif isinstance(ist, JoinInputStream):
         selector = _build_join(ist, rt, app_context, stream_defs, stream_def,
@@ -722,8 +821,11 @@ def _build_join(ist: JoinInputStream, rt: QueryRuntime, app_context,
         if side["kind"] == "stream":
             from .debugger import DebuggedReceiver
             side["tail"].set_next(JoinSide(jr, is_left))
-            rt.subscriptions.append((side["stream"].stream_id, DebuggedReceiver(
-                StreamReceiver(side["head"]), rt.name, app_context)))
+            rt.subscriptions.append((side["stream"].stream_id, ObservedReceiver(
+                DebuggedReceiver(StreamReceiver(side["head"]), rt.name,
+                                 app_context),
+                app_context, rt.name,
+                getattr(rt, "metric_name", rt.name))))
         elif side["kind"] == "window":
             nw = app_context.named_windows[side["stream"].stream_id]
             bridge = _ChainHead()

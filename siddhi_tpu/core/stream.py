@@ -10,6 +10,7 @@ interpreter junction is synchronous and deterministic.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Callable, Optional
 
 from ..query_api.definition import AbstractDefinition
@@ -43,6 +44,8 @@ class StreamJunction:
         self.throughput = 0
         self.receiver_errors = 0           # every receiver failure counts —
         # multi-query fan-out faults must not collapse into one
+        self.last_event_ts: Optional[int] = None   # newest delivered event
+        # time — the watermark-lag gauge reads app clock minus this
         self.dispatcher = None             # AsyncDispatcher when @async
         self.flow = None                   # StreamFlow when @app:wal/@app:backpressure
 
@@ -65,6 +68,11 @@ class StreamJunction:
         if self.dispatcher is not None:
             # throughput counts at DELIVERY (worker, under the engine lock):
             # a bare += here would race between producer threads
+            tracer = self.app_context.tracer
+            if tracer is not None and event.trace is None:
+                # the delivery worker is a different thread: the sampled
+                # trace must ride the event across the queue
+                event.trace = tracer.active
             self.dispatcher.enqueue(("event", event))
             return
         self.deliver_event(event)
@@ -75,23 +83,42 @@ class StreamJunction:
         if not events:
             return
         if self.dispatcher is not None:
+            tracer = self.app_context.tracer
+            if tracer is not None and events[0].trace is None:
+                events[0].trace = tracer.active
             self.dispatcher.enqueue(("chunk", events))
             return
         self.deliver_events(events)
+
+    def _activate_trace(self, trace):
+        """Re-activate a queue-carried trace on the delivery thread; returns
+        True when a matching pop() is owed."""
+        tracer = self.app_context.tracer
+        if tracer is None or trace is None or tracer.active is trace:
+            return False
+        tracer.push(trace)
+        return True
 
     def deliver_event(self, event: StreamEvent) -> None:
         """Synchronous delivery into the receiver chain (worker entry point in
         async mode; delivery is serialized under the engine lock)."""
         self.throughput += 1
+        self.last_event_ts = event.timestamp if self.last_event_ts is None \
+            else max(self.last_event_ts, event.timestamp)
+        pushed = self._activate_trace(event.trace)
         first_error = None
-        for r in self.receivers:
-            try:
-                r.receive(event)
-            except Exception as e:  # noqa: BLE001 — per-receiver isolation:
-                # one faulty query must not starve the other subscribers
-                self._record_receiver_error(r, e)
-                if first_error is None:
-                    first_error = e
+        try:
+            for r in self.receivers:
+                try:
+                    r.receive(event)
+                except Exception as e:  # noqa: BLE001 — per-receiver isolation:
+                    # one faulty query must not starve the other subscribers
+                    self._record_receiver_error(r, e)
+                    if first_error is None:
+                        first_error = e
+        finally:
+            if pushed:
+                self.app_context.tracer.pop()
         if self.flow is not None and event.flow_seq is not None:
             # applied watermark advances under the engine lock: a quiesced
             # snapshot records a cut at a WAL record boundary
@@ -104,24 +131,32 @@ class StreamJunction:
 
     def deliver_events(self, events: list[StreamEvent]) -> None:
         self.throughput += len(events)
+        newest = max(e.timestamp for e in events)
+        self.last_event_ts = newest if self.last_event_ts is None \
+            else max(self.last_event_ts, newest)
+        pushed = self._activate_trace(events[0].trace)
         failures = {}           # id(event|chunk) -> (target, first exception)
-        for r in self.receivers:
-            if hasattr(r, "receive_chunk"):
-                try:
-                    r.receive_chunk(events)
-                except Exception as e:  # noqa: BLE001 — chunk receivers
-                    # process the batch as one unit: the failure is
-                    # attributed to the chunk, not an arbitrary member
-                    self._record_receiver_error(r, e)
-                    failures.setdefault(id(events), (events, e))
-            else:
-                for ev in events:
+        try:
+            for r in self.receivers:
+                if hasattr(r, "receive_chunk"):
                     try:
-                        r.receive(ev)
-                    except Exception as e:  # noqa: BLE001 — attribute the
-                        # failure to the event that actually raised
+                        r.receive_chunk(events)
+                    except Exception as e:  # noqa: BLE001 — chunk receivers
+                        # process the batch as one unit: the failure is
+                        # attributed to the chunk, not an arbitrary member
                         self._record_receiver_error(r, e)
-                        failures.setdefault(id(ev), (ev, e))
+                        failures.setdefault(id(events), (events, e))
+                else:
+                    for ev in events:
+                        try:
+                            r.receive(ev)
+                        except Exception as e:  # noqa: BLE001 — attribute the
+                            # failure to the event that actually raised
+                            self._record_receiver_error(r, e)
+                            failures.setdefault(id(ev), (ev, e))
+        finally:
+            if pushed:
+                self.app_context.tracer.pop()
         if self.flow is not None:
             seqs = [e.flow_seq for e in events if e.flow_seq is not None]
             if seqs:
@@ -180,9 +215,32 @@ class InputHandler:
 
     def send(self, data, timestamp: Optional[int] = None) -> None:
         """Accepts ``[a, b, c]``, ``Event``, or ``list[Event]``."""
-        if self.flow is not None and not self.flow.replaying:
-            self._send_flow(data, timestamp)
+        tracer = self.app_context.tracer
+        if tracer is None:
+            self._send(data, timestamp)
             return
+        tr = tracer.maybe_trace(self.stream_id)
+        if tr is None:
+            self._send(data, timestamp)
+            return
+        # sampled: the ingress span covers admission/WAL/dispatch; the
+        # trace stays stack-active so synchronous downstream stages (query,
+        # window, selector, sink) attach their spans without any plumbing
+        n = len(data) if data and not isinstance(data, Event) \
+            and isinstance(data[0], Event) else 1
+        t0 = time.perf_counter_ns()
+        tracer.push(tr)
+        outcome = "error"
+        try:
+            outcome = self._send(data, timestamp) or "ok"
+        finally:
+            tracer.pop()
+            tr.add_span("ingress", self.stream_id,
+                        time.perf_counter_ns() - t0, n, outcome)
+
+    def _send(self, data, timestamp: Optional[int] = None):
+        if self.flow is not None and not self.flow.replaying:
+            return self._send_flow(data, timestamp)
         if self.junction.dispatcher is not None:
             # async junction: producers only touch the queue mutex — the
             # watermark advances at DELIVERY time on the worker (under the
@@ -249,7 +307,8 @@ class InputHandler:
         for row in rows:
             self._check_arity(row)       # malformed rows must not hit the WAL
         if not self.flow.admit(len(rows)):
-            return                       # whole call shed by the gate
+            # whole call shed by the gate; the ingress span records it
+            return "shed"
 
         def build():
             events = [StreamEvent(ts, row, EventType.CURRENT)

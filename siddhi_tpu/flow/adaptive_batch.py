@@ -118,28 +118,64 @@ class AdaptiveFlushMixin:
     ``flush()`` and ``process(batch)``."""
 
     batch_controller = None     # AdaptiveBatchController via @app:adaptive
+    step_observer = None        # DeviceStepProbe.on_step (observability)
+    step_sealer = None          # DeviceStepProbe.seal — closes the probe's
+    # open trace group when a batch is emitted (FIFO group-per-batch)
+    flush_causes = None         # probe's flush-cause counter dict
+
+    def _count_flush(self, cause: str) -> None:
+        fc = self.flush_causes
+        if fc is not None:
+            fc[cause] = fc.get(cause, 0) + 1
 
     def _maybe_flush(self) -> None:
         """Flush on the hard capacity OR the adaptive soft threshold (jitted
         shapes stay static at capacity; only the fill level changes)."""
         c = self.batch_controller
-        if self.builder.full or (c is not None
-                                 and len(self.builder) >= c.current):
+        if self.builder.full:
+            self._count_flush("capacity")
+            self.flush()
+        elif c is not None and len(self.builder) >= c.current:
+            self._count_flush("adaptive")
             self.flush()
 
-    def observe_step(self, n_events: int, latency_s: float) -> None:
-        """Feed one stepped batch's latency to the adaptive controller (the
-        async driver reports its own step timing through this hook)."""
+    def _seal(self) -> None:
+        """Close the probe's open trace group — call immediately before
+        ``builder.emit()`` (every flush implementation does), so trace
+        groups pair 1:1 with emitted batches."""
+        s = self.step_sealer
+        if s is not None:
+            s()
+
+    def observe_step(self, n_events: int, latency_s: float,
+                     device_path: bool = True) -> None:
+        """Feed one stepped batch's latency to the adaptive controller and
+        the observability step probe (the async driver reports its own step
+        timing through this hook). ``device_path=False`` marks a step whose
+        work the resilience layer rerouted to the host interpreter — the
+        controller must not tune on it, but the probe still drains its
+        trace group."""
         c = self.batch_controller
-        if c is not None:
+        if c is not None and device_path:
             c.observe(n_events, latency_s)
+        obs = self.step_observer
+        if obs is not None:
+            obs(n_events, latency_s, device_path)
 
     def _timed_process(self, batch: dict):
-        """Sync-path ``process(batch)``, timed for the controller."""
-        if self.batch_controller is None:
+        """Sync-path ``process(batch)``, timed for the controller/probe."""
+        if self.batch_controller is None and self.step_observer is None:
             return self.process(batch)
         t0 = time.perf_counter()
-        rows = self.process(batch)
+        try:
+            rows = self.process(batch)
+        except BaseException:
+            # a raising step still consumed its batch: the probe must pop
+            # this batch's trace group or every later device span would be
+            # attributed one batch off, forever
+            self.observe_step(batch.get("count", 0),
+                              time.perf_counter() - t0, device_path=False)
+            raise
         self.observe_step(batch.get("count", 0), time.perf_counter() - t0)
         return rows
 

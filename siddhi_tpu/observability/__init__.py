@@ -1,0 +1,234 @@
+"""End-to-end observability: pipeline tracing, percentile latency,
+Prometheus exposition, device-path profiling.
+
+PR 1 (flow) and PR 2 (resilience) filled the statistics SPI with gauges
+and counters but left three gaps this package closes:
+
+- **tracing** (``tracing.py``) — ``@app:trace(sample='1/N')`` opens a span
+  chain at ingress and closes stage spans as the event crosses junction →
+  query runtime → window processor → device micro-batch → selector → sink
+  pipeline; exported by ``GET /siddhi-apps/{name}/trace``;
+- **percentile latency** (``histogram.py``) — every ``LatencyTracker`` is
+  now a log-bucketed histogram (p50/p90/p99/p99.9); per-query end-to-end,
+  per-sink publish, and per-device-step latencies record into it;
+- **exposition** (``prometheus.py``) — ``GET /metrics`` and
+  ``GET /siddhi-apps/{name}/metrics`` render every tracker as stable
+  ``siddhi_tpu_*`` families in Prometheus 0.0.4 text format;
+- **device profiling** (``profiler.py`` + the step probe below) —
+  per-kernel compile/step/pad-ratio/flush-cause accounting on every
+  ``@device`` bridge, and ``@app:profile`` brackets steps with
+  ``jax.profiler`` trace annotations.
+
+Apps without ``@app:trace`` / ``@app:profile`` pay one ``is None`` check
+per hot-path event; the step probe and watermark gauges are passive.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from typing import Optional
+
+from ..query_api.annotation import find_annotation
+from .histogram import LogHistogram
+from .profiler import DeviceProfiler, parse_profile_annotation
+from .prometheus import CONTENT_TYPE, render
+from .tracing import PipelineTracer, Span, Trace, parse_trace_annotation
+
+log = logging.getLogger("siddhi_tpu.observability")
+
+__all__ = [
+    "CONTENT_TYPE", "DeviceProfiler", "DeviceStepProbe", "LogHistogram",
+    "ObservabilitySubsystem", "PipelineTracer", "Span", "Trace",
+    "parse_profile_annotation", "parse_trace_annotation", "render",
+]
+
+# every flush site reports one of these causes; registered as counters even
+# when still zero so dashboards see the full breakdown
+FLUSH_CAUSES = ("capacity", "adaptive", "drain", "final")
+
+
+class DeviceStepProbe:
+    """Per-bridge device-path accounting, fed by ``observe_step`` on both
+    the sync flush path and the async driver. ``compile_*`` is a proxy:
+    batch shapes are static, so the first step's wall time is the one that
+    pays jit trace + XLA compile."""
+
+    # sealed groups beyond this are stale (emit sites the probe does not
+    # seal, e.g. shutdown finalize) — close their spans rather than grow
+    MAX_GROUPS = 128
+
+    def __init__(self, query_name: str, capacity: int, latency_tracker,
+                 tracer: Optional[PipelineTracer]):
+        self.query_name = query_name
+        self.capacity = max(1, int(capacity))
+        self.latency_tracker = latency_tracker
+        self.tracer = tracer
+        self.steps = 0
+        self.events = 0
+        self.busy_seconds = 0.0
+        self.compile_count = 0
+        self.compile_seconds = 0.0
+        self.flush_causes: dict[str, int] = {}
+        # (trace, arrival perf_counter_ns) registered at packing time into
+        # the OPEN group; seal() closes the group when its batch is emitted,
+        # so steps pop groups FIFO — matching the FIFO batch queue — and a
+        # step never claims traces packed into a later batch. The engine
+        # thread appends/seals, the device worker pops — deque ops are
+        # GIL-atomic.
+        self.pending: deque = deque()
+        self._groups: deque = deque()
+
+    def seal(self) -> None:
+        """Close the open trace group — call when a batch is emitted (even
+        an untraced one: group order must mirror batch order)."""
+        if self.tracer is None:
+            return
+        group, self.pending = self.pending, deque()
+        self._groups.append(group)
+        while len(self._groups) > self.MAX_GROUPS:
+            for tr, t0 in self._groups.popleft():
+                tr.add_span("device", self.query_name,
+                            time.perf_counter_ns() - t0, 0, outcome="lost")
+
+    def on_step(self, n_events: int, latency_s: float,
+                device_path: bool = True) -> None:
+        if device_path:
+            self.steps += 1
+            self.events += int(n_events)
+            self.busy_seconds += latency_s
+            if self.steps == 1:
+                self.compile_count = 1
+                self.compile_seconds = latency_s
+            self.latency_tracker.record_seconds(latency_s)
+        # a host-fallback step (device_path=False) still consumed its batch:
+        # drain its trace group so spans close and nothing accumulates
+        # during a quarantine
+        if self.tracer is not None:
+            now = time.perf_counter_ns()
+            if self._groups:
+                group = self._groups.popleft()
+            else:
+                # unsealed emit site: drain the open set entry-by-entry —
+                # popleft is GIL-atomic, so a concurrent engine-thread
+                # append is either fully drained here or left for the next
+                # step, never lost (a whole-deque swap on this worker
+                # thread could drop a racing append)
+                group = []
+                while True:
+                    try:
+                        group.append(self.pending.popleft())
+                    except IndexError:
+                        break
+            outcome = "ok" if device_path else "fallback"
+            for tr, t0 in group:
+                tr.add_span("device", self.query_name, now - t0,
+                            batch_size=int(n_events), outcome=outcome)
+
+    @property
+    def pad_ratio(self) -> float:
+        """Padding waste: fraction of stepped batch slots that held no
+        event (0.0 = perfectly full batches)."""
+        if self.steps == 0:
+            return 0.0
+        return 1.0 - self.events / (self.steps * self.capacity)
+
+
+class ObservabilitySubsystem:
+    """One app's observability wiring. Constructed BEFORE the runtime
+    builds (so the tracer exists when queries/sinks compile); ``wire()``
+    runs after the build to register gauges over the finished surfaces."""
+
+    def __init__(self, runtime):
+        self.runtime = runtime
+        anns = runtime.app.annotations
+        from ..core.errors import SiddhiAppCreationError
+        trace_ann = find_annotation(anns, "trace")
+        self.tracer: Optional[PipelineTracer] = None
+        if trace_ann is not None:
+            try:
+                self.tracer = parse_trace_annotation(trace_ann)
+            except ValueError as e:
+                raise SiddhiAppCreationError(str(e)) from None
+        runtime.ctx.tracer = self.tracer
+        profile_ann = find_annotation(anns, "profile")
+        self.profiler: Optional[DeviceProfiler] = None
+        if profile_ann is not None:
+            self.profiler = parse_profile_annotation(profile_ann)
+        self.probes: list[DeviceStepProbe] = []
+
+    # -- post-build wiring -----------------------------------------------------
+    def wire(self) -> None:
+        rt = self.runtime
+        sm = rt.ctx.statistics_manager
+        ctx = rt.ctx
+
+        # stream surfaces: delivered-event counters + event-time watermark
+        # lag (app clock minus the stream's newest delivered timestamp)
+        for sid, j in ctx.stream_junctions.items():
+            sm.gauge_tracker(f"stream.{sid}.events_total",
+                             lambda jj=j: jj.throughput)
+            sm.gauge_tracker(
+                f"stream.{sid}.watermark_lag_seconds",
+                lambda jj=j, c=ctx: 0.0 if jj.last_event_ts is None
+                else max(0.0, (c.current_time() - jj.last_event_ts) / 1e3))
+
+        # source transports: cumulative connect attempts per stream (a
+        # minimal Source subclass may never have called init — skip those)
+        def _src_sid(s):
+            d = getattr(s, "definition", None)
+            return d.id if d is not None else None
+
+        for sid in {_src_sid(s) for s in rt.sources} - {None}:
+            sm.gauge_tracker(
+                f"source.{sid}.connect_attempts_total",
+                lambda s_id=sid, r=rt: sum(
+                    s.connect_attempts for s in r.sources
+                    if _src_sid(s) == s_id))
+
+        # device bridges: step histogram + kernel/compile/pad/flush probes
+        for bridge in rt.device_bridges:
+            probe = DeviceStepProbe(
+                bridge.query_name,
+                getattr(bridge, "batch_capacity", 1),
+                sm.latency_tracker(f"device.{bridge.query_name}.step"),
+                self.tracer)
+            self.probes.append(probe)
+            bridge.probe = probe
+            bridge.runtime.step_observer = probe.on_step
+            bridge.runtime.step_sealer = probe.seal
+            bridge.runtime.flush_causes = probe.flush_causes
+            q = bridge.query_name
+            sm.gauge_tracker(f"device.{q}.steps_total",
+                             lambda p=probe: p.steps)
+            sm.gauge_tracker(f"device.{q}.busy_seconds_total",
+                             lambda p=probe: p.busy_seconds)
+            sm.gauge_tracker(f"device.{q}.compile_count",
+                             lambda p=probe: p.compile_count)
+            sm.gauge_tracker(f"device.{q}.compile_seconds",
+                             lambda p=probe: p.compile_seconds)
+            sm.gauge_tracker(f"device.{q}.pad_ratio",
+                             lambda p=probe: round(p.pad_ratio, 4))
+            for cause in FLUSH_CAUSES:
+                sm.gauge_tracker(
+                    f"device.{q}.flush_{cause}_total",
+                    lambda p=probe, c=cause: p.flush_causes.get(c, 0))
+            if self.profiler is not None:
+                self.profiler.install(bridge)
+
+    # -- lifecycle -------------------------------------------------------------
+    def on_start(self) -> None:
+        if self.profiler is not None:
+            self.profiler.start()
+
+    def on_shutdown(self) -> None:
+        if self.profiler is not None:
+            self.profiler.stop()
+
+    # -- introspection ---------------------------------------------------------
+    def trace_export(self, limit: Optional[int] = None) -> dict:
+        if self.tracer is None:
+            return {"enabled": False, "traces": []}
+        return {"enabled": True, **self.tracer.report(),
+                "traces": self.tracer.export(limit)}

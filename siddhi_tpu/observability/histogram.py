@@ -1,0 +1,132 @@
+"""Log-bucketed latency histogram: percentiles for the statistics SPI.
+
+The reference's Dropwizard ``Timer`` keeps an exponentially-decaying
+reservoir; here a fixed geometric bucket ladder (Hazelcast Jet's
+"99.99th percentile" argument, arXiv:2103.10169: tail latency is the
+product, averages are the wrong statistic for a streaming engine) —
+O(1) lock-held time per sample, mergeable, and directly renderable as a
+Prometheus histogram (the cumulative ``le`` ladder IS the bucket array).
+
+Bucket ``i`` covers ``(min_value * growth**(i-1), min_value * growth**i]``;
+with the default quarter-octave growth (``2**0.25 ≈ 1.19``) any reported
+percentile is within ~19% of the true sample quantile, over a range of
+1µs .. ~1.6h in 128 buckets.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Optional
+
+# quarter-octave ladder: 128 buckets cover 1e-6 s .. ~6000 s
+DEFAULT_MIN = 1e-6
+DEFAULT_GROWTH = 2.0 ** 0.25
+DEFAULT_BUCKETS = 128
+
+
+class LogHistogram:
+    """Thread-safe geometric-bucket histogram over positive float samples
+    (seconds by convention)."""
+
+    def __init__(self, min_value: float = DEFAULT_MIN,
+                 growth: float = DEFAULT_GROWTH,
+                 num_buckets: int = DEFAULT_BUCKETS):
+        if min_value <= 0 or growth <= 1.0 or num_buckets < 2:
+            raise ValueError(
+                f"bad histogram shape (min={min_value}, growth={growth}, "
+                f"buckets={num_buckets})")
+        self.min_value = float(min_value)
+        self.growth = float(growth)
+        self._log_growth = math.log(growth)
+        # counts[i] guards (bounds[i-1], bounds[i]]; counts[-1] is overflow
+        self._bounds = [min_value * growth ** i for i in range(num_buckets)]
+        self._counts = [0] * (num_buckets + 1)
+        self._lock = threading.Lock()
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def _index(self, value: float) -> int:
+        if value <= self.min_value:
+            return 0
+        i = int(math.ceil(math.log(value / self.min_value) / self._log_growth))
+        return min(i, len(self._bounds))       # len(_bounds) == overflow slot
+
+    def record(self, value: float) -> None:
+        v = float(value)
+        if v < 0.0 or v != v:                  # negative / NaN: clamp out
+            v = 0.0
+        i = self._index(v)
+        with self._lock:
+            self._counts[i] += 1
+            self.count += 1
+            self.sum += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+
+    # -- readouts --------------------------------------------------------------
+    def percentile(self, q: float) -> float:
+        """Upper bucket bound at quantile ``q`` in [0, 1] (0.0 when empty).
+        Conservative: the true sample quantile is ≤ the returned value and
+        > returned/growth."""
+        with self._lock:
+            if self.count == 0:
+                return 0.0
+            rank = max(1, math.ceil(q * self.count))
+            cum = 0
+            for i, c in enumerate(self._counts):
+                cum += c
+                if cum >= rank:
+                    if i >= len(self._bounds):      # overflow bucket
+                        return self.max if self.max is not None \
+                            else self._bounds[-1]
+                    return min(self._bounds[i],
+                               self.max if self.max is not None
+                               else self._bounds[i])
+            return self.max or 0.0                  # unreachable
+
+    def export(self) -> tuple[list[tuple[float, int]], int, float]:
+        """One consistent ``(buckets, count, sum)`` read under the lock —
+        exposition must not read buckets and count separately, or a
+        concurrent :meth:`record` renders ``_count`` != the ``+Inf``
+        bucket (a malformed Prometheus histogram)."""
+        with self._lock:
+            last = 0
+            for i, c in enumerate(self._counts[:-1]):
+                if c:
+                    last = i
+            out, cum = [], 0
+            for i in range(last + 1):
+                cum += self._counts[i]
+                out.append((self._bounds[i], cum))
+            return out, self.count, self.sum
+
+    def buckets(self) -> list[tuple[float, int]]:
+        """Cumulative ``(le_bound, count)`` pairs, trimmed past the last
+        occupied bucket (callers append the implicit ``+Inf == count``
+        themselves; for exposition use :meth:`export`)."""
+        return self.export()[0]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            count, total = self.count, self.sum
+            mn, mx = self.min, self.max
+        if count == 0:
+            return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                    "avg": 0.0, "p50": 0.0, "p90": 0.0, "p99": 0.0,
+                    "p999": 0.0}
+        return {
+            "count": count,
+            "sum": total,
+            "min": mn,
+            "max": mx,
+            "avg": total / count,
+            "p50": self.percentile(0.50),
+            "p90": self.percentile(0.90),
+            "p99": self.percentile(0.99),
+            "p999": self.percentile(0.999),
+        }

@@ -1,0 +1,83 @@
+"""Device-path profiling: ``@app:profile`` brackets device steps with
+``jax.profiler`` trace annotations.
+
+    @app:profile                       -- annotate device steps only
+    @app:profile(dir='/tmp/jaxtrace')  -- also capture a full profiler trace
+                                          between start() and shutdown()
+
+Annotations name each micro-batch step ``siddhi:step:<query>`` so a
+captured trace (TensorBoard / Perfetto) attributes device time to the
+query that spent it. Everything degrades to a no-op when ``jax.profiler``
+is unavailable — profiling must never take an app down.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+
+log = logging.getLogger("siddhi_tpu.observability")
+
+
+def _jax_profiler():
+    try:
+        import jax.profiler as jp
+        return jp
+    except Exception:       # noqa: BLE001 — profiling is strictly optional
+        return None
+
+
+class DeviceProfiler:
+    """Opt-in step bracketing + optional trace capture for one app."""
+
+    def __init__(self, trace_dir=None):
+        self.trace_dir = trace_dir
+        self._jp = _jax_profiler()
+        self._tracing = False
+
+    def annotate(self, name: str):
+        """Context manager naming the enclosed device work in a trace."""
+        if self._jp is None:
+            return contextlib.nullcontext()
+        try:
+            return self._jp.TraceAnnotation(name)
+        except Exception:       # noqa: BLE001 — annotation is best-effort
+            return contextlib.nullcontext()
+
+    def install(self, bridge) -> None:
+        """Wrap the bridge runtime's ``process`` so every device step runs
+        under a ``siddhi:step:<query>`` annotation (wraps whatever is
+        installed — including a DeviceGuard's fallback dispatch)."""
+        rt = bridge.runtime
+        inner = rt.process
+        label = f"siddhi:step:{bridge.query_name}"
+        profiler = self
+
+        def annotated_process(batch):
+            with profiler.annotate(label):
+                return inner(batch)
+
+        rt.process = annotated_process
+
+    # -- trace capture ---------------------------------------------------------
+    def start(self) -> None:
+        if self.trace_dir is None or self._jp is None or self._tracing:
+            return
+        try:
+            self._jp.start_trace(self.trace_dir)
+            self._tracing = True
+        except Exception as e:  # noqa: BLE001 — capture is best-effort
+            log.warning("@app:profile: start_trace failed: %s", e)
+
+    def stop(self) -> None:
+        if not self._tracing:
+            return
+        self._tracing = False
+        try:
+            self._jp.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            log.warning("@app:profile: stop_trace failed: %s", e)
+
+
+def parse_profile_annotation(ann) -> DeviceProfiler:
+    return DeviceProfiler(trace_dir=ann.get("dir"))

@@ -1,0 +1,180 @@
+"""Prometheus 0.0.4 text exposition over the statistics SPI.
+
+Maps every tracker registered in a :class:`StatisticsManager` to a stable
+``siddhi_tpu_*`` family with ``app`` / ``stream`` / ``query`` labels. The
+dotted registration keys follow the repo-wide convention
+``<scope>.<entity>[.<ordinal>].<field>`` (``flow.S.wal_bytes``,
+``sink.O.0.sink_retries``, ``device.q1.batch_size``); the scope becomes the
+label name, the field the metric suffix. Latency trackers render as real
+histograms — cumulative ``le`` bucket lines plus ``_sum``/``_count`` — so
+p99 is derivable by any scraper.
+
+``scripts/check_metric_names.py`` lints the rendered output (snake_case,
+prefix, sample uniqueness); keep the mapping here total — an unknown key
+falls back to a sanitized literal rather than being dropped.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, Optional
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_SCOPE_LABEL = {"stream": "stream", "flow": "stream", "device": "query",
+                "query": "query", "partition": "query", "source": "stream"}
+_SAN = re.compile(r"[^a-z0-9_]+")
+
+
+def _sanitize(name: str) -> str:
+    s = _SAN.sub("_", name.lower()).strip("_")
+    return re.sub(r"__+", "_", s) or "unnamed"
+
+
+def _split_key(key: str) -> tuple[str, dict, Optional[str]]:
+    """Registration key → (scope, labels, field)."""
+    parts = key.split(".")
+    scope = parts[0]
+    if scope == "sink" and len(parts) >= 3:
+        field = ".".join(parts[3:]) or None
+        return scope, {"stream": parts[1], "ordinal": parts[2]}, field
+    if scope in _SCOPE_LABEL and len(parts) >= 2:
+        field = ".".join(parts[2:]) or None
+        return scope, {_SCOPE_LABEL[scope]: parts[1]}, field
+    if scope in ("chaos", "app") and len(parts) >= 2:
+        return scope, {}, ".".join(parts[1:])
+    return scope, {}, None
+
+
+def _metric_name(scope: str, field: Optional[str], suffix: str = "") -> str:
+    field = _sanitize(field) if field else ""
+    if scope == "app":                       # app-scoped: field stands alone
+        base = field or "app"
+    elif not field:
+        base = _sanitize(scope)
+    elif field.startswith(scope + "_"):      # 'sink.O.0.sink_retries'
+        base = field
+    else:
+        base = f"{_sanitize(scope)}_{field}"
+    if suffix and not base.endswith(suffix):
+        base += suffix
+    return f"siddhi_tpu_{base}"
+
+
+_LATENCY_FAMILIES = {
+    "query": "siddhi_tpu_query_latency_seconds",
+    "sink": "siddhi_tpu_sink_publish_latency_seconds",
+    "device": "siddhi_tpu_device_step_latency_seconds",
+}
+
+
+def _escape(value) -> str:
+    return str(value).replace("\\", "\\\\").replace("\n", "\\n") \
+                     .replace('"', '\\"')
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return f"{float(v):.10g}"
+
+
+class _Family:
+    def __init__(self, name: str, mtype: str, help_text: str):
+        self.name = name
+        self.type = mtype
+        self.help = help_text
+        self.samples: list[tuple[str, str, str]] = []  # (suffix, labels, val)
+
+    def add(self, labels: dict, value, suffix: str = "") -> None:
+        self.samples.append((suffix, _fmt_labels(labels), _fmt_value(value)))
+
+
+def _collect(sm, families: dict) -> None:
+    """Append one app's samples into the shared family map."""
+    from ..core.metrics import Level
+
+    def fam(name: str, mtype: str, help_text: str) -> _Family:
+        f = families.get(name)
+        if f is None:
+            f = families[name] = _Family(name, mtype, help_text)
+        return f
+
+    app = {"app": sm.app_name}
+    snap = sm.snapshot_trackers()
+
+    for key, tracker in snap["throughput"].items():
+        scope, labels, field = _split_key(key)
+        name = _metric_name(scope, field or "events", "_total")
+        fam(name, "counter", f"events through {scope}").add(
+            {**app, **labels}, tracker.count)
+
+    for key, tracker in snap["counters"].items():
+        scope, labels, field = _split_key(key)
+        name = _metric_name(scope, field, "_total")
+        fam(name, "counter", f"{scope} counter").add(
+            {**app, **labels}, tracker.count)
+
+    for key, tracker in snap["buffered"].items():
+        scope, labels, _ = _split_key(key)
+        fam("siddhi_tpu_buffered_events", "gauge",
+            "queued events/batches awaiting delivery").add(
+            {**app, "kind": scope, **labels}, tracker.buffered)
+
+    for key, tracker in snap["gauges"].items():
+        scope, labels, field = _split_key(key)
+        v = tracker.value
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            continue                          # non-numeric gauge: not a sample
+        if field and field.endswith("_total"):
+            fam(_metric_name(scope, field), "counter",
+                f"{scope} cumulative count").add({**app, **labels}, v)
+        else:
+            fam(_metric_name(scope, field), "gauge", f"{scope} gauge").add(
+                {**app, **labels}, v)
+
+    # the retained-size walker is expensive — scrape it only at DETAIL,
+    # matching the report() gating
+    if sm.level == Level.DETAIL:
+        for key, tracker in snap["memory"].items():
+            fam("siddhi_tpu_memory_bytes", "gauge",
+                "retained bytes per element (device pytrees: HBM bytes)").add(
+                {**app, "element": key}, tracker.bytes)
+
+    for key, tracker in snap["latency"].items():
+        scope, labels, field = _split_key(key)
+        name = _LATENCY_FAMILIES.get(
+            scope, f"siddhi_tpu_{_sanitize(key)}_latency_seconds")
+        f = fam(name, "histogram", f"{scope} latency distribution (seconds)")
+        buckets, count, total = tracker.hist.export()   # one atomic read
+        for le, cum in buckets:
+            f.add({**app, **labels, "le": f"{le:.6g}"}, cum, "_bucket")
+        f.add({**app, **labels, "le": "+Inf"}, count, "_bucket")
+        f.add({**app, **labels}, total, "_sum")
+        f.add({**app, **labels}, count, "_count")
+
+
+def render(managers: Iterable) -> str:
+    """Prometheus text for one or more apps' StatisticsManagers."""
+    families: dict[str, _Family] = {}
+    for sm in managers:
+        _collect(sm, families)
+    lines: list[str] = []
+    for name in sorted(families):
+        f = families[name]
+        lines.append(f"# HELP {f.name} {f.help}")
+        lines.append(f"# TYPE {f.name} {f.type}")
+        for suffix, labels, value in f.samples:
+            lines.append(f"{f.name}{suffix}{labels} {value}")
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,138 @@
+"""Pipeline tracing: sampled per-event span chains across engine stages.
+
+``@app:trace(sample='1/16', ring='2048')`` arms a per-app
+:class:`PipelineTracer`. Every Nth ``InputHandler.send`` (or WAL-admitted
+flow ingress) opens a :class:`Trace`; as the event moves junction → query
+runtime → window/NFA processor → device micro-batch → selector → sink
+pipeline, each stage appends a :class:`Span` with its wall-time, batch
+size, and outcome. Completed chains sit in a bounded ring, exported as
+JSON by ``GET /siddhi-apps/{name}/trace``.
+
+Propagation is thread-local: host-path processing is synchronous under
+the engine lock, so the stack-scoped "active trace" rides the call chain
+for free (TiLT-style per-operator attribution, arXiv:2301.12030, without
+threading a context argument through every processor). The two async
+hops carry it explicitly — ``@async`` junction events are stamped with
+``StreamEvent.trace`` at enqueue and re-activated at worker delivery,
+and device bridges register pending traces at packing time, closing
+their ``device`` span when the micro-batch steps.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+
+class Span:
+    __slots__ = ("stage", "name", "duration_ns", "batch_size", "outcome")
+
+    def __init__(self, stage: str, name: str, duration_ns: int,
+                 batch_size: int = 1, outcome: str = "ok"):
+        self.stage = stage
+        self.name = name
+        self.duration_ns = max(0, int(duration_ns))
+        self.batch_size = batch_size
+        self.outcome = outcome
+
+    def to_dict(self) -> dict:
+        return {"stage": self.stage, "name": self.name,
+                "duration_ms": self.duration_ns / 1e6,
+                "batch_size": self.batch_size, "outcome": self.outcome}
+
+
+class Trace:
+    """One sampled event's journey: an append-only span chain."""
+
+    __slots__ = ("trace_id", "stream", "started_at", "spans")
+
+    def __init__(self, trace_id: int, stream: str):
+        self.trace_id = trace_id
+        self.stream = stream
+        self.started_at = time.time()
+        self.spans: list[Span] = []
+
+    def add_span(self, stage: str, name: str, duration_ns: int,
+                 batch_size: int = 1, outcome: str = "ok") -> None:
+        # list.append is atomic under the GIL; spans may arrive from the
+        # engine thread and a device worker
+        self.spans.append(Span(stage, name, duration_ns, batch_size, outcome))
+
+    def stages(self) -> set:
+        return {s.stage for s in self.spans}
+
+    def to_dict(self) -> dict:
+        return {"trace_id": self.trace_id, "stream": self.stream,
+                "started_at": self.started_at,
+                "spans": [s.to_dict() for s in self.spans]}
+
+
+class PipelineTracer:
+    """Per-app sampler + span ring + thread-local active-trace stack."""
+
+    def __init__(self, sample_n: int = 16, ring_size: int = 2048):
+        if sample_n < 1 or ring_size < 1:
+            raise ValueError(
+                f"bad trace config (sample=1/{sample_n}, ring={ring_size})")
+        self.sample_n = sample_n
+        self.ring: deque = deque(maxlen=ring_size)
+        self._seq = itertools.count()
+        self._ids = itertools.count(1)
+        self._tl = threading.local()
+
+    # -- sampling --------------------------------------------------------------
+    def maybe_trace(self, stream_id: str) -> Optional[Trace]:
+        """Every Nth call opens a trace (and retains it in the ring)."""
+        if next(self._seq) % self.sample_n != 0:
+            return None
+        tr = Trace(next(self._ids), stream_id)
+        self.ring.append(tr)
+        return tr
+
+    # -- thread-local propagation ----------------------------------------------
+    @property
+    def active(self) -> Optional[Trace]:
+        stack = getattr(self._tl, "stack", None)
+        return stack[-1] if stack else None
+
+    def push(self, trace: Trace) -> None:
+        stack = getattr(self._tl, "stack", None)
+        if stack is None:
+            stack = self._tl.stack = []
+        stack.append(trace)
+
+    def pop(self) -> None:
+        stack = getattr(self._tl, "stack", None)
+        if stack:
+            stack.pop()
+
+    # -- export ----------------------------------------------------------------
+    def export(self, limit: Optional[int] = None) -> list[dict]:
+        traces = list(self.ring)
+        if limit is not None:               # newest `limit` (0 → none:
+            traces = traces[-limit:] if limit > 0 else []   # -0 slices ALL)
+        return [t.to_dict() for t in traces]
+
+    def report(self) -> dict:
+        return {"sample": f"1/{self.sample_n}",
+                "ring_capacity": self.ring.maxlen,
+                "retained": len(self.ring)}
+
+
+def parse_trace_annotation(ann) -> PipelineTracer:
+    """``@app:trace(sample='1/16', ring='2048')`` → tracer. ``sample``
+    accepts ``1/N`` or a bare ``N`` (both mean one-in-N)."""
+    raw = (ann.get("sample") or "1/16").strip()
+    if "/" in raw:
+        num, _, den = raw.partition("/")
+        if num.strip() != "1":
+            raise ValueError(
+                f"@app:trace sample must be '1/N', got '{raw}'")
+        n = int(den)
+    else:
+        n = int(raw)
+    ring = int(ann.get("ring") or 2048)
+    return PipelineTracer(sample_n=n, ring_size=ring)

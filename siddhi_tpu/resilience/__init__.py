@@ -117,7 +117,8 @@ class ResilienceSubsystem:
             chaos=self.chaos,
             shutdown_signal=self.shutdown_signal,
             stats=ctx.statistics_manager,
-            listener_fn=lambda: ctx.exception_listener)
+            listener_fn=lambda: ctx.exception_listener,
+            tracer=ctx.tracer)
         self.sinks.append(wrapped)
         return wrapped
 

@@ -138,12 +138,15 @@ class DeviceGuard:
         rt.process = lambda batch: self.step(inner_process, batch)
         # failed/quarantined steps time the HOST replay, not the device —
         # feeding those samples to the adaptive batch controller would tune
-        # it on latencies unrelated to device performance
+        # it on latencies unrelated to device performance. The observability
+        # probe must still see the step (device_path=False) or its pending
+        # trace groups would pile up for the whole quarantine.
         inner_observe = getattr(rt, "observe_step", None)
         if inner_observe is not None:
-            def observe(n_events, latency_s):
-                if not self._last_step_fell_back:
-                    inner_observe(n_events, latency_s)
+            def observe(n_events, latency_s, device_path=True):
+                inner_observe(
+                    n_events, latency_s,
+                    device_path=device_path and not self._last_step_fell_back)
             rt.observe_step = observe
 
     # -- step ----------------------------------------------------------------

@@ -36,8 +36,10 @@ from __future__ import annotations
 import logging
 import random
 import threading
+import time
 from typing import Callable, Optional
 
+from ..core.metrics import CounterTracker, Level
 from .circuit import CircuitBreaker
 from .chaos import ChaosInjector
 
@@ -96,8 +98,8 @@ class ResilientSink:
                  chaos: Optional[ChaosInjector] = None,
                  shutdown_signal: Optional[threading.Event] = None,
                  stats=None,
-                 listener_fn: Optional[Callable[[], object]] = None):
-        from ..core.metrics import CounterTracker
+                 listener_fn: Optional[Callable[[], object]] = None,
+                 tracer=None):
         self._listener_fn = listener_fn or (lambda: None)
         self.inner = inner
         self.stream_id = stream_id
@@ -116,6 +118,10 @@ class ResilientSink:
         make = stats.counter_tracker if stats is not None else CounterTracker
         self._retry_counter = make(f"{base}.sink_retries")
         self._dropped_counter = make(f"{base}.sink_dropped")
+        self._stats = stats
+        self._latency = stats.latency_tracker(base) \
+            if stats is not None else None
+        self.tracer = tracer            # PipelineTracer when @app:trace
         self.published = 0
         self.stored = 0
         self.routed_to_fault = 0
@@ -143,6 +149,25 @@ class ResilientSink:
         """Publish through the policy pipeline. Returns the outcome —
         'published' | 'stored' | 'fault' | 'dropped' — so error-store replay
         can judge THIS call without racing other threads' counters."""
+        tr = self.tracer.active if self.tracer is not None else None
+        track = self._latency is not None and self._stats.level is not Level.OFF
+        if tr is None and not track:
+            return self._publish(event)
+        t0 = time.perf_counter_ns()
+        outcome = "error"
+        try:
+            outcome = self._publish(event)
+            return outcome
+        finally:
+            dt = time.perf_counter_ns() - t0
+            if track:
+                # publish latency includes retries/backoff — that IS the
+                # egress cost the pipeline imposed on this event
+                self._latency.record_seconds(dt / 1e9)
+            if tr is not None:
+                tr.add_span("sink", self._site, dt, 1, outcome)
+
+    def _publish(self, event) -> str:
         if self.policy == OnErrorPolicy.WAIT:
             # WAIT means wait: an open circuit is slept out inside the loop,
             # never escalated — the policy's contract is lossless egress

@@ -23,6 +23,11 @@ on request. Endpoints (stdlib http.server, threaded; no framework deps):
                                              "ids": [lo, hi]}
     GET    /siddhi-apps/{name}/resilience    sink circuit/retry stats, device
                                              quarantine state, chaos counters
+    GET    /siddhi-apps/{name}/metrics       Prometheus 0.0.4 text exposition
+                                             of the app's statistics
+    GET    /metrics                          same, across every deployed app
+    GET    /siddhi-apps/{name}/trace         sampled pipeline span chains
+                                             (@app:trace); ?limit=N caps it
     DELETE /siddhi-apps/{name}               undeploy (shutdown + forget)
     POST   /siddhi-apps/{name}/streams/{sid} body = JSON {"data": [...],
                                              "timestamp": ms?} → send event
@@ -58,9 +63,14 @@ class SiddhiService:
                 pass
 
             def _reply(self, code: int, payload: dict) -> None:
-                body = json.dumps(payload).encode()
+                self._reply_text(code, json.dumps(payload),
+                                 "application/json")
+
+            def _reply_text(self, code: int, text: str,
+                            content_type: str) -> None:
+                body = text.encode()
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -98,6 +108,34 @@ class SiddhiService:
                 if parts == ["siddhi-apps"]:
                     self._reply(200, {"status": "OK",
                                       "apps": sorted(service.runtimes)})
+                elif parts == ["metrics"]:
+                    from .observability import CONTENT_TYPE
+                    code, text = service.metrics_text(None)
+                    self._reply_text(code, text, CONTENT_TYPE)
+                elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                        and parts[2] == "metrics":
+                    from .observability import CONTENT_TYPE
+                    code, text = service.metrics_text(parts[1])
+                    if code == 200:
+                        self._reply_text(code, text, CONTENT_TYPE)
+                    else:
+                        self._reply(code, {"status": "ERROR",
+                                           "message": text})
+                elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                        and parts[2] == "trace":
+                    limit = query.get("limit")
+                    try:
+                        limit = int(limit) if limit else None
+                        if limit is not None and limit < 0:
+                            raise ValueError(limit)
+                    except ValueError:
+                        self._reply(400, {
+                            "status": "ERROR",
+                            "message": "limit must be a non-negative "
+                                       "integer"})
+                        return
+                    code, payload = service.trace_export(parts[1], limit)
+                    self._reply(code, payload)
                 elif len(parts) == 3 and parts[0] == "siddhi-apps" \
                         and parts[2] == "status":
                     code, payload = service.status(parts[1])
@@ -265,6 +303,29 @@ class SiddhiService:
         except Exception as e:  # noqa: BLE001 — surfaced to the caller
             return 500, {"status": "ERROR", "message": str(e)}
         return 200, {"status": "OK", **report}
+
+    def metrics_text(self, name: Optional[str]) -> tuple[int, str]:
+        """Prometheus text exposition: one app, or every deployed app when
+        ``name`` is None (the all-apps scrape endpoint)."""
+        from .observability import render
+        if name is None:
+            managers = [rt.ctx.statistics_manager
+                        for _, rt in sorted(self.runtimes.items())]
+            return 200, render(managers)
+        rt = self.runtimes.get(name)
+        if rt is None:
+            return 404, f"no app '{name}' deployed"
+        return 200, render([rt.ctx.statistics_manager])
+
+    def trace_export(self, name: str,
+                     limit: Optional[int] = None) -> tuple[int, dict]:
+        """Sampled span chains from the app's @app:trace ring."""
+        rt = self.runtimes.get(name)
+        if rt is None:
+            return 404, {"status": "ERROR",
+                         "message": f"no app '{name}' deployed"}
+        return 200, {"status": "OK",
+                     **rt.observability.trace_export(limit)}
 
     def resilience_stats(self, name: str) -> tuple[int, dict]:
         """Sink circuits/retries, device quarantine, chaos counters."""

@@ -1826,6 +1826,7 @@ class DeviceNFARuntime(AdaptiveFlushMixin):
     def flush(self, decode: bool = True):
         if len(self.builder) == 0:
             return None
+        self._seal()            # trace group closes exactly at the emit
         batch = self.builder.emit()
         if self.driver is not None:
             self.driver.submit(batch)
