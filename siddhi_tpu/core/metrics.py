@@ -308,6 +308,20 @@ class StatisticsManager:
         with self._lock:
             return self.counters.setdefault(name, CounterTracker(name))
 
+    def unregister(self, prefix: str) -> int:
+        """Remove every tracker whose registration key starts with
+        ``prefix`` (a component tearing down — e.g. a DCN worker closing or
+        a released lane group — must not leave dead gauges behind to read 0
+        forever); returns the number removed."""
+        removed = 0
+        with self._lock:
+            for d in (self.throughput, self.latency, self.buffered,
+                      self.memory, self.gauges, self.counters):
+                for k in [k for k in d if k.startswith(prefix)]:
+                    del d[k]
+                    removed += 1
+        return removed
+
     def snapshot_trackers(self) -> dict:
         """Point-in-time shallow copies of every tracker dict — iterate
         these, not the live dicts, so deploy-time registration can't mutate

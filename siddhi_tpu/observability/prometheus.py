@@ -22,7 +22,8 @@ from typing import Iterable, Optional
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 _SCOPE_LABEL = {"stream": "stream", "flow": "stream", "device": "query",
-                "query": "query", "partition": "query", "source": "stream"}
+                "query": "query", "partition": "query", "source": "stream",
+                "dcn": "peer"}
 _SAN = re.compile(r"[^a-z0-9_]+")
 
 

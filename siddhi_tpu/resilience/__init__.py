@@ -34,6 +34,13 @@ import threading
 from ..query_api.annotation import find_annotation
 from .chaos import ChaosFault, ChaosInjector, parse_chaos_annotation
 from .circuit import CircuitBreaker, CircuitState
+from .dcn_guard import (
+    DCNGuard,
+    DCNGuardConfig,
+    LaneGroupSnapshotStore,
+    PeerHealth,
+    SpillQueue,
+)
 from .device_guard import DeviceGuard
 from .sink_pipeline import OnErrorPolicy, ResilientSink, parse_sink_policy
 
@@ -41,8 +48,9 @@ log = logging.getLogger("siddhi_tpu.resilience")
 
 __all__ = [
     "ChaosFault", "ChaosInjector", "CircuitBreaker", "CircuitState",
-    "DeviceGuard", "OnErrorPolicy", "ResilienceSubsystem", "ResilientSink",
-    "parse_chaos_annotation", "parse_sink_policy",
+    "DCNGuard", "DCNGuardConfig", "DeviceGuard", "LaneGroupSnapshotStore",
+    "OnErrorPolicy", "PeerHealth", "ResilienceSubsystem", "ResilientSink",
+    "SpillQueue", "parse_chaos_annotation", "parse_sink_policy",
 ]
 
 

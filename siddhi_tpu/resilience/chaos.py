@@ -13,7 +13,12 @@ failure-prone surfaces:
   ``connect.fail.p`` fails source ``connect()`` calls to exercise
   ``connect_with_retry``;
 - **device steps** — compiled micro-batch steps raise :class:`ChaosFault`,
-  driving the device guard's host fallback and quarantine.
+  driving the device guard's host fallback and quarantine;
+- **DCN frames** — ``dcn.drop.p`` drops a forwarded frame's ack on the
+  sender side (the frame may have applied — exercising retry + receiver
+  dedup), ``dcn.kill.p`` kills the serving connection before the frame
+  applies (the peer looks crashed mid-frame), and ``dcn.delay.ms`` delays
+  the receiver's ack (exercising the ack-recv deadline).
 
 Determinism: each injection site owns a ``random.Random`` seeded from
 ``(seed, site)`` — the fault pattern for a site depends only on its own call
@@ -37,16 +42,22 @@ class ChaosFault(Exception):
 class ChaosInjector:
     def __init__(self, seed: int = 0, source_fail_p: float = 0.0,
                  sink_fail_p: float = 0.0, device_fail_p: float = 0.0,
-                 connect_fail_p: float = 0.0, latency_ms: float = 0.0):
+                 connect_fail_p: float = 0.0, latency_ms: float = 0.0,
+                 dcn_drop_p: float = 0.0, dcn_kill_p: float = 0.0,
+                 dcn_delay_ms: float = 0.0):
         self.seed = int(seed)
         self.source_fail_p = float(source_fail_p)
         self.sink_fail_p = float(sink_fail_p)
         self.device_fail_p = float(device_fail_p)
         self.connect_fail_p = float(connect_fail_p)
         self.latency_ms = float(latency_ms)
+        self.dcn_drop_p = float(dcn_drop_p)
+        self.dcn_kill_p = float(dcn_kill_p)
+        self.dcn_delay_ms = float(dcn_delay_ms)
         self._rngs: dict[str, random.Random] = {}
         self.counters = {"source_faults": 0, "sink_faults": 0,
-                         "device_faults": 0, "connect_faults": 0}
+                         "device_faults": 0, "connect_faults": 0,
+                         "dcn_drops": 0, "dcn_kills": 0}
 
     def _rng(self, site: str) -> random.Random:
         rng = self._rngs.get(site)
@@ -94,12 +105,35 @@ class ChaosInjector:
             raise ConnectionUnavailableError(
                 f"chaos: connect fault injected at {site}")
 
+    # -- DCN fault sites (drop frame / kill peer / delay ack) ----------------
+    def on_dcn_send(self, site: str) -> None:
+        """Sender side, AFTER the frame hit the wire: raising here models a
+        lost ack — the frame may have applied, so the retry must dedup."""
+        if self._roll(site, self.dcn_drop_p):
+            self.counters["dcn_drops"] += 1
+            raise ChaosFault(f"chaos: dcn ack dropped at {site}")
+
+    def on_dcn_serve(self, site: str) -> None:
+        """Receiver side, BEFORE the frame applies: raising here kills the
+        serving connection mid-frame (peer looks crashed; sender retries)."""
+        if self._roll(site, self.dcn_kill_p):
+            self.counters["dcn_kills"] += 1
+            raise ChaosFault(f"chaos: dcn peer killed at {site}")
+
+    def on_dcn_ack(self, site: str) -> None:
+        """Receiver side, before the ack goes out: bounded random delay
+        exercising the sender's ack-recv deadline."""
+        if self.dcn_delay_ms > 0:
+            time.sleep(self.dcn_delay_ms / 1000.0 * self._rng(site).random())
+
     def report(self) -> dict:
         return {
             "seed": self.seed,
             "probabilities": {
                 "source": self.source_fail_p, "sink": self.sink_fail_p,
                 "device": self.device_fail_p, "connect": self.connect_fail_p,
+                "dcn_drop": self.dcn_drop_p, "dcn_kill": self.dcn_kill_p,
+                "dcn_delay_ms": self.dcn_delay_ms,
             },
             "counters": dict(self.counters),
         }
@@ -116,4 +150,7 @@ def parse_chaos_annotation(ann) -> Optional[ChaosInjector]:
         device_fail_p=float(ann.get("device.fail.p") or 0.0),
         connect_fail_p=float(ann.get("connect.fail.p") or 0.0),
         latency_ms=float(ann.get("latency.ms") or 0.0),
+        dcn_drop_p=float(ann.get("dcn.drop.p") or 0.0),
+        dcn_kill_p=float(ann.get("dcn.kill.p") or 0.0),
+        dcn_delay_ms=float(ann.get("dcn.delay.ms") or 0.0),
     )

@@ -1,4 +1,5 @@
-"""Circuit breaker shared by sink egress and the device-path quarantine.
+"""Circuit breaker shared by sink egress, the device-path quarantine, and
+the DCN peer-health detector.
 
 Classic three-state machine (Nygard; the reference engine's ``Sink.java``
 connect/retry loop plays the same role implicitly): CLOSED counts consecutive
@@ -41,6 +42,25 @@ class CircuitBreaker:
     @property
     def state_code(self) -> int:
         return CircuitState.CODES[self.state]
+
+    @property
+    def suspect(self) -> bool:
+        """CLOSED but accumulating failures — the DCN peer detector's
+        *suspect* phase between healthy and down."""
+        with self._lock:
+            return (self.state == CircuitState.CLOSED
+                    and self.consecutive_failures > 0)
+
+    def trip(self) -> None:
+        """Force OPEN immediately (an unambiguous hard failure — e.g. a
+        peer's process is known dead — should not wait out the threshold)."""
+        with self._lock:
+            if self.state != CircuitState.OPEN:
+                self.open_count += 1
+            self.state = CircuitState.OPEN
+            self.consecutive_failures = max(self.consecutive_failures,
+                                            self.failure_threshold)
+            self.opened_at = self.clock()
 
     def allow(self) -> bool:
         """True when an attempt may proceed. An OPEN circuit past its

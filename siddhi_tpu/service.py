@@ -23,6 +23,11 @@ on request. Endpoints (stdlib http.server, threaded; no framework deps):
                                              "ids": [lo, hi]}
     GET    /siddhi-apps/{name}/resilience    sink circuit/retry stats, device
                                              quarantine state, chaos counters
+    GET    /siddhi-apps/{name}/dcn           multi-host shard state: peer
+                                             health, retry/spill counters,
+                                             lane-group ownership, failover
+                                             counts (apps with an attached
+                                             ``runtime.dcn_worker``)
     GET    /siddhi-apps/{name}/metrics       Prometheus 0.0.4 text exposition
                                              of the app's statistics
     GET    /metrics                          same, across every deployed app
@@ -152,6 +157,10 @@ class SiddhiService:
                 elif len(parts) == 3 and parts[0] == "siddhi-apps" \
                         and parts[2] == "resilience":
                     code, payload = service.resilience_stats(parts[1])
+                    self._reply(code, payload)
+                elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                        and parts[2] == "dcn":
+                    code, payload = service.dcn_stats(parts[1])
                     self._reply(code, payload)
                 else:
                     self._reply(404, {"status": "ERROR",
@@ -338,6 +347,20 @@ class SiddhiService:
         payload.update(resilience.report() if resilience is not None
                        else {"sinks": [], "device": []})
         return 200, payload
+
+    def dcn_stats(self, name: str) -> tuple[int, dict]:
+        """Multi-host shard state (peer health / spill / failover). A
+        sharded deployment attaches its :class:`~siddhi_tpu.tpu.dcn.
+        DCNWorker` as ``runtime.dcn_worker``; single-host apps report
+        ``enabled: false``."""
+        rt = self.runtimes.get(name)
+        if rt is None:
+            return 404, {"status": "ERROR",
+                         "message": f"no app '{name}' deployed"}
+        worker = getattr(rt, "dcn_worker", None)
+        if worker is None:
+            return 200, {"status": "OK", "enabled": False}
+        return 200, {"status": "OK", "enabled": True, **worker.report()}
 
     def recover(self, name: str, body: str = "") -> tuple[int, dict]:
         """Restore the latest (or a named) persisted revision and replay the

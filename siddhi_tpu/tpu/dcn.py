@@ -5,13 +5,14 @@ sinks (``util/transport/MultiClientDistributedSink.java``) — to "DCN for
 multi-host ingest/egress; per-shard output streams". The TPU-native design:
 
 - **Sharding model**: the partition-lane axis is the unit of placement. A
-  GLOBAL lane space of ``num_lanes`` is split into contiguous groups, one per
-  host; within a host, lanes spread over the local chips via the existing
-  ``shard_map`` mesh (``tpu/partition.py``). Keys hash to global lanes with
+  GLOBAL lane space of ``num_lanes`` is split into contiguous groups; group
+  ``g``'s HOME host is host ``g``, but ownership is a live mapping
+  (:attr:`LaneTopology.owner`) so a survivor can adopt a dead host's group
+  (failover) and hand it back on recovery. Keys hash to global lanes with
   the same crc32 as single-host mode, so a cluster resize is a lane-group
   remap, not a rehash.
-- **Ingest (DCN)**: every host accepts events; rows whose lane belongs to a
-  peer are forwarded over the data-center network (sockets here; the
+- **Ingest (DCN)**: every host accepts events; rows whose lane group belongs
+  to a peer are forwarded over the data-center network (sockets here; the
   same framing applies to any transport). Forwarding is batched — rows are
   framed in bulk wire batches, never per-event — because cross-host hops are
   the latency budget's biggest item.
@@ -22,6 +23,26 @@ multi-host ingest/egress; per-shard output streams". The TPU-native design:
 - **In-pod vs cross-pod**: within a host, collectives ride ICI via the jax
   mesh (no host involvement). DCN carries only (a) mis-routed ingest rows and
   (b) egress rows — NFA state never crosses hosts (keys are lane-affine).
+
+**Fault tolerance** (the DISTRIBUTED.md "Failure / elasticity" row; policy
+lives in :mod:`siddhi_tpu.resilience.dcn_guard`):
+
+- every DCN socket carries a deadline (connect, send, ack-recv, idle serve
+  loop) — a wedged peer becomes a *detected* failure, never a hang;
+- ``K_ROWS`` frames carry ``(sender, group, epoch, seq)``; the receiver
+  dedups per (group, sender) so a retried frame after a lost ack stays
+  exactly-once, across sender restarts (the epoch) and across failover (the
+  dedup table travels with the group's snapshot);
+- ``_forward`` retries with capped backoff, dropping the cached peer socket
+  on any error so the next attempt reconnects; exhausted retries spill the
+  frame into the group's bounded :class:`~siddhi_tpu.resilience.dcn_guard.
+  SpillQueue` for in-order replay on recovery;
+- heartbeats (``K_PING``/``K_PONG``) drive the per-peer
+  healthy→suspect→down→probing detector; past the takeover deadline a
+  designated survivor adopts the dead host's lane group from the latest
+  snapshot revision (global-lane-keyed), re-points :class:`LaneTopology`,
+  announces ``K_OWNER``, and replays the spill; a returning host re-joins
+  via ``K_ADOPT`` — the same handoff in reverse.
 
 The wire format is the binary SoA row frame below — the same
 structure-of-arrays layout the C++ ingress packer stages lane buffers in
@@ -35,18 +56,45 @@ zero-parse on the numeric columns.
 
 from __future__ import annotations
 
+import logging
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Optional
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
+from ..resilience.chaos import ChaosFault
+from ..resilience.dcn_guard import (
+    PEER_DOWN,
+    DCNGuard,
+    DCNGuardConfig,
+    LaneGroupSnapshotStore,
+)
 from .partition import PartitionedNFARuntime, _hash_key
+
+log = logging.getLogger("siddhi_tpu.dcn")
 
 # frame: 1-byte kind + u32 payload length + payload
 _HDR = struct.Struct(">BI")
 K_ROWS, K_ACK, K_FLUSH, K_FLUSHED = 1, 2, 3, 4
+K_PING, K_PONG, K_OWNER, K_ADOPT = 5, 6, 7, 8
+
+# K_ROWS payload prefix: sender host, lane group, sender epoch (incarnation),
+# per-(sender→group) sequence number. Epoch lets a restarted sender's fresh
+# seq space supersede its dead incarnation's; seq drives receiver dedup.
+_ROWS_HDR = struct.Struct(">BBIQ")
+# K_OWNER / K_ADOPT payloads
+_OWNER_FMT = struct.Struct(">BB")        # (group, owner host)
+_ADOPT_FMT = struct.Struct(">B")         # (group,)
+
+# every DCN call path carries a deadline (scripts/check_socket_timeouts.py
+# lints that no blocking socket op in siddhi_tpu/ runs without one)
+CONNECT_TIMEOUT_S = 5.0
+IO_TIMEOUT_S = 10.0
 
 # column type chars (shared vocabulary with native/ingress.cpp's schema
 # string): i=i32 l=i64 f=f32 d=f64 b=bool s=string
@@ -57,21 +105,42 @@ def send_msg(sock: socket.socket, kind: int, payload: bytes = b"") -> None:
     sock.sendall(_HDR.pack(kind, len(payload)) + payload)
 
 
-def recv_msg(sock: socket.socket):
-    """Returns (kind, payload) or None on a closed connection."""
+def recv_msg(sock: socket.socket, timeout: float = IO_TIMEOUT_S):
+    """Returns (kind, payload), or None on a cleanly closed connection.
+
+    Always arms a deadline: ``socket.timeout`` raised at a frame boundary
+    means *idle* (callers may poll); a timeout or close mid-frame raises
+    ``ConnectionError`` — the stream is desynced and must be dropped."""
+    sock.settimeout(timeout)
     hdr = _recv_exact(sock, _HDR.size)
     if hdr is None:
         return None
     kind, n = _HDR.unpack(hdr)
     payload = _recv_exact(sock, n) if n else b""
-    return None if payload is None else (kind, payload)
+    if payload is None:
+        raise ConnectionError("connection closed mid-frame")
+    return (kind, payload)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    if sock.gettimeout() is None:
+        # every blocking recv in this package must carry a deadline
+        # (scripts/check_socket_timeouts.py pins the same invariant in CI)
+        raise ValueError("blocking recv on a socket without a timeout")
     buf = b""
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            if buf:
+                # a half-read frame can never resync — surface a broken
+                # connection, not an idle timeout
+                raise ConnectionError(
+                    "connection timed out mid-frame") from None
+            raise
         if not chunk:
+            if buf:
+                raise ConnectionError("connection closed mid-frame")
             return None
         buf += chunk
     return buf
@@ -142,53 +211,114 @@ def unpack_rows(payload: bytes) -> tuple[list, list]:
 
 
 class LaneTopology:
-    """Global lane space split into contiguous per-host groups."""
+    """Global lane space split into contiguous per-host groups.
 
-    def __init__(self, num_lanes: int, num_hosts: int):
+    Group ``g``'s HOME host is host ``g`` (the identity the snapshot store
+    and dedup tables key on); :attr:`owner` is the LIVE assignment, re-pointed
+    by failover (:meth:`reassign`). ``local_lane`` stays a plain modulo —
+    the contiguous-regroup property that makes any host able to restore any
+    group's snapshot."""
+
+    def __init__(self, num_lanes: int, num_hosts: int,
+                 owner: Optional[dict] = None):
         if num_lanes % num_hosts:
             raise ValueError("num_lanes must divide evenly across hosts")
+        if not 1 <= num_hosts <= 255:
+            # host/group indices travel as one wire byte (_ROWS_HDR)
+            raise ValueError("num_hosts must be in [1, 255]")
         self.num_lanes = num_lanes
         self.num_hosts = num_hosts
         self.lanes_per_host = num_lanes // num_hosts
+        self.owner = (dict(owner) if owner is not None
+                      else {g: g for g in range(num_hosts)})
 
     def lane_of(self, key) -> int:
         return _hash_key(key) % self.num_lanes
 
+    def group_of(self, global_lane: int) -> int:
+        return global_lane // self.lanes_per_host
+
     def host_of(self, key) -> int:
-        return self.lane_of(key) // self.lanes_per_host
+        return self.owner[self.group_of(self.lane_of(key))]
 
     def local_lane(self, global_lane: int) -> int:
         return global_lane % self.lanes_per_host
 
+    def lanes_of_group(self, group: int) -> range:
+        return range(group * self.lanes_per_host,
+                     (group + 1) * self.lanes_per_host)
+
+    def groups_owned_by(self, host: int) -> list:
+        return sorted(g for g, o in self.owner.items() if o == host)
+
+    def reassign(self, group: int, host: int) -> None:
+        if group not in self.owner or not 0 <= host < self.num_hosts:
+            raise ValueError(f"bad lane-group reassign {group}->{host}")
+        self.owner[group] = host
+
 
 class DCNWorker:
-    """One host's engine shard: owns a lane group, serves a DCN ingest port,
-    forwards mis-routed rows to peers, emits its own lanes' matches.
+    """One host's engine shard: owns lane group(s), serves a DCN ingest
+    port, forwards mis-routed rows to peers, emits its own lanes' matches.
 
     ``peers``: host index → (addr, port) for every OTHER worker. The worker
     both listens (for forwarded rows) and dials out (to forward). Rows
-    forwarded to a peer are batched per ``ingest`` call — the DCN hop is
-    framed in bulk, never per event.
+    forwarded to a peer are batched per ``ingest`` call per lane group —
+    the DCN hop is framed in bulk, never per event.
+
+    Fault tolerance rides on the attached :class:`DCNGuard` (heartbeats,
+    retry budget, spill policy, takeover deadline — see
+    :class:`~siddhi_tpu.resilience.dcn_guard.DCNGuardConfig`). ``epoch`` is
+    this worker's incarnation number: a restarted host passes a HIGHER
+    epoch so its fresh sequence space supersedes the dead one's in peer
+    dedup tables. With a ``snapshot_store``, ``restore=True`` reloads the
+    latest revision of every owned group at startup, and
+    ``snapshot_every_frames=N`` persists owned groups after every N applied
+    peer frames (before the ack, so an acked frame is durable at N=1).
     """
 
     def __init__(self, host_index: int, topology: LaneTopology,
-                 app_text: str, key_attr: str, port: int,
+                 app_text, key_attr: str, port: int,
                  peers: dict, stream_id: str = "S",
                  slot_capacity: int = 32, lane_batch: int = 256,
-                 on_rows: Optional[Callable] = None):
+                 on_rows: Optional[Callable] = None, *,
+                 epoch: Optional[int] = None,
+                 chaos=None,
+                 guard_config: Optional[DCNGuardConfig] = None,
+                 snapshot_store: Optional[LaneGroupSnapshotStore] = None,
+                 restore: bool = False,
+                 snapshot_every_frames: Optional[int] = None,
+                 connect_timeout_s: float = CONNECT_TIMEOUT_S,
+                 io_timeout_s: float = IO_TIMEOUT_S,
+                 clock=time.monotonic):
         self.host_index = host_index
         self.topo = topology
         self.key_attr = key_attr
         self.stream_id = stream_id
         self.peers = dict(peers)
         self.on_rows = on_rows
-        self.rt = PartitionedNFARuntime(
-            app_text, num_partitions=topology.lanes_per_host,
-            key_attr=key_attr, slot_capacity=slot_capacity,
-            lane_batch=lane_batch, mesh=None)
-        if on_rows is not None:
-            self.rt.callback = on_rows
-        self._key_pos = self.rt.stream_defs[stream_id].attribute_position(
+        # incarnation number: a restarted sender MUST come back with a
+        # higher epoch or peers' dedup tables (which persist in snapshots)
+        # silently discard its fresh seq space as retries. With a store the
+        # epoch derives automatically; without one, pass it explicitly on
+        # restart.
+        if epoch is None:
+            epoch = (snapshot_store.next_epoch(host_index)
+                     if snapshot_store is not None else 0)
+        self.epoch = int(epoch)
+        self.chaos = chaos
+        self.snapshot_store = snapshot_store
+        self.snapshot_every_frames = snapshot_every_frames
+        self.connect_timeout_s = connect_timeout_s
+        self.io_timeout_s = io_timeout_s
+
+        from ..compiler import parse as _parse
+        self._app = _parse(app_text) if isinstance(app_text, str) \
+            else app_text
+        self.slot_capacity = slot_capacity
+        self.lane_batch = lane_batch
+        self.stream_defs = dict(self._app.stream_definitions)
+        self._key_pos = self.stream_defs[stream_id].attribute_position(
             key_attr)
         from ..query_api.definition import DataType
         chars = {DataType.STRING: "s", DataType.INT: "i",
@@ -196,114 +326,781 @@ class DCNWorker:
                  DataType.DOUBLE: "d", DataType.BOOL: "b"}
         self._types = "".join(
             chars[a.type]
-            for a in self.rt.stream_defs[stream_id].attributes)
+            for a in self.stream_defs[stream_id].attributes)
+
         # one lock serializes every engine mutation: local ingest, rows
-        # frames arriving on concurrent peer connections, and the flush
-        # barrier (review finding: unsynchronized builder appends corrupt
-        # batches)
+        # frames arriving on concurrent peer connections, the flush barrier,
+        # ownership changes, dedup marks, and snapshot export
         self._engine_lock = threading.Lock()
-        self.forwarded = 0            # rows shipped to peers over DCN
+        # per-group send locks keep the (sender→group) seq stream ordered;
+        # per-host socket locks keep request/reply exchanges on a shared
+        # data socket from interleaving. Lock order: group → host; the
+        # engine lock is never held across either.
+        self._group_locks = {g: threading.Lock()
+                             for g in range(topology.num_hosts)}
+        self._sock_locks = {h: threading.Lock()
+                            for h in range(topology.num_hosts)}
+        self._hb_locks = {h: threading.Lock()
+                          for h in range(topology.num_hosts)}
+
+        # engine shards: one PartitionedNFARuntime per OWNED lane group
+        # (normally just the home group; failover adds adopted ones)
+        self._shards: dict = {}
+        for g in topology.groups_owned_by(host_index):
+            self._shards[g] = self._build_shard()
+        self.rt = self._shards.get(host_index)   # home shard, if owned
+
+        self.forwarded = 0            # rows ACKED by (or re-owned from) peers
         self.received = 0             # rows accepted from peers
+        self.dup_frames = 0           # retried frames deduped by seq
+        self.frame_errors = 0         # serve-side engine failures (no ack)
+        self.takeovers = 0            # lane groups adopted from dead peers
+        self.rejoins = 0              # lane groups handed back on recovery
+        self.snapshots = 0            # snapshot() completions
+        self._frames_applied: dict = {}   # group → applied frame count
+        self._next_seq: dict = {}     # group → last assigned seq
+        self._dedup: dict = {}        # group → {sender: (epoch, seq)}
         self._peer_socks: dict = {}
+        self._hb_socks: dict = {}
+        self._ever_connected: set = set()
+        self._sm = None               # StatisticsManager, when registered
+
+        self.guard = DCNGuard(self, guard_config, clock=clock)
+
+        if restore and snapshot_store is not None:
+            with self._engine_lock:
+                for g, shard in self._shards.items():
+                    snap = snapshot_store.latest(g)
+                    if snap is not None:
+                        self._restore_shard_state(g, shard, snap)
+                        self._merge_dedup_locked(g, snap)
+
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind(("127.0.0.1", port))
         self._srv.listen(8)
+        self._srv.settimeout(0.5)     # accept() wakes to observe shutdown
         self.port = self._srv.getsockname()[1]
         self._stop = threading.Event()
+        self._conns: set = set()
+        self._serve_threads: list = []
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True)
         self._accept_thread.start()
+        # LAST: the heartbeat thread must only observe a fully built worker
+        self.guard.start_if_configured()
+
+    def _build_shard(self) -> PartitionedNFARuntime:
+        rt = PartitionedNFARuntime(
+            self._app, num_partitions=self.topo.lanes_per_host,
+            key_attr=self.key_attr, slot_capacity=self.slot_capacity,
+            lane_batch=self.lane_batch, mesh=None)
+        if self.on_rows is not None:
+            rt.callback = self.on_rows
+        return rt
 
     # -- local + DCN ingest ---------------------------------------------------
     def ingest(self, rows: list, timestamps: list) -> None:
-        """Accepts arbitrary rows; applies local ones, forwards the rest in
-        ONE frame per destination host (acked — see ``_forward``)."""
+        """Accepts arbitrary rows; applies locally-owned ones, forwards the
+        rest in ONE frame per destination lane group (acked — see
+        ``_forward``; peer-down frames spill for in-order replay)."""
         key_pos = self._key_pos
-        by_peer: dict = {}
+        by_group: dict = {}
+        # a locally-owned group with a spill backlog (takeover window) must
+        # NOT apply fresh rows directly — older spilled rows would be
+        # overtaken. Those rows take the forward path, which drains the
+        # backlog in order before applying.
+        backlogged = set(self.guard.backlogged_groups())
         with self._engine_lock:
             for row, ts in zip(rows, timestamps):
-                h = self.topo.host_of(row[key_pos])
-                if h == self.host_index:
-                    self._apply(row, ts)
+                lane = self.topo.lane_of(row[key_pos])
+                g = self.topo.group_of(lane)
+                if g in self._shards and g not in backlogged:
+                    self._apply_locked(g, lane, row, ts)
                 else:
-                    r, t = by_peer.setdefault(h, ([], []))
+                    r, t = by_group.setdefault(g, ([], []))
                     r.append(row)
                     t.append(ts)
-        for h, (prows, pts) in by_peer.items():
-            self._forward(h, prows, pts)
-            self.forwarded += len(prows)
+        for g, (prows, pts) in by_group.items():
+            # framing errors (malformed row data) raise to the caller,
+            # exactly like a malformed row on the local-apply path — only
+            # POST-framing failures are swallowed, because by then the
+            # frame is guaranteed parked in the spill queue
+            body = pack_rows(self._types, prows, pts)
+            try:
+                acked = self._forward(g, body, len(prows))
+            except Exception:   # noqa: BLE001 — logged; the frame is
+                # already parked in the spill queue by _forward, and one
+                # group's failure must not drop the REMAINING groups' rows
+                log.exception("host %d: forward to group %d failed",
+                              self.host_index, g)
+                continue
+            if acked:
+                # count under the lock, and only rows actually acked —
+                # spilled/failed frames are counted by the spill queue
+                with self._engine_lock:
+                    self.forwarded += acked
 
-    def _apply(self, row: list, ts: int) -> None:
+    def _apply_locked(self, group: int, lane: int, row: list,
+                      ts: int) -> None:
         # local-lane routing reuses the single-host runtime: global lane →
         # local lane is a contiguous remap, and the runtime's own crc32 lane
         # assignment is replaced by explicit placement. Callers hold
         # ``_engine_lock``.
-        lane = self.topo.local_lane(self.topo.lane_of(row[self._key_pos]))
-        b = self.rt.builders[lane]
+        shard = self._shards[group]
+        b = shard.builders[self.topo.local_lane(lane)]
         b.append(self.stream_id, row, ts)
         if b.full:
-            self.rt.flush(decode=self.on_rows is not None)
+            shard.flush(decode=self.on_rows is not None)
 
-    def _forward(self, peer: int, rows: list, timestamps: list) -> None:
-        s = self._peer_socks.get(peer)
+    def _forward(self, group: int, body: bytes, n: int) -> int:
+        """Deliver one lane group's pre-packed rows; returns rows acked by
+        the remote owner (0 when spilled, failed, or applied locally after
+        an ownership change mid-flight)."""
+        spill_q = self.guard.spill(group)
+        if self.guard.must_spill(group):
+            # BLOCK-policy admission wait happens OUTSIDE the group lock so
+            # a replay drain can free space (bounded; then forced in)
+            spill_q.wait_for_space(self._stop)
+        with self._group_locks[group]:
+            seq = self._next_seq.get(group, 0) + 1
+            self._next_seq[group] = seq
+            frame = _ROWS_HDR.pack(self.host_index, group, self.epoch,
+                                   seq) + body
+            if not spill_q.empty:
+                # a backlog exists for a group WE now own (takeover window):
+                # drain it before this frame applies, or the locally-applied
+                # higher seq would make monotone dedup drop every older
+                # spilled frame on replay
+                with self._engine_lock:
+                    owner = self.topo.owner[group]
+                if owner == self.host_index:
+                    try:
+                        self._drain_spill_group_locked(group)
+                    except Exception:
+                        # park the fresh frame before surfacing, like the
+                        # send path below — it must never simply vanish
+                        spill_q.append(frame, n)
+                        raise
+            if self.guard.must_spill(group):
+                spill_q.append(frame, n)
+                return 0
+            try:
+                outcome = self._send_frame(group, frame)
+            except Exception:
+                # never lose a framed batch to an unexpected error: park it
+                # in the spill queue (the sweep replays it) and surface
+                spill_q.append(frame, n)
+                raise
+            if outcome == "acked":
+                return n
+            if outcome == "local":
+                return 0
+            spill_q.append(frame, n)
+            return 0
+
+    def _send_frame(self, group: int, frame: bytes) -> str:
+        """One frame through the retry/redirect machine. Returns ``acked``
+        (remote owner applied or deduped it), ``local`` (ownership moved to
+        this host mid-flight; applied through the same dedup path), or
+        ``failed`` (retry budget exhausted — caller spills). Any send/ack
+        error closes and evicts the cached peer socket so the next attempt
+        reconnects instead of reusing a broken connection."""
+        attempts = 0
+        redirects = 0
+        while True:
+            with self._engine_lock:
+                owner = self.topo.owner[group]
+            if owner == self.host_index:
+                try:
+                    self._apply_frame_locally(frame)
+                    return "local"
+                except ConnectionError:
+                    # ownership said local but the shard is gone (stale
+                    # K_OWNER flip mid-handoff) — spill, don't lose
+                    return "failed"
+            site = f"dcn:{self.host_index}->{owner}"
+            try:
+                with self._sock_locks[owner]:
+                    s = self._peer_sock_locked(owner)
+                    send_msg(s, K_ROWS, frame)
+                    if self.chaos is not None:
+                        self.chaos.on_dcn_send(site)    # simulated lost ack
+                    reply = recv_msg(s, timeout=self.io_timeout_s)
+                if reply is None:
+                    raise ConnectionError(f"peer {owner}: closed before ack")
+                kind, payload = reply
+                if kind == K_ACK:
+                    self.guard.on_send_ok(owner)
+                    return "acked"
+                if kind == K_OWNER:
+                    g, new_owner = _OWNER_FMT.unpack(payload)
+                    with self._engine_lock:
+                        self.topo.reassign(g, new_owner)
+                    self.guard.count(owner, "redirects")
+                    redirects += 1
+                    if redirects > self.topo.num_hosts:
+                        raise ConnectionError(
+                            f"group {group}: ownership redirect loop")
+                    continue          # re-send the SAME frame to the owner
+                raise ConnectionError(
+                    f"peer {owner}: unexpected reply kind {kind}")
+            except (OSError, ConnectionError, ChaosFault,
+                    ValueError, struct.error) as e:
+                # ValueError/struct.error: a malformed control reply
+                # (short K_OWNER payload, out-of-range owner byte) is peer
+                # misbehavior — retry/spill like any transport fault
+                self._drop_peer_sock(owner)
+                self.guard.on_send_error(owner)
+                attempts += 1
+                if attempts >= self.guard.config.retry_max:
+                    log.warning(
+                        "host %d: frame to group %d (peer %d) failed after "
+                        "%d attempts: %s", self.host_index, group, owner,
+                        attempts, e)
+                    return "failed"
+                self.guard.count(owner, "retries")
+                if self._stop.wait(self.guard.backoff_s(attempts - 1)):
+                    return "failed"
+
+    def _apply_frame_locally(self, frame: bytes) -> int:
+        """Apply a framed K_ROWS payload to a locally-owned shard through
+        the SAME dedup path a remote receiver uses (takeover replay and
+        ownership changes mid-send land here)."""
+        sender, group, epoch, seq = _ROWS_HDR.unpack_from(frame)
+        rows, tss = unpack_rows(frame[_ROWS_HDR.size:])
+        with self._engine_lock:
+            if group not in self._shards:
+                raise ConnectionError(
+                    f"group {group} not owned here (owner "
+                    f"{self.topo.owner.get(group)})")
+            if self._is_dup_locked(group, sender, epoch, seq):
+                self.dup_frames += 1
+                return 0
+            for row, ts in zip(rows, tss):
+                lane = self.topo.lane_of(row[self._key_pos])
+                self._apply_locked(group, lane, row, ts)
+            self._mark_locked(group, sender, epoch, seq)
+            # locally re-owned rows count as forwarded ("delivered to the
+            # group's owner — us"), keeping the row totals reconcilable
+            # across a takeover's spill replay
+            self.forwarded += len(rows)
+        return len(rows)
+
+    # -- dedup (exactly-once across retries, restarts, and failover) ----------
+    def _is_dup_locked(self, group: int, sender: int, epoch: int,
+                       seq: int) -> bool:
+        cur = self._dedup.get(group, {}).get(sender)
+        if cur is None:
+            return False
+        cepoch, cseq = cur
+        return epoch < cepoch or (epoch == cepoch and seq <= cseq)
+
+    def _mark_locked(self, group: int, sender: int, epoch: int,
+                     seq: int) -> None:
+        self._dedup.setdefault(group, {})[sender] = (epoch, seq)
+
+    # -- peer sockets ---------------------------------------------------------
+    def _peer_sock_locked(self, host: int) -> socket.socket:
+        """Cached data socket to ``host`` (caller holds its sock lock)."""
+        s = self._peer_socks.get(host)
         if s is None:
-            addr, port = self.peers[peer]
-            s = socket.create_connection((addr, port), timeout=10)
-            self._peer_socks[peer] = s
-        send_msg(s, K_ROWS, pack_rows(self._types, rows, timestamps))
-        # the ack establishes happens-before with any LATER flush barrier on
-        # another connection (review finding: sendall only means buffered,
-        # not applied)
-        reply = recv_msg(s)
-        if not reply or reply[0] != K_ACK:
-            raise ConnectionError(f"peer {peer}: missing ack")
+            addr, port = self.peers[host]
+            s = socket.create_connection((addr, port),
+                                         timeout=self.connect_timeout_s)
+            s.settimeout(self.io_timeout_s)
+            self._peer_socks[host] = s
+            if host in self._ever_connected:
+                self.guard.count(host, "reconnects")
+            self._ever_connected.add(host)
+        return s
+
+    def _drop_peer_sock(self, host: int) -> None:
+        """Close + evict the cached socket so the next attempt reconnects
+        (a broken connection must never be reused)."""
+        with self._sock_locks[host]:
+            s = self._peer_socks.pop(host, None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def ping_peer(self, peer: int) -> bool:
+        """One heartbeat probe on the dedicated heartbeat connection (data
+        exchanges never wait behind a probe and vice versa)."""
+        timeout = self.guard.config.probe_timeout_s
+        with self._hb_locks[peer]:
+            s = self._hb_socks.get(peer)
+            try:
+                if s is None:
+                    addr, port = self.peers[peer]
+                    s = socket.create_connection((addr, port),
+                                                 timeout=timeout)
+                    s.settimeout(timeout)
+                    self._hb_socks[peer] = s
+                send_msg(s, K_PING)
+                reply = recv_msg(s, timeout=timeout)
+                if reply is not None and reply[0] == K_PONG:
+                    return True
+                raise ConnectionError(f"peer {peer}: bad heartbeat reply")
+            except (OSError, ConnectionError):
+                s = self._hb_socks.pop(peer, None)
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                return False
+
+    def _control_exchange(self, host: int, kind: int, payload: bytes,
+                          timeout: Optional[float] = None
+                          ) -> Optional[tuple]:
+        """Best-effort request/reply on the data socket (K_OWNER/K_ADOPT)."""
+        try:
+            with self._sock_locks[host]:
+                s = self._peer_sock_locked(host)
+                send_msg(s, kind, payload)
+                return recv_msg(s, timeout=timeout or self.io_timeout_s)
+        except (OSError, ConnectionError) as e:
+            self._drop_peer_sock(host)
+            log.warning("host %d: control frame %d to peer %d failed: %s",
+                        self.host_index, kind, host, e)
+            return None
+
+    def _announce_owner(self, group: int, owner: int) -> None:
+        payload = _OWNER_FMT.pack(group, owner)
+        for peer in self.peers:
+            if self.guard.peer_state(peer) != PEER_DOWN:
+                self._control_exchange(peer, K_OWNER, payload)
+
+    # -- spill replay ---------------------------------------------------------
+    def replay_spill(self, group: int) -> int:
+        """Drain the group's spill queue in order (recovery, takeover, or
+        the heartbeat backlog sweep). Stops at the first frame that fails
+        again (pushed back intact); returns rows acked by the remote
+        owner."""
+        with self._group_locks[group]:
+            acked_rows = self._drain_spill_group_locked(group)
+        if acked_rows:
+            with self._engine_lock:
+                self.forwarded += acked_rows
+        return acked_rows
+
+    def _drain_spill_group_locked(self, group: int) -> int:
+        """Replay the backlog in order; caller holds the group lock."""
+        q = self.guard.spill(group)
+        acked_rows = 0
+        while True:
+            item = q.pop_front()
+            if item is None:
+                break
+            frame, n = item
+            try:
+                outcome = self._send_frame(group, frame)
+            except Exception:
+                # an unexpected engine/transport error must not lose the
+                # popped frame — restore it before surfacing
+                q.push_front(item)
+                raise
+            if outcome == "failed":
+                q.push_front(item)
+                break
+            q.mark_replayed(n)
+            if outcome == "acked":
+                acked_rows += n
+        return acked_rows
+
+    # -- failover: takeover / hand-back ---------------------------------------
+    def is_designated_survivor(self, dead: int) -> bool:
+        """Deterministic survivor election: the lowest-indexed host not
+        currently DOWN adopts. Every survivor evaluates the same rule, but
+        from its LOCAL failure-detector view — a network partition that
+        splits those views can elect two survivors (dual adoption). This
+        layer deliberately stops at deadline-based election; deployments
+        that must exclude split-brain put a lease/coordinator in front of
+        ``take_over`` (see DISTRIBUTED.md)."""
+        alive = [self.host_index] + [
+            p for p in self.peers
+            if p != dead and self.guard.peer_state(p) != PEER_DOWN]
+        return self.host_index == min(alive)
+
+    def take_over(self, group: int, refresh: bool = False) -> bool:
+        """Adopt a lane group: restore its latest snapshot revision (state
+        pytree keyed by global lane ids + the group's dedup table), re-point
+        the topology, announce ownership, and replay any spilled frames —
+        which now apply locally through the same dedup path.
+
+        ``refresh=True`` (the K_ADOPT hand-back path) re-restores even when
+        the group is already held: a restarted home host may have rebuilt
+        its home shard from a PRE-handoff revision at startup, and keeping
+        that state would drop every row the survivor applied since."""
+        if group in self._shards and not refresh:
+            return False          # cheap unlocked pre-check; re-checked below
+        # the slow work — snapshot-store disk read, shard construction (jit
+        # compile), state restore — runs on a PRIVATE shard with no lock
+        # held: holding _engine_lock here would stall every ingest/serve
+        # thread past their ack deadlines and churn the whole cluster
+        snap = (self.snapshot_store.latest(group)
+                if self.snapshot_store is not None else None)
+        shard = self._build_shard()
+        if snap is not None:
+            self._restore_shard_state(group, shard, snap)
+        with self._engine_lock:
+            existing = self._shards.get(group)
+            if existing is not None and not refresh:
+                return False      # raced another adopter
+            if existing is not None and snap is None:
+                return False      # nothing to re-restore from: keep state
+            if existing is not None:
+                # the replaced shard's rows are gone — loud, not silent. A
+                # host that may have been failed over should restart with a
+                # STANDBY owner map (home group pointed at the survivor) so
+                # nothing lands here before the hand-back (DISTRIBUTED.md)
+                log.warning(
+                    "host %d: re-restoring group %d discards a live shard "
+                    "(match_count=%d) in favor of the handed-back revision",
+                    self.host_index, group, existing.match_count)
+            if snap is not None:
+                self._merge_dedup_locked(group, snap)
+            self._shards[group] = shard
+            if group == self.host_index:
+                self.rt = shard
+            self.topo.reassign(group, self.host_index)
+            self.takeovers += 1
+        log.info("host %d: took over lane group %d", self.host_index, group)
+        # announce off the caller (usually the heartbeat thread): serial
+        # request/reply to every peer at io_timeout each would stall
+        # failure detection of OTHER peers. An uninformed peer keeps
+        # sending to the dead host, spills, and the sweep replays here.
+        threading.Thread(target=self._announce_owner,
+                         args=(group, self.host_index), daemon=True).start()
+        self.replay_spill(group)
+        return True
+
+    def release_group(self, group: int) -> bool:
+        """Hand an adopted group back to its recovered home host: snapshot
+        the adopted state (new revision), drop the shard, re-point the
+        topology, and drive the returning host's restore with ``K_ADOPT`` —
+        the takeover handoff in reverse."""
+        home = group
+        with self._engine_lock:
+            shard = self._shards.get(group)
+            if shard is None or group == self.host_index:
+                return False
+            shard.flush(decode=self.on_rows is not None)
+            if self.snapshot_store is not None:
+                self._save_group_locked(group, shard)
+            del self._shards[group]
+            self.topo.reassign(group, home)
+        log.info("host %d: released lane group %d back to host %d",
+                 self.host_index, group, home)
+        # no K_OWNER broadcast here: home's own take_over announces once the
+        # restore is done. In the handoff window a frame for this group can
+        # bounce between redirects; the sender's redirect bound turns that
+        # into a retry/spill (replayed once ownership settles), never a loss.
+        # Two K_ADOPT attempts: the first may hit the cached pre-crash
+        # socket (it gets dropped), the second dials the recovered host
+        # fresh. The home host acks only AFTER its restore completes —
+        # which includes a shard rebuild (jit compile) — so this exchange
+        # gets a much longer deadline than a data frame; a rollback on a
+        # handoff that was merely slow would leave both hosts owning the
+        # group.
+        adopt_timeout = max(60.0, self.io_timeout_s)
+        for _ in range(2):
+            reply = self._control_exchange(home, K_ADOPT,
+                                           _ADOPT_FMT.pack(group),
+                                           timeout=adopt_timeout)
+            if reply is not None and reply[0] == K_ACK:
+                self.rejoins += 1
+                return True
+        # unconfirmed handoff must not strand the group: re-adopt from the
+        # revision saved above (no loss — nothing applied here since), and
+        # trip the peer's detector so the probe cycle re-drives the
+        # hand-back instead of leaving it half-done forever
+        log.warning("host %d: K_ADOPT handoff of group %d to host %d "
+                    "failed; re-adopting and re-marking the peer down",
+                    self.host_index, group, home)
+        self.take_over(group, refresh=True)
+        self.guard.health(home).trip()
+        return False
+
+    # -- snapshots (global-lane-keyed lane-group state) -----------------------
+    def snapshot(self) -> dict:
+        """Flush + persist every owned group's state; returns
+        ``{group: revision}``. The saved revision carries the group's dedup
+        table so exactly-once survives a restore."""
+        if self.snapshot_store is None:
+            raise ValueError("no snapshot store configured")
+        revs = {}
+        with self._engine_lock:
+            for g, shard in self._shards.items():
+                shard.flush(decode=self.on_rows is not None)
+                revs[g] = self._save_group_locked(g, shard)
+            self.snapshots += 1
+        return revs
+
+    def _save_group_locked(self, group: int,
+                           shard: PartitionedNFARuntime) -> int:
+        leaves, _ = jax.tree_util.tree_flatten(shard.state)
+        leaves = [np.asarray(jax.device_get(x)) for x in leaves]
+        return self.snapshot_store.save(
+            group, list(self.topo.lanes_of_group(group)), leaves,
+            self._dedup.get(group, {}),
+            dicts=shard.compiler.merged.snapshot_dictionaries())
+
+    def _restore_shard_state(self, group: int,
+                             shard: PartitionedNFARuntime,
+                             snap: dict) -> None:
+        """State + dictionaries onto a PRIVATE (unpublished) shard — no
+        lock needed; the dedup merge happens separately under the lock."""
+        leaves, treedef = jax.tree_util.tree_flatten(shard.state)
+        saved = snap["leaves"]
+        if len(saved) != len(leaves):
+            raise ValueError(
+                f"group {group} snapshot has {len(saved)} leaves, "
+                f"runtime expects {len(leaves)} (app/config mismatch)")
+        shard.state = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(a) for a in saved])
+        # state slots store dictionary CODES: the dictionary must restore
+        # with them or key-equality filters compare garbage in a fresh
+        # process (the device_state_snapshot contract, per lane group)
+        shard.compiler.merged.restore_dictionaries(snap.get("dicts", {}))
+
+    def _merge_dedup_locked(self, group: int, snap: dict) -> None:
+        merged = self._dedup.setdefault(group, {})
+        for sender, mark in snap["dedup"].items():
+            cur = merged.get(sender)
+            if cur is None or mark > cur:
+                merged[sender] = mark
+
+    def _maybe_snapshot(self, group: int, due: bool) -> None:
+        """Per-frame durability persists ONLY the group the frame applied
+        to — ack latency must not scale with the number of adopted groups."""
+        if not due or self.snapshot_store is None:
+            return
+        with self._engine_lock:
+            shard = self._shards.get(group)
+            if shard is not None:
+                shard.flush(decode=self.on_rows is not None)
+                self._save_group_locked(group, shard)
+                self.snapshots += 1
 
     # -- DCN server side ------------------------------------------------------
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
             try:
                 conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue              # periodic shutdown check
             except OSError:
                 return
-            threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            # prune finished threads: a flapping peer reconnects constantly
+            # and the list must not grow for the worker's lifetime
+            self._serve_threads = [x for x in self._serve_threads
+                                   if x.is_alive()]
+            self._serve_threads.append(t)
+            t.start()
 
     def _serve(self, conn: socket.socket) -> None:
-        while True:
-            msg = recv_msg(conn)
-            if msg is None:
+        conn.settimeout(self.io_timeout_s)
+        self._conns.add(conn)
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = recv_msg(conn, timeout=self.io_timeout_s)
+                except socket.timeout:
+                    continue          # idle between frames; re-check stop
+                except (OSError, ConnectionError):
+                    return
+                if msg is None:
+                    return
+                kind, payload = msg
+                try:
+                    if kind == K_ROWS:
+                        self._handle_rows(conn, payload)
+                    elif kind == K_PING:
+                        send_msg(conn, K_PONG)
+                    elif kind == K_OWNER:
+                        g, owner = _OWNER_FMT.unpack(payload)
+                        with self._engine_lock:
+                            self.topo.reassign(g, owner)
+                        send_msg(conn, K_ACK)
+                    elif kind == K_ADOPT:
+                        (g,) = _ADOPT_FMT.unpack(payload)
+                        self.take_over(g, refresh=True)
+                        send_msg(conn, K_ACK)
+                    elif kind == K_FLUSH:
+                        self.flush()
+                        send_msg(conn, K_FLUSHED,
+                                 struct.pack(">q", self.match_count))
+                except ChaosFault:
+                    return            # injected peer kill: die without ack
+                except (OSError, ConnectionError):
+                    return
+                except Exception:     # noqa: BLE001 — counted + logged:
+                    # an engine failure mid-frame must not kill the serve
+                    # thread silently; no ack goes out, so the sender
+                    # retries/spills (see _handle_rows on frame atomicity)
+                    self.frame_errors += 1
+                    log.exception("host %d: serve failed on frame kind %d",
+                                  self.host_index, kind)
+                    return
+        finally:
+            self._conns.discard(conn)
+            try:
                 conn.close()
-                return
-            kind, payload = msg
-            if kind == K_ROWS:
-                rows, tss = unpack_rows(payload)
-                with self._engine_lock:
-                    for row, ts in zip(rows, tss):
-                        self.received += 1
-                        self._apply(row, ts)
-                send_msg(conn, K_ACK)
-            elif kind == K_FLUSH:
-                self.flush()
-                send_msg(conn, K_FLUSHED,
-                         struct.pack(">q", self.match_count))
+            except OSError:
+                pass
+
+    def _handle_rows(self, conn: socket.socket, payload: bytes) -> None:
+        # Frame atomicity caveat: rows apply before the dedup mark, with no
+        # rollback — an engine exception MID-frame (counted in
+        # frame_errors) leaves head rows applied and unmarked, so a retry
+        # could re-apply them. Append-path failures are deterministic (a
+        # poison frame fails identically on retry, no double apply); only a
+        # transient device-step failure mid-frame can break exactly-once,
+        # and WAL-grade frame atomicity is the flow layer's job, not the
+        # transport's.
+        sender, group, epoch, seq = _ROWS_HDR.unpack_from(payload)
+        site = f"dcn:serve:{self.host_index}"
+        if self.chaos is not None:
+            self.chaos.on_dcn_serve(site)   # kill-peer site: abort, no ack
+        rows, tss = unpack_rows(payload[_ROWS_HDR.size:])
+        redirect = None
+        due = False
+        with self._engine_lock:
+            if group not in self._shards:
+                redirect = self.topo.owner[group]
+            elif self._is_dup_locked(group, sender, epoch, seq):
+                # the retry of a frame whose ack was lost: exactly-once
+                # means ack again, apply nothing
+                self.dup_frames += 1
+            else:
+                for row, ts in zip(rows, tss):
+                    self.received += 1
+                    lane = self.topo.lane_of(row[self._key_pos])
+                    self._apply_locked(group, lane, row, ts)
+                self._mark_locked(group, sender, epoch, seq)
+                # the durability cadence is PER GROUP: a global counter
+                # with interleaved senders could systematically skip one
+                # group's snapshots (unbounded loss instead of <= N-1
+                # frames)
+                c = self._frames_applied.get(group, 0) + 1
+                self._frames_applied[group] = c
+                n = self.snapshot_every_frames
+                due = bool(n) and c % n == 0
+        if redirect is not None:
+            # stale routing at the sender: point it at the current owner;
+            # it re-sends the SAME frame there, so dedup state stays with
+            # the lane group and nothing applies twice
+            send_msg(conn, K_OWNER, _OWNER_FMT.pack(group, redirect))
+            return
+        # durability before the ack: at snapshot_every_frames=1 an acked
+        # frame is guaranteed restorable
+        self._maybe_snapshot(group, due)
+        if self.chaos is not None:
+            self.chaos.on_dcn_ack(site)     # ack-delay site
+        send_msg(conn, K_ACK)
 
     def flush(self) -> None:
         with self._engine_lock:
-            self.rt.flush(decode=self.on_rows is not None)
+            for shard in self._shards.values():
+                shard.flush(decode=self.on_rows is not None)
 
     @property
     def match_count(self) -> int:
-        return self.rt.match_count
+        with self._engine_lock:
+            return sum(rt.match_count for rt in self._shards.values())
+
+    # -- observability --------------------------------------------------------
+    def report(self) -> dict:
+        """Service-facing state (GET /siddhi-apps/{name}/dcn)."""
+        with self._engine_lock:
+            owner = {str(g): o for g, o in self.topo.owner.items()}
+            owned = sorted(self._shards)
+        return {
+            "host": self.host_index, "epoch": self.epoch,
+            "topology": {"num_lanes": self.topo.num_lanes,
+                         "num_hosts": self.topo.num_hosts,
+                         "lanes_per_host": self.topo.lanes_per_host,
+                         "owner": owner},
+            "owned_groups": owned,
+            "forwarded_rows": self.forwarded,
+            "received_rows": self.received,
+            "dup_frames": self.dup_frames,
+            "takeovers": self.takeovers,
+            "rejoins": self.rejoins,
+            "snapshots": self.snapshots,
+            "match_count": self.match_count,
+            **self.guard.report(),
+        }
+
+    def register_metrics(self, sm) -> None:
+        """Expose peer/spill/failover state as ``dcn.*`` trackers so the
+        Prometheus exposition renders ``siddhi_tpu_dcn_*`` families (label
+        ``peer`` = host or lane-group index, ``self`` for worker-level)."""
+        guard = self.guard
+        for peer in self.peers:
+            sm.gauge_tracker(f"dcn.{peer}.peer_state",
+                             lambda p=peer: guard.health(p).state_code)
+            for key in ("pings", "ping_failures", "retries", "reconnects",
+                        "redirects"):
+                sm.gauge_tracker(
+                    f"dcn.{peer}.{key}_total",
+                    lambda p=peer, k=key: guard.peer_counters[p][k])
+        # every group, INCLUDING the home one: a standby restart (home
+        # group owned by the survivor) spills home-group frames too, and
+        # that backlog must not be a metrics blind spot
+        for g in range(self.topo.num_hosts):
+            sm.gauge_tracker(f"dcn.{g}.spill_depth",
+                             lambda gg=g: len(guard.spill(gg)))
+            sm.gauge_tracker(
+                f"dcn.{g}.spilled_frames_total",
+                lambda gg=g: guard.spill(gg).spilled_frames)
+            sm.gauge_tracker(
+                f"dcn.{g}.spill_replayed_frames_total",
+                lambda gg=g: guard.spill(gg).replayed_frames)
+            sm.gauge_tracker(
+                f"dcn.{g}.spill_dropped_frames_total",
+                lambda gg=g: (guard.spill(gg).dropped_oldest_frames
+                              + guard.spill(gg).shed_frames))
+        sm.gauge_tracker("dcn.self.forwarded_rows_total",
+                         lambda: self.forwarded)
+        sm.gauge_tracker("dcn.self.received_rows_total",
+                         lambda: self.received)
+        sm.gauge_tracker("dcn.self.dup_frames_total",
+                         lambda: self.dup_frames)
+        sm.gauge_tracker("dcn.self.takeovers_total", lambda: self.takeovers)
+        sm.gauge_tracker("dcn.self.rejoins_total", lambda: self.rejoins)
+        sm.gauge_tracker("dcn.self.snapshots_total", lambda: self.snapshots)
+        sm.gauge_tracker("dcn.self.owned_groups",
+                         lambda: len(self._shards))
+        self._sm = sm
 
     def close(self) -> None:
         self._stop.set()
+        self.guard.stop()
         try:
             self._srv.close()
         except OSError:
             pass
-        for s in self._peer_socks.values():
+        for conn in list(self._conns):
             try:
-                s.close()
+                conn.close()
             except OSError:
                 pass
+        for socks in (self._peer_socks, self._hb_socks):
+            for s in list(socks.values()):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self._accept_thread.join(timeout=5)
+        for t in self._serve_threads:
+            t.join(timeout=1)
+        if self._sm is not None:
+            self._sm.unregister("dcn.")
+            self._sm = None
