@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Bench regression guard: a fresh ``bench.py`` HOST run vs BASELINE.json.
+
+CI-runnable (invoked from tests/test_host_batch.py when ``BENCH_GUARD`` is
+set): runs the bench host child (both host tiers — scalar interpreter and
+the columnar micro-batch engine) on a reduced corpus and fails when
+
+1. the columnar engine did not actually engage (``host_engine`` !=
+   ``columnar`` — a silent fall-back to the interpreter is the regression
+   this guard exists to catch);
+2. host-side oracle parity broke (columnar vs scalar match counts);
+3. the columnar/scalar speedup dropped below the tolerance band around
+   BASELINE.json's ``host_baseline.columnar_vs_scalar_min`` (the ratio is
+   same-machine, so it is robust to container speed differences — absolute
+   ev/s numbers are NOT comparable across machines and are only reported).
+
+Exit code 0 = ok, 1 = regression, 2 = could not measure.
+
+Env knobs: ``BENCH_GUARD_EVENTS`` (default 60000), ``BENCH_GUARD_TOL``
+(default 0.5 — the fraction of the stored speedup floor that must still
+hold; 0.5 × 3.0 → the columnar engine must stay ≥1.5x the interpreter).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_guard(events: int, tol: float, deadline_s: int = 600) -> int:
+    with open(os.path.join(REPO, "BASELINE.json")) as f:
+        baseline = json.load(f).get("host_baseline") or {}
+    ratio_min = float(baseline.get("columnar_vs_scalar_min", 3.0))
+    floor = tol * ratio_min
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "BENCH_BASELINE_EVENTS": str(min(events, 20000)),
+        "BENCH_ORACLE_EVENTS": str(events),
+    }
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--host-child"],
+            capture_output=True, text=True, timeout=deadline_s, env=env,
+            cwd=REPO)
+    except subprocess.TimeoutExpired:
+        print(f"GUARD: host bench exceeded {deadline_s}s", file=sys.stderr)
+        return 2
+    if p.returncode != 0:
+        tail = (p.stderr or "").strip().splitlines()[-6:]
+        print("GUARD: host bench failed: " + " | ".join(tail),
+              file=sys.stderr)
+        return 2
+    data = None
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            data = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    if data is None:
+        print("GUARD: no JSON in bench output", file=sys.stderr)
+        return 2
+
+    scalar = data.get("rate")
+    columnar = data.get("host_batch_rate")
+    engine = data.get("host_engine")
+    failures = []
+    if engine != "columnar":
+        failures.append(
+            f"columnar engine did not engage (host_engine={engine!r}, "
+            f"error={data.get('host_batch_error')!r})")
+    if data.get("host_batch_oracle_matches") != data.get("oracle_matches"):
+        failures.append(
+            f"host oracle parity broke: columnar="
+            f"{data.get('host_batch_oracle_matches')} scalar="
+            f"{data.get('oracle_matches')} over {events} events")
+    ratio = None
+    if scalar and columnar:
+        ratio = columnar / scalar
+        if ratio < floor:
+            failures.append(
+                f"columnar/scalar speedup {ratio:.2f}x below the tolerance "
+                f"band (floor {floor:.2f}x = {tol} x stored "
+                f"{ratio_min:.2f}x)")
+    elif not failures:
+        failures.append("missing host rates in bench output")
+
+    print(json.dumps({
+        "scalar_evps": round(scalar) if scalar else None,
+        "columnar_evps": round(columnar) if columnar else None,
+        "speedup": round(ratio, 2) if ratio else None,
+        "floor": floor,
+        "stored_seed_evps": baseline.get("scalar_evps"),
+        "host_engine": engine,
+        "parity_ok": data.get("host_batch_oracle_matches")
+        == data.get("oracle_matches"),
+        "ok": not failures,
+    }))
+    for f_ in failures:
+        print(f"GUARD REGRESSION: {f_}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+def main() -> int:
+    events = int(os.environ.get("BENCH_GUARD_EVENTS", 60000))
+    tol = float(os.environ.get("BENCH_GUARD_TOL", 0.5))
+    return run_guard(events, tol)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

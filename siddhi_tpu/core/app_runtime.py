@@ -92,6 +92,7 @@ class SiddhiAppRuntime:
         self.sources: list = []
         self.sinks: list = []
         self.device_bridges: list = []
+        self.host_bridges: list = []    # columnar host fast-path queries
         self._io_handlers: list[tuple[str, str]] = []   # (kind, element id)
         self._started = False
         self._ondemand_cache: dict[str, OnDemandQueryRuntime] = {}
@@ -230,6 +231,12 @@ class SiddhiAppRuntime:
         for ad in app.aggregation_definitions.values():
             ctx.aggregations[ad.id] = AggregationRuntime(ad, ctx, self._stream_defs())
         # queries & partitions in definition order
+        from .host_bridge import (
+            host_batch_config,
+            try_build_host_partition,
+            try_build_host_query,
+        )
+        host_cfg = host_batch_config(app.annotations)
         q_count = 0
         for element in app.execution_elements:
             if isinstance(element, Query):
@@ -247,6 +254,19 @@ class SiddhiAppRuntime:
                             bridge.receiver_for(sid))
                     self._fill_implicit(element, bridge)
                     continue
+                # columnar host fast path (middle tier): engages per query
+                # when the plan lowers on the numpy backend; otherwise the
+                # scalar interpreter builds below — per query, not per app
+                hbridge = try_build_host_query(
+                    element, ctx, self._stream_defs(), self._get_junction,
+                    name, host_cfg)
+                if hbridge is not None:
+                    self.host_bridges.append(hbridge)
+                    for sid in hbridge.stream_ids:
+                        self._get_junction(sid).subscribe(
+                            hbridge.receiver_for(sid))
+                    self._fill_implicit(element, hbridge)
+                    continue
                 rt = build_query_runtime(
                     element, ctx, self._stream_defs(), self._get_junction, name)
                 self.query_runtimes[name] = rt
@@ -262,6 +282,20 @@ class SiddhiAppRuntime:
             elif isinstance(element, Partition):
                 q_count += 1
                 name = f"partition-{q_count}"
+                if host_cfg is not None:
+                    # lane-partitioned columnar NFA for pattern partitions:
+                    # replaces the per-key interpreter cloning when EVERY
+                    # query in the block lowers on the numpy backend
+                    hbridges = try_build_host_partition(
+                        element, ctx, self._stream_defs(),
+                        self._get_junction, name, host_cfg)
+                    if hbridges is not None:
+                        for hb in hbridges:
+                            self.host_bridges.append(hb)
+                            for sid in hb.stream_ids:
+                                self._get_junction(sid).subscribe(
+                                    hb.receiver_for(sid))
+                        continue
                 prt = PartitionRuntime(element, ctx, self._stream_defs(),
                                        lambda sid, inner=False: self._get_junction(sid),
                                        name)
@@ -319,6 +353,20 @@ class SiddhiAppRuntime:
             ctrl = getattr(b.runtime, "batch_controller", None)
             if ctrl is not None:
                 sm.gauge_tracker(f"device.{b.query_name}.batch_size",
+                                 lambda c=ctrl: c.current)
+        # columnar host fast-path gauges: staged rows, events/batches routed
+        # through the vectorized engine (the step-latency histogram registers
+        # at bridge construction)
+        for b in self.host_bridges:
+            sm.buffered_tracker(f"host_batch.{b.query_name}",
+                                lambda bb=b: len(bb.runtime.builder))
+            sm.gauge_tracker(f"host_batch.{b.query_name}.events",
+                             lambda bb=b: bb.events_in)
+            sm.gauge_tracker(f"host_batch.{b.query_name}.batches",
+                             lambda bb=b: bb.batches)
+            ctrl = getattr(b.runtime, "batch_controller", None)
+            if ctrl is not None:
+                sm.gauge_tracker(f"host_batch.{b.query_name}.batch_size",
                                  lambda c=ctrl: c.current)
         # resilience gauges: per-receiver fault counts, sink circuits, device
         # quarantine state (sink_retries / sink_dropped register themselves
@@ -532,7 +580,7 @@ class SiddhiAppRuntime:
             cbs = rt.callback_adapter.callbacks
             if callback in cbs:
                 cbs.remove(callback)
-        for bridge in self.device_bridges:
+        for bridge in self.device_bridges + self.host_bridges:
             cbs = getattr(bridge, "query_callbacks", [])
             if callback in cbs:
                 cbs.remove(callback)
@@ -542,7 +590,7 @@ class SiddhiAppRuntime:
         if rt is not None:
             rt.add_callback(callback)
             return
-        for bridge in self.device_bridges:
+        for bridge in self.device_bridges + self.host_bridges:
             if bridge.query_name == query_name:
                 bridge.query_callbacks.append(callback)
                 return
@@ -586,6 +634,8 @@ class SiddhiAppRuntime:
         self.drain_async()           # deliver queued async events
         for b in self.device_bridges:
             b.finalize()             # drain + close open device segments
+        for b in self.host_bridges:
+            b.finalize()             # drain columnar host micro-batches
         for j in self.ctx.stream_junctions.values():
             if j.dispatcher is not None:
                 j.dispatcher.stop()
@@ -628,6 +678,7 @@ class SiddhiAppRuntime:
     def advance_time(self, ts: int) -> None:
         """Advance the playback clock (fires due timers) without an event."""
         self.flush_device()
+        self.flush_host()
         self.ctx.advance_time(ts)
 
     def flush_device(self) -> None:
@@ -635,11 +686,18 @@ class SiddhiAppRuntime:
         for b in self.device_bridges:
             b.flush()
 
+    def flush_host(self) -> None:
+        """Drain pending micro-batches of columnar host fast-path queries."""
+        for b in self.host_bridges:
+            b.flush()
+
     # -- snapshots ------------------------------------------------------------
     def _pre_snapshot(self) -> None:
         """Quiesce async machinery so state walks see a stable engine (the
         reference locks ThreadBarrier). Runs WITHOUT root_lock."""
         self.drain_async()
+        self.flush_host()       # columnar bridges are synchronous: a plain
+        # drain leaves no staged row for the state walk to miss
         for b in self.device_bridges:
             if b.driver is not None:
                 b.driver.flush_sync()
@@ -754,6 +812,7 @@ class SiddhiAppRuntime:
     def query_names(self) -> set:
         names = set(self.query_runtimes)
         names.update(b.query_name for b in self.device_bridges)
+        names.update(b.query_name for b in self.host_bridges)
         return names
 
     @property

@@ -45,7 +45,18 @@ class StreamEvent:
         self.trace = None
 
     def copy(self) -> "StreamEvent":
-        return StreamEvent(self.timestamp, list(self.data), self.type)
+        # hot path (every window expiry clones): skip __init__ — field
+        # assignment via __new__ is ~2x cheaper than re-running the
+        # constructor, and the per-copy semantics (fresh group_key/flow_seq/
+        # trace) are explicit here
+        c = StreamEvent.__new__(StreamEvent)
+        c.timestamp = self.timestamp
+        c.data = list(self.data)
+        c.type = self.type
+        c.group_key = None
+        c.flow_seq = None
+        c.trace = None
+        return c
 
     def __repr__(self) -> str:
         return f"StreamEvent({self.timestamp}, {self.data}, {self.type.name})"

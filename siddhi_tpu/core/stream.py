@@ -129,6 +129,32 @@ class StreamJunction:
             # the same event twice and duplicate it on replay
             self.handle_error(event, first_error)
 
+    def rows_capable(self) -> bool:
+        """True when every subscriber accepts raw row chunks — the columnar
+        fast path can then skip per-event ``StreamEvent`` materialization
+        entirely (measured ~35% of chunked-ingress wall time)."""
+        return self.dispatcher is None and self.flow is None and \
+            self.receivers and \
+            all(hasattr(r, "receive_rows") for r in self.receivers)
+
+    def deliver_rows(self, rows: list, timestamps) -> None:
+        """Zero-wrap chunk delivery to rows-capable receivers (see
+        ``rows_capable``). Caller transfers ownership of ``rows``."""
+        self.throughput += len(rows)
+        newest = max(timestamps)
+        self.last_event_ts = newest if self.last_event_ts is None \
+            else max(self.last_event_ts, newest)
+        for r in self.receivers:
+            try:
+                r.receive_rows(rows, timestamps)
+            except Exception as e:  # noqa: BLE001 — per-receiver isolation,
+                # same contract as deliver_events; fault routing sees the
+                # chunk as StreamEvents (rare path, built on demand)
+                self._record_receiver_error(r, e)
+                self.handle_error(
+                    [StreamEvent(ts, list(row), EventType.CURRENT)
+                     for row, ts in zip(rows, timestamps)], e)
+
     def deliver_events(self, events: list[StreamEvent]) -> None:
         self.throughput += len(events)
         newest = max(e.timestamp for e in events)
@@ -344,6 +370,49 @@ class InputHandler:
             # the events are queued (depth_fn counts them) or delivery
             # failed: either way the admission reservation is done
             self.flow.release(len(rows))
+
+    def send_rows(self, rows: list, timestamps) -> None:
+        """Bulk ingress: one chunk of raw rows + per-row timestamps.
+
+        The columnar fast path's preferred entry: the chunk reaches
+        chunk-aware receivers (host/device bridges) as ONE micro-batch with
+        no per-row ``Event`` wrapping. Semantics match a ``send`` of the
+        equivalent ``Event`` list (watermark advances to the chunk minimum
+        before delivery, to the maximum after)."""
+        if not rows:
+            return
+        if len(rows) != len(timestamps):
+            # zip would silently truncate on one path and desynchronize the
+            # SoA stagers on the other — fail loudly instead
+            raise ValueError(
+                f"send_rows: {len(rows)} rows but {len(timestamps)} "
+                f"timestamps")
+        if self.flow is not None and not self.flow.replaying:
+            self._send([Event(ts, row) for row, ts in zip(rows, timestamps)])
+            return
+        arity = len(self.junction.definition.attributes)
+        if any(len(r) != arity for r in rows):
+            for row in rows:
+                self._check_arity(row)         # raise with the full message
+        if self.junction.rows_capable():
+            # every subscriber is chunk-columnar: raw rows go straight into
+            # the SoA stagers, no per-event StreamEvent materialization
+            with self.app_context.root_lock:
+                self.app_context.advance_time(min(timestamps))
+                self.junction.deliver_rows(rows, timestamps)
+                self.app_context.advance_time(max(timestamps))
+            return
+        events = [StreamEvent(ts, row, EventType.CURRENT)
+                  for row, ts in zip(rows, timestamps)]
+        if self.junction.dispatcher is not None:
+            self.junction.send_events(events)
+            return
+        with self.app_context.root_lock:
+            self.app_context.advance_time(
+                min(ev.timestamp for ev in events))
+            self.junction.send_events(events)
+            self.app_context.advance_time(
+                max(ev.timestamp for ev in events))
 
     def _check_arity(self, data) -> None:
         defn = self.junction.definition

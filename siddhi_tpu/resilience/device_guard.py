@@ -126,6 +126,7 @@ class DeviceGuard:
         self.bridge = None              # set by guard_device for callbacks
         self._last_step_fell_back = False
         self._fb_runtime = None
+        self._fb_engine = None          # 'columnar' | 'scalar' once built
         self._fb_lock = threading.Lock()
 
     # -- installation --------------------------------------------------------
@@ -185,10 +186,38 @@ class DeviceGuard:
         with self.app_context.root_lock:
             with self._fb_lock:
                 if self._fb_runtime is None:
+                    # COLUMNAR first: quarantine/shadow-replay through the
+                    # vectorized host engine (tpu/host_exec.py) — degraded
+                    # mode runs at micro-batch speed, not one event at a
+                    # time. Queries that don't lower on the numpy backend
+                    # keep the scalar interpreter runtime.
+                    fb = None
+                    try:
+                        from ..core.host_bridge import build_host_fallback
+                        fb = build_host_fallback(
+                            self.query, self.app_context, self.stream_defs,
+                            self.get_junction, f"{self.query_name}__hostfb")
+                    except Exception:   # noqa: BLE001 — fallback of the
+                        # fallback: never let the fast path's absence turn
+                        # a degraded device into a dead query
+                        log.exception(
+                            "%s: columnar fallback build failed; using the "
+                            "scalar interpreter", self._site)
+                    if fb is not None:
+                        if self.bridge is not None:
+                            # SHARE the bridge's query-callback list (see
+                            # the scalar branch below)
+                            fb.bridge.query_callbacks = \
+                                self.bridge.query_callbacks
+                        self._fb_runtime = fb
+                        self._fb_engine = "columnar"
+                        self._fb_runtime.start()
+                        return self._fb_runtime
                     from ..core.query_runtime import build_query_runtime
                     self._fb_runtime = build_query_runtime(
                         self.query, self.app_context, self.stream_defs,
                         self.get_junction, f"{self.query_name}__hostfb")
+                    self._fb_engine = "scalar"
                     if self.bridge is not None:
                         # SHARE the bridge's query-callback list: callbacks
                         # registered on the device query (now or later) see
@@ -222,6 +251,10 @@ class DeviceGuard:
                     if sid is None or rsid == sid:
                         receiver.receive(ev)
                 delivered += 1
+            if self._fb_engine == "columnar":
+                # columnar receivers STAGE rows; one vectorized step per
+                # replayed batch surfaces the outputs immediately
+                rt.flush()
         self.fallback_events += delivered
         log.info("%s: %d event(s) rerouted through the host path%s",
                  self._site, delivered,
@@ -235,4 +268,7 @@ class DeviceGuard:
             "failures": self.failures,
             "fallback_events": self.fallback_events,
             "lost_events": self.lost_events,
+            # which engine replays shadows: 'columnar' (vectorized host
+            # fast path) or 'scalar'; None until the first fallback
+            "fallback_engine": self._fb_engine,
         }

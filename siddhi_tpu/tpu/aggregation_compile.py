@@ -51,10 +51,9 @@ _MIN_IDENT = {True: np.inf, False: -np.inf}
 
 
 def _ident(dtype, is_min: bool):
-    if jnp.issubdtype(dtype, jnp.floating):
-        return jnp.asarray(jnp.inf if is_min else -jnp.inf, dtype)
-    info = jnp.iinfo(dtype)
-    return jnp.asarray(info.max if is_min else info.min, dtype)
+    # shared with query_compile (was a byte-identical local copy)
+    from .backend import reduce_identity
+    return reduce_identity(dtype, is_min, jnp)
 
 
 class CompiledAggregation:

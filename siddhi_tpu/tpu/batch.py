@@ -186,12 +186,25 @@ class BatchSchema:
         return np.dtype(NP[t])
 
     def encode_value(self, name: str, v: Any):
-        t = self.definition.attribute_type(name)
-        if t == DataType.STRING:
-            return self.dictionaries[name].encode(v)
+        enc = self.encoders.get(name)
+        if enc is not None:                    # string column
+            return enc(v)
         if v is None:
             return 0
         return v
+
+    @property
+    def encoders(self) -> dict:
+        """Per-attribute string encoders, resolved ONCE per schema (the
+        per-event append loop previously re-looked-up attribute type and
+        dictionary for every value)."""
+        e = self.__dict__.get("_encoders")
+        if e is None:
+            e = self.__dict__["_encoders"] = {
+                a.name: self.dictionaries[a.name].encode
+                for a in self.definition.attributes
+                if a.type == DataType.STRING}
+        return e
 
     def snapshot_dictionaries(self) -> dict:
         return snapshot_dictionaries(self.dictionaries)

@@ -8,13 +8,18 @@ whole condition into a single elementwise kernel over the micro-batch.
 Programs take ``cols: dict[str, jnp.ndarray]`` (plus ``__ts__``) and return an
 array of shape [B]. String constants are dictionary-encoded at trace time, so
 string equality becomes int32 compare on codes.
+
+Backend parametric (``backend.py``): the resolver's ``xp`` attribute picks the
+array namespace the emitted closures run on — jax.numpy (jitted device path,
+the default) or plain numpy (the columnar host engine). The same compile pass
+serves both; only the dtype policy differs (f32 device / f64 host).
 """
 
 from __future__ import annotations
 
 from typing import Callable, Optional
 
-import jax.numpy as jnp
+from .backend import jnp, policy_dtype, resolver_xp
 
 from ..query_api import (
     And,
@@ -41,7 +46,9 @@ class DeviceCompileError(Exception):
 _NUM_ORDER = [DataType.INT, DataType.LONG, DataType.FLOAT, DataType.DOUBLE]
 
 
-def _policy_dtype(t: DataType):
+def _policy_dtype(t: DataType, xp=None):
+    if xp is not None:
+        return policy_dtype(t, xp)
     from .dtypes import JNP
     return JNP[t]
 
@@ -56,10 +63,15 @@ def promote(a: DataType, b: DataType) -> DataType:
 
 class ColumnResolver:
     """Maps a Variable to a column key + dtype. Single-stream queries use bare
-    attribute names; pattern/join compilers subclass with prefixed keys."""
+    attribute names; pattern/join compilers subclass with prefixed keys.
 
-    def __init__(self, schema: BatchSchema):
+    ``xp`` selects the array namespace compiled programs execute on (numpy on
+    the columnar host backend; the lazy jax.numpy proxy otherwise)."""
+
+    def __init__(self, schema: BatchSchema, xp=None):
         self.schema = schema
+        if xp is not None:
+            self.xp = xp
 
     def resolve(self, var: Variable) -> tuple[str, DataType]:
         d = self.schema.definition
@@ -68,15 +80,28 @@ class ColumnResolver:
         return var.attribute, d.attribute_type(var.attribute)
 
     def encode_string(self, attr_key: str, value: str) -> int:
+        # the (attr, value)→code map is cached per APP (on the shared schema
+        # dictionaries), not re-resolved per compiled query: rebuilt plans
+        # (per-key partition instances, guard fallback runtimes, fuzz loops)
+        # hit the cache instead of re-walking the dictionary
+        cache = getattr(self.schema, "_enc_cache", None)
+        if cache is None:
+            cache = self.schema._enc_cache = {}
+        code = cache.get((attr_key, value))
+        if code is not None:
+            return code
         dic = self.schema.dictionaries.get(attr_key)
         if dic is None:
             raise DeviceCompileError(f"no dictionary for '{attr_key}'")
-        return dic.encode(value)
+        code = dic.encode(value)
+        cache[(attr_key, value)] = code
+        return code
 
 
 def compile_expression(expr: Expression, resolver: ColumnResolver
                        ) -> tuple[Callable[[dict], jnp.ndarray], DataType]:
-    """Returns (fn(cols)->jnp array [B], result dtype)."""
+    """Returns (fn(cols)->array [B], result dtype) on the resolver's backend."""
+    xp = resolver_xp(resolver)
 
     if isinstance(expr, Constant):
         if expr.type == DataType.STRING:
@@ -92,16 +117,16 @@ def compile_expression(expr: Expression, resolver: ColumnResolver
     if isinstance(expr, And):
         lf, _ = compile_expression(expr.left, resolver)
         rf, _ = compile_expression(expr.right, resolver)
-        return (lambda cols: jnp.logical_and(lf(cols), rf(cols))), DataType.BOOL
+        return (lambda cols: xp.logical_and(lf(cols), rf(cols))), DataType.BOOL
 
     if isinstance(expr, Or):
         lf, _ = compile_expression(expr.left, resolver)
         rf, _ = compile_expression(expr.right, resolver)
-        return (lambda cols: jnp.logical_or(lf(cols), rf(cols))), DataType.BOOL
+        return (lambda cols: xp.logical_or(lf(cols), rf(cols))), DataType.BOOL
 
     if isinstance(expr, Not):
         f, _ = compile_expression(expr.expr, resolver)
-        return (lambda cols: jnp.logical_not(f(cols))), DataType.BOOL
+        return (lambda cols: xp.logical_not(f(cols))), DataType.BOOL
 
     if isinstance(expr, Compare):
         return _compile_compare(expr, resolver)
@@ -109,7 +134,7 @@ def compile_expression(expr: Expression, resolver: ColumnResolver
     if isinstance(expr, MathExpr):
         lf, lt = compile_expression(expr.left, resolver)
         rf, rt = compile_expression(expr.right, resolver)
-        _check_long_float_mix(lt, rt, expr.left, expr.right)
+        _check_long_float_mix(lt, rt, expr.left, expr.right, xp)
         rtype = promote(lt, rt)
         op = expr.op
         int_result = rtype in (DataType.INT, DataType.LONG)
@@ -117,10 +142,11 @@ def compile_expression(expr: Expression, resolver: ColumnResolver
         def run(cols):
             # pin both operands to the policy dtype of the promoted type: JAX
             # x64 promotion would otherwise materialize float64 for mixed
-            # int64/float32 operands (dtypes.py invariant: no f64 on device)
-            jdt = _policy_dtype(rtype)
-            a = jnp.asarray(lf(cols)).astype(jdt)
-            b = jnp.asarray(rf(cols)).astype(jdt)
+            # int64/float32 operands (dtypes.py invariant: no f64 on device);
+            # the host backend pins to f64/i64 (interpreter-exact)
+            jdt = _policy_dtype(rtype, xp)
+            a = xp.asarray(lf(cols)).astype(jdt)
+            b = xp.asarray(rf(cols)).astype(jdt)
             if op == MathOp.ADD:
                 return a + b
             if op == MathOp.SUB:
@@ -130,12 +156,12 @@ def compile_expression(expr: Expression, resolver: ColumnResolver
             if op == MathOp.DIV:
                 if int_result:
                     # Java semantics: truncation toward zero
-                    q = jnp.abs(a) // jnp.abs(b)
-                    return jnp.where((a >= 0) == (b >= 0), q, -q)
+                    q = xp.abs(a) // xp.abs(b)
+                    return xp.where((a >= 0) == (b >= 0), q, -q)
                 return a / b
             if int_result:     # operands pinned to an int dtype above
-                return jnp.sign(a) * (jnp.abs(a) % jnp.abs(b))
-            return jnp.fmod(a, b)
+                return xp.sign(a) * (xp.abs(a) % xp.abs(b))
+            return xp.fmod(a, b)
 
         return run, rtype
 
@@ -157,11 +183,17 @@ _F32_EXACT_INT = 2 ** 24      # |v| ≤ 2^24 round-trips int↔float32 exactly
 
 
 def _check_long_float_mix(lt: DataType, rt: DataType, left: Expression,
-                          right: Expression) -> None:
+                          right: Expression, xp=None) -> None:
     """LONG mixed with a non-constant FLOAT/DOUBLE casts the int64 side to
     f32, which misfires above 2^24 — the reference promotes to double (exact
     to 2^53). Fall back to the host path unless the LONG side is a constant
-    small enough to be exact in f32 (advisor r2 finding)."""
+    small enough to be exact in f32 (advisor r2 finding).
+
+    The numpy host backend promotes to float64 like the reference, so the
+    guard only applies to the f32 device policy."""
+    import numpy as _np
+    if xp is _np:
+        return
     floats = (DataType.FLOAT, DataType.DOUBLE)
     for t, other_t, e in ((lt, rt, left), (rt, lt, right)):
         if t == DataType.LONG and other_t in floats:
@@ -172,7 +204,7 @@ def _check_long_float_mix(lt: DataType, rt: DataType, left: Expression,
                 "device (f64 banned) — host path")
 
 
-def _fold_int_vs_float_const(col_fn, op: CompareOp, c: float):
+def _fold_int_vs_float_const(col_fn, op: CompareOp, c: float, xp=jnp):
     """``int_col OP float_const`` as an exact int64 comparison.
 
     For any integer a: a > c ⟺ a ≥ ⌊c⌋+1; a ≥ c ⟺ a ≥ ⌈c⌉; a < c ⟺ a ≤ ⌈c⌉-1;
@@ -182,8 +214,8 @@ def _fold_int_vs_float_const(col_fn, op: CompareOp, c: float):
     I64_MIN, I64_MAX = -(2 ** 63), 2 ** 63 - 1
 
     def const_bool(v: bool):
-        return lambda cols: jnp.broadcast_to(
-            jnp.asarray(v), jnp.shape(col_fn(cols)))
+        return lambda cols: xp.broadcast_to(
+            xp.asarray(v), xp.shape(col_fn(cols)))
 
     # non-finite constants (inf from an overflowing literal, NaN) never reach
     # floor/ceil — fold to the constant truth value (advisor r2 finding)
@@ -227,6 +259,8 @@ def _fold_int_vs_float_const(col_fn, op: CompareOp, c: float):
 
 
 def _compile_compare(expr: Compare, resolver: ColumnResolver):
+    xp = resolver_xp(resolver)
+
     # string comparisons: only EQ/NEQ, via dictionary codes
     def side(e: Expression, other: Expression):
         if isinstance(e, Constant) and e.type == DataType.STRING:
@@ -253,25 +287,25 @@ def _compile_compare(expr: Compare, resolver: ColumnResolver):
     _INTS = (DataType.INT, DataType.LONG)
     if lt in _INTS and isinstance(expr.right, Constant) \
             and rt in (DataType.FLOAT, DataType.DOUBLE):
-        return _fold_int_vs_float_const(lf, op, float(expr.right.value)), \
+        return _fold_int_vs_float_const(lf, op, float(expr.right.value), xp), \
             DataType.BOOL
     if rt in _INTS and isinstance(expr.left, Constant) \
             and lt in (DataType.FLOAT, DataType.DOUBLE):
         return _fold_int_vs_float_const(
-            rf, _FLIP[op], float(expr.left.value)), DataType.BOOL
+            rf, _FLIP[op], float(expr.left.value), xp), DataType.BOOL
 
     # numeric compares: pin both sides to the promoted policy dtype so mixed
     # int64/float32 operands never promote to float64 (string codes and bools
     # already share one dtype per side)
-    _check_long_float_mix(lt, rt, expr.left, expr.right)
-    cmp_dt = _policy_dtype(promote(lt, rt)) \
+    _check_long_float_mix(lt, rt, expr.left, expr.right, xp)
+    cmp_dt = _policy_dtype(promote(lt, rt), xp) \
         if lt in _NUM_ORDER and rt in _NUM_ORDER and lt != rt else None
 
     def run(cols):
         a, b = lf(cols), rf(cols)
         if cmp_dt is not None:
-            a = jnp.asarray(a).astype(cmp_dt)
-            b = jnp.asarray(b).astype(cmp_dt)
+            a = xp.asarray(a).astype(cmp_dt)
+            b = xp.asarray(b).astype(cmp_dt)
         if op == CompareOp.EQ:
             return a == b
         if op == CompareOp.NEQ:
@@ -288,31 +322,30 @@ def _compile_compare(expr: Compare, resolver: ColumnResolver):
 
 
 def _compile_function(expr: AttributeFunction, resolver: ColumnResolver):
+    xp = resolver_xp(resolver)
     name = expr.name if expr.namespace is None else f"{expr.namespace}:{expr.name}"
     if name == "ifThenElse":
         c, _ = compile_expression(expr.args[0], resolver)
         a, ta = compile_expression(expr.args[1], resolver)
         b, tb = compile_expression(expr.args[2], resolver)
         rt = promote(ta, tb)
-        jdt = _policy_dtype(rt)
-        return (lambda cols: jnp.where(
-            c(cols), jnp.asarray(a(cols)).astype(jdt),
-            jnp.asarray(b(cols)).astype(jdt))), rt
+        jdt = _policy_dtype(rt, xp)
+        return (lambda cols: xp.where(
+            c(cols), xp.asarray(a(cols)).astype(jdt),
+            xp.asarray(b(cols)).astype(jdt))), rt
     if name in ("convert", "cast"):
         src, _ = compile_expression(expr.args[0], resolver)
         target = expr.args[1]
         if not isinstance(target, Constant):
             raise DeviceCompileError("convert target must be constant")
-        from .dtypes import JNP as _J
-        tmap = {"int": (_J[DataType.INT], DataType.INT),
-                "long": (_J[DataType.LONG], DataType.LONG),
-                "float": (_J[DataType.FLOAT], DataType.FLOAT),
-                "double": (_J[DataType.DOUBLE], DataType.DOUBLE),
-                "bool": (jnp.bool_, DataType.BOOL)}
+        tmap = {"int": DataType.INT, "long": DataType.LONG,
+                "float": DataType.FLOAT, "double": DataType.DOUBLE,
+                "bool": DataType.BOOL}
         if str(target.value).lower() not in tmap:
             raise DeviceCompileError(f"convert to {target.value!r} not on device")
-        jdt, dt = tmap[str(target.value).lower()]
-        return (lambda cols: src(cols).astype(jdt)), dt
+        dt = tmap[str(target.value).lower()]
+        jdt = xp.bool_ if dt == DataType.BOOL else _policy_dtype(dt, xp)
+        return (lambda cols: xp.asarray(src(cols)).astype(jdt)), dt
     if name == "eventTimestamp" and not expr.args:
         return (lambda cols: cols["__ts__"]), DataType.LONG
     if name in ("maximum", "minimum"):
@@ -320,12 +353,12 @@ def _compile_function(expr: AttributeFunction, resolver: ColumnResolver):
         t = fns[0][1]
         for _, ti in fns[1:]:
             t = promote(t, ti)
-        jdt = _policy_dtype(t)
-        red = jnp.max if name == "maximum" else jnp.min
+        jdt = _policy_dtype(t, xp)
+        red = xp.max if name == "maximum" else xp.min
 
         def run(cols, fns=fns, jdt=jdt, red=red):
-            vs = [jnp.asarray(f(cols)).astype(jdt) for f, _ in fns]
-            return red(jnp.stack(jnp.broadcast_arrays(*vs)), axis=0)
+            vs = [xp.asarray(f(cols)).astype(jdt) for f, _ in fns]
+            return red(xp.stack(xp.broadcast_arrays(*vs)), axis=0)
 
         return run, t
     raise DeviceCompileError(f"function '{name}' not device-compilable")

@@ -292,6 +292,11 @@ class _NFAResolver:
         self.current = current_state
         self.current_alias = current_alias
         self.touched: list = []        # (state, variant) bound refs resolved
+        # backend the compiled predicate/output closures execute on (numpy
+        # for the columnar host engine; default lazy jax.numpy)
+        xp = getattr(nfa, "xp", None)
+        if xp is not None:
+            self.xp = xp
 
     def resolve(self, var: Variable) -> tuple[str, DataType]:
         nfa = self.nfa
@@ -419,10 +424,17 @@ def _null_strict(e) -> bool:
 class DeviceNFACompiler:
     def __init__(self, query: Query, stream_defs: dict[str, StreamDefinition],
                  slot_capacity: int = 64, batch_capacity: int = 1024,
-                 creation_cap: Optional[int] = None):
+                 creation_cap: Optional[int] = None,
+                 backend: str = "jax"):
         ist = query.input_stream
         if not isinstance(ist, StateInputStream):
             raise DeviceCompileError("not a pattern/sequence query")
+        # backend="numpy": compile the SAME plan (states, predicates, output
+        # programs) against plain numpy for the columnar host engine
+        # (tpu/host_exec.py) — no jit, no device, f64/i64 dtype policy
+        self.backend = backend
+        if backend == "numpy":
+            self.xp = np
         self.query = query
         self.C = slot_capacity
         self.B = batch_capacity
@@ -579,7 +591,16 @@ class DeviceNFACompiler:
             raise DeviceCompileError(
                 "element-level within outside stream-chain patterns needs "
                 "the host path")
-        self._step = jax.jit(self._make_step(), donate_argnums=(0,))
+        if backend == "numpy":
+            # the columnar host engine (tpu/host_exec.py) executes the plan
+            # eagerly with dynamic shapes; it only covers the blocked shape
+            if not self.blocked:
+                raise DeviceCompileError(
+                    "count/logical/absent states have no columnar host "
+                    "kernel — scalar interpreter path")
+            self._step = None
+        else:
+            self._step = jax.jit(self._make_step(), donate_argnums=(0,))
 
     def _compile_predicates(self, ist: StateInputStream) -> None:
         # recover filter ASTs from the host compiler's branch filters is not

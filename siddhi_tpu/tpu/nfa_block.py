@@ -43,6 +43,14 @@ kernel (``nfa.py``).
 Reference semantics: ``StreamPreStateProcessor.processAndReturn``
 (``query/input/stream/state/StreamPreStateProcessor.java:364-403``), expiry
 ``isExpired:118``; the blocked formulation is original to this framework.
+
+The same compiled plan (``DeviceNFACompiler`` states/predicates/outputs,
+``backend="numpy"``) has a second executor: ``host_exec.HostBlockNFA`` runs
+these stage semantics eagerly in NumPy with DYNAMIC tables — no padding, no
+slot capacities, no drop counters — as the columnar host fast path and the
+DeviceGuard quarantine engine. Semantic changes to the stage algorithm here
+must be mirrored there (the parity fuzz in ``tests/test_host_batch.py``
+pins both against the scalar interpreter).
 """
 
 from __future__ import annotations

@@ -78,20 +78,16 @@ def _kahan_add(base, comp, add):
 
 
 def _avalanche(x):
-    """splitmix64 finalizer: spreads packed multi-key ids over buckets."""
-    x = x.astype(jnp.uint64)
-    x = (x ^ (x >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
-    x = (x ^ (x >> 27)) * jnp.uint64(0x94D049BB133111EB)
-    x = x ^ (x >> 31)
-    return (x & jnp.uint64(0x7FFFFFFFFFFFFFFF)).astype(jnp.int64)
+    """splitmix64 finalizer (shared definition in ``backend.py``)."""
+    from .backend import avalanche
+    return avalanche(x, jnp)
 
 
 def _ident(dtype, is_min: bool):
-    """Reduction identity for min/max lanes."""
-    if jnp.issubdtype(dtype, jnp.floating):
-        return jnp.asarray(jnp.inf if is_min else -jnp.inf, dtype)
-    info = jnp.iinfo(dtype)
-    return jnp.asarray(info.max if is_min else info.min, dtype)
+    """Reduction identity for min/max lanes (shared with
+    ``aggregation_compile`` via ``backend.py``)."""
+    from .backend import reduce_identity
+    return reduce_identity(dtype, is_min, jnp)
 
 
 def _range_reduce(z, lo, j, is_min: bool):
@@ -147,7 +143,7 @@ class CompiledStreamQuery:
 
     def __init__(self, query: Query, definition: StreamDefinition,
                  batch_capacity: int = 4096, group_capacity: int = 1024,
-                 window_capacity: int = 4096):
+                 window_capacity: int = 4096, backend: str = "jax"):
         ist = query.input_stream
         if not isinstance(ist, SingleInputStream):
             raise DeviceCompileError("device path covers single-stream queries")
@@ -155,8 +151,13 @@ class CompiledStreamQuery:
         self.definition = definition
         self.B = batch_capacity
         self.K = group_capacity
+        # backend="numpy": the SAME lowering pass (handler walk, spec build,
+        # validation) emits numpy closures for the columnar host engine
+        # (tpu/host_exec.py) — no jit, f64/i64 policy, dynamic shapes
+        self.backend = backend
+        self.xp = np if backend == "numpy" else None
         self.schema = BatchSchema(definition)
-        resolver = ColumnResolver(self.schema)
+        resolver = ColumnResolver(self.schema, xp=self.xp)
         self.resolver = resolver
 
         # handlers: filters + at most one window
@@ -483,8 +484,11 @@ class CompiledStreamQuery:
         self.having_fn: Optional[Callable] = None
         if query.selector.having is not None:
             hres = _OutputResolver(self.specs, self.schema)
+            if self.xp is not None:
+                hres.xp = self.xp
             self.having_fn, _ = compile_expression(query.selector.having, hres)
-        self._step = jax.jit(self._make_step(), donate_argnums=(0,))
+        self._step = None if backend == "numpy" \
+            else jax.jit(self._make_step(), donate_argnums=(0,))
 
     def _mdtype(self, i: int):
         return _JNP_DTYPES[self.specs[i].dtype]
