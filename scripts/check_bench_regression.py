@@ -106,10 +106,100 @@ def run_guard(events: int, tol: float, deadline_s: int = 600) -> int:
     return 1 if failures else 0
 
 
+def run_fleet_guard(tol: float, deadline_s: int = 600) -> int:
+    """Multi-tenant fleet line vs BASELINE.json ``fleet_baseline``: a fresh
+    ``bench.py --fleet-child`` run (reduced feed) must keep
+
+    1. the fleet engaged (every tenant on a fleet bridge) with ONE compile
+       per shape (shared-compilation dedupe across K tenants);
+    2. per-tenant oracle parity (fleet == solo == scalar match counts);
+    3. fleet/solo aggregate throughput above the tolerance band around the
+       stored ``fleet_vs_solo_min`` (same-machine ratio — robust to
+       container speed).
+    """
+    with open(os.path.join(REPO, "BASELINE.json")) as f:
+        baseline = json.load(f).get("fleet_baseline") or {}
+    ratio_min = float(baseline.get("fleet_vs_solo_min", 3.0))
+    floor = tol * ratio_min
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "BENCH_TENANT_FEED": os.environ.get("BENCH_GUARD_TENANT_FEED",
+                                            "6000"),
+        "BENCH_FLEET_PATTERN_FEED": "0",    # ratio line only: keep CI fast
+    }
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--fleet-child"],
+            capture_output=True, text=True, timeout=deadline_s, env=env,
+            cwd=REPO)
+    except subprocess.TimeoutExpired:
+        print(f"GUARD: fleet bench exceeded {deadline_s}s", file=sys.stderr)
+        return 2
+    if p.returncode != 0:
+        tail = (p.stderr or "").strip().splitlines()[-6:]
+        print("GUARD: fleet bench failed: " + " | ".join(tail),
+              file=sys.stderr)
+        return 2
+    data = None
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            data = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    if data is None:
+        print("GUARD: no JSON in fleet bench output", file=sys.stderr)
+        return 2
+
+    failures = []
+    tenants = data.get("tenants")
+    if data.get("fleet_engaged") != tenants:
+        failures.append(
+            f"fleet did not engage every tenant "
+            f"(engaged={data.get('fleet_engaged')} of {tenants})")
+    if data.get("fleet_compiles") != 1:
+        failures.append(
+            f"shared compilation broke: {data.get('fleet_compiles')} "
+            f"compiles for {tenants} homogeneous tenants (expected 1)")
+    if not data.get("oracle_ok"):
+        failures.append("per-tenant oracle parity broke "
+                        "(fleet/solo/scalar match counts diverged)")
+    ratio = data.get("fleet_vs_solo")
+    if not ratio:
+        failures.append("missing fleet_vs_solo in bench output")
+    elif ratio < floor:
+        failures.append(
+            f"fleet/solo speedup {ratio:.2f}x below the tolerance band "
+            f"(floor {floor:.2f}x = {tol} x stored {ratio_min:.2f}x)")
+
+    print(json.dumps({
+        "tenants": tenants,
+        "fleet_evps": data.get("fleet_evps"),
+        "solo_evps": data.get("solo_evps"),
+        "fleet_vs_solo": round(ratio, 2) if ratio else None,
+        "floor": floor,
+        "fleet_compiles": data.get("fleet_compiles"),
+        "solo_compiles": data.get("solo_compiles"),
+        "oracle_ok": data.get("oracle_ok"),
+        "ok": not failures,
+    }))
+    for f_ in failures:
+        print(f"GUARD REGRESSION (fleet): {f_}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def main() -> int:
     events = int(os.environ.get("BENCH_GUARD_EVENTS", 60000))
     tol = float(os.environ.get("BENCH_GUARD_TOL", 0.5))
-    return run_guard(events, tol)
+    rc = run_guard(events, tol)
+    if os.environ.get("BENCH_GUARD_SKIP_FLEET", "") == "1":
+        return rc
+    frc = run_fleet_guard(tol)
+    return rc or frc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Fleet shape-key lint: fingerprints must be stable and collision-free.
+
+Run from tier-1 tests (tests/test_fleet.py). Checks, over a built-in corpus
+of representative app texts PLUS every app text found in the seed sample
+corpus (``samples/*.py``):
+
+1. **determinism** — parsing the same query text twice produces the same
+   shape key (keys must survive process restarts: they index the shared
+   plan cache);
+2. **constant invariance** — variants that differ ONLY in constants
+   (thresholds, window sizes, string literals, within horizons) map to the
+   SAME key (that is the whole point: N homogeneous tenants, one compile);
+3. **structure sensitivity** — structurally distinct queries (different
+   operators, windows kinds, group keys, select shapes, state graphs) map
+   to DISTINCT keys (a collision would batch tenants into the wrong
+   program).
+
+Exit 0 = ok, 1 = violation, 2 = could not check.
+"""
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+STREAM = "define stream S (sym string, v double, n long);\n"
+
+# (name, app text) — each entry is one STRUCTURE; the list of texts per
+# entry are constant-variants that must share one key
+CORPUS = [
+    ("filter", [
+        STREAM + "from S[v > 10.0] select sym, v insert into Out;",
+        STREAM + "from S[v > 99.5] select sym, v insert into Out;",
+    ]),
+    ("filter-string", [
+        STREAM + "from S[sym == 'a' and v > 1.0] select v insert into Out;",
+        STREAM + "from S[sym == 'zz' and v > 2.5] select v insert into Out;",
+    ]),
+    ("filter-math", [
+        STREAM + "from S[v * 2.0 + 1.0 > 10.0] select v, n insert into Out;",
+        STREAM + "from S[v * 3.5 + 0.5 > 77.0] select v, n insert into Out;",
+    ]),
+    ("proj-scale", [
+        STREAM + "from S select v * 2.0 as x insert into Out;",
+        STREAM + "from S select v * 9.0 as x insert into Out;",
+    ]),
+    ("running-agg", [
+        STREAM + "from S select sum(v) as s, count() as c insert into Out;",
+    ]),
+    ("group-by", [
+        STREAM + "from S select sym, sum(v) as s group by sym "
+                 "insert into Out;",
+    ]),
+    ("length-window", [
+        STREAM + "from S#window.length(10) select avg(v) as a "
+                 "insert into Out;",
+        STREAM + "from S#window.length(500) select avg(v) as a "
+                 "insert into Out;",
+    ]),
+    ("time-window", [
+        STREAM + "from S#window.time(5 sec) select sum(v) as s "
+                 "insert into Out;",
+        STREAM + "from S#window.time(90 sec) select sum(v) as s "
+                 "insert into Out;",
+    ]),
+    ("having", [
+        STREAM + "from S select sym, sum(v) as s group by sym "
+                 "having s > 10.0 insert into Out;",
+        STREAM + "from S select sym, sum(v) as s group by sym "
+                 "having s > 999.0 insert into Out;",
+    ]),
+    ("pattern", [
+        STREAM + "from every e1=S[v > 90.0] -> e2=S[v > e1.v] within 4000 "
+                 "select e1.v as a, e2.v as b insert into Out;",
+        STREAM + "from every e1=S[v > 10.0] -> e2=S[v > e1.v] within 900000 "
+                 "select e1.v as a, e2.v as b insert into Out;",
+    ]),
+    ("sequence", [
+        STREAM + "from every e1=S[v > 90.0], e2=S[v > e1.v] "
+                 "select e1.v as a, e2.v as b insert into Out;",
+    ]),
+    ("pattern-3", [
+        STREAM + "from every e1=S[v > 90.0] -> e2=S[v > e1.v] -> "
+                 "e3=S[v > e2.v] select e1.v as a, e3.v as b "
+                 "insert into Out;",
+    ]),
+]
+
+PARTITION = [
+    ("partitioned-pattern", [
+        STREAM + "partition with (sym of S) begin from every "
+                 "e1=S[v > 90.0] -> e2=S[v > e1.v] within 4000 "
+                 "select e1.v as a, e2.v as b insert into Out; end;",
+        STREAM + "partition with (sym of S) begin from every "
+                 "e1=S[v > 15.5] -> e2=S[v > e1.v] within 9000 "
+                 "select e1.v as a, e2.v as b insert into Out; end;",
+    ]),
+]
+
+
+def _keys_of(app_text: str):
+    """Shape keys of every normalizable execution element of an app text."""
+    from siddhi_tpu.compiler import parse
+    from siddhi_tpu.fleet.shape import (
+        FleetShapeError,
+        normalize_partition_query,
+        normalize_query,
+    )
+    from siddhi_tpu.query_api import Partition, Query
+
+    app = parse(app_text)
+    defs = dict(app.stream_definitions)
+    keys = []
+    for el in app.execution_elements:
+        try:
+            if isinstance(el, Query):
+                keys.append(normalize_query(el, defs).shape_key)
+            elif isinstance(el, Partition):
+                for q in el.queries:
+                    keys.append(
+                        normalize_partition_query(el, q, defs).shape_key)
+        except FleetShapeError:
+            keys.append(None)          # no shape — solo path, not an error
+    return keys
+
+
+def _sample_corpus_texts():
+    """App texts embedded in the seed sample corpus (samples/*.py):
+    triple-quoted strings containing a stream definition."""
+    texts = []
+    sdir = os.path.join(REPO, "samples")
+    if not os.path.isdir(sdir):
+        return texts
+    pat = re.compile(r'"""(.*?)"""', re.DOTALL)
+    for fn in sorted(os.listdir(sdir)):
+        if not fn.endswith(".py"):
+            continue
+        with open(os.path.join(sdir, fn)) as f:
+            src = f.read()
+        for m in pat.finditer(src):
+            if "define stream" in m.group(1):
+                texts.append((fn, m.group(1)))
+    return texts
+
+
+def main() -> int:
+    failures = []
+
+    # 1+2: built-in corpus — determinism and constant invariance
+    key_of_structure = {}
+    for name, variants in CORPUS + PARTITION:
+        keys = set()
+        for text in variants:
+            k1 = _keys_of(text)
+            k2 = _keys_of(text)
+            if k1 != k2:
+                failures.append(f"{name}: non-deterministic keys "
+                                f"{k1} vs {k2}")
+                continue
+            if any(k is None for k in k1):
+                failures.append(f"{name}: query did not normalize")
+                continue
+            keys.update(k1)
+        if len(keys) > 1:
+            failures.append(
+                f"{name}: constant-variants split into {len(keys)} keys "
+                f"({sorted(keys)})")
+        if keys:
+            key_of_structure[name] = next(iter(keys))
+
+    # 3: distinct structures ⇒ distinct keys
+    seen = {}
+    for name, key in key_of_structure.items():
+        if key in seen:
+            failures.append(
+                f"shape-key COLLISION: '{name}' and '{seen[key]}' share "
+                f"{key}")
+        seen[key] = name
+
+    # seed sample corpus: determinism over whatever parses + normalizes
+    checked = 0
+    for fn, text in _sample_corpus_texts():
+        try:
+            k1 = _keys_of(text)
+            k2 = _keys_of(text)
+        except Exception:   # noqa: BLE001 — samples may need extensions etc.
+            continue
+        checked += 1
+        if k1 != k2:
+            failures.append(f"samples/{fn}: non-deterministic keys")
+
+    if failures:
+        for f in failures:
+            print(f"FLEET-SHAPE: {f}", file=sys.stderr)
+        return 1
+    print(f"fleet shapes ok: {len(CORPUS) + len(PARTITION)} structures, "
+          f"{len(key_of_structure)} distinct keys, {checked} sample apps "
+          f"checked")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:   # noqa: BLE001
+        print(f"FLEET-SHAPE: could not check: {e}", file=sys.stderr)
+        sys.exit(2)

@@ -93,6 +93,7 @@ class SiddhiAppRuntime:
         self.sinks: list = []
         self.device_bridges: list = []
         self.host_bridges: list = []    # columnar host fast-path queries
+        self.fleet_bridges: list = []   # multi-tenant shared-plan queries
         self._io_handlers: list[tuple[str, str]] = []   # (kind, element id)
         self._started = False
         self._ondemand_cache: dict[str, OnDemandQueryRuntime] = {}
@@ -237,6 +238,14 @@ class SiddhiAppRuntime:
             try_build_host_query,
         )
         host_cfg = host_batch_config(app.annotations)
+        # @app:fleet: multi-tenant shared compilation — queries join the
+        # engine-wide FleetManager's shape groups (one compiled program per
+        # shape, cross-app lane batching); non-normalizing queries fall
+        # through to the solo tiers below, per query
+        from ..fleet import fleet_config
+        fleet_cfg = fleet_config(app.annotations)
+        fleet_mgr = ctx.siddhi_context.fleet() if fleet_cfg is not None \
+            else None
         q_count = 0
         for element in app.execution_elements:
             if isinstance(element, Query):
@@ -254,6 +263,20 @@ class SiddhiAppRuntime:
                             bridge.receiver_for(sid))
                     self._fill_implicit(element, bridge)
                     continue
+                # fleet tier: same-shape queries across tenant apps share
+                # one compiled columnar program and step as lanes of one
+                # batched step (solo tiers below when no fleet shape)
+                if fleet_mgr is not None:
+                    fbridge = fleet_mgr.enroll_query(
+                        element, ctx, self._stream_defs(),
+                        self._get_junction, name, fleet_cfg)
+                    if fbridge is not None:
+                        self.fleet_bridges.append(fbridge)
+                        for sid in fbridge.stream_ids:
+                            self._get_junction(sid).subscribe(
+                                fbridge.receiver_for(sid))
+                        self._fill_implicit(element, fbridge)
+                        continue
                 # columnar host fast path (middle tier): engages per query
                 # when the plan lowers on the numpy backend; otherwise the
                 # scalar interpreter builds below — per query, not per app
@@ -282,6 +305,17 @@ class SiddhiAppRuntime:
             elif isinstance(element, Partition):
                 q_count += 1
                 name = f"partition-{q_count}"
+                if fleet_mgr is not None:
+                    fbridges = fleet_mgr.enroll_partition(
+                        element, ctx, self._stream_defs(),
+                        self._get_junction, name, fleet_cfg)
+                    if fbridges is not None:
+                        for fb in fbridges:
+                            self.fleet_bridges.append(fb)
+                            for sid in fb.stream_ids:
+                                self._get_junction(sid).subscribe(
+                                    fb.receiver_for(sid))
+                        continue
                 if host_cfg is not None:
                     # lane-partitioned columnar NFA for pattern partitions:
                     # replaces the per-key interpreter cloning when EVERY
@@ -368,6 +402,12 @@ class SiddhiAppRuntime:
             if ctrl is not None:
                 sm.gauge_tracker(f"host_batch.{b.query_name}.batch_size",
                                  lambda c=ctrl: c.current)
+        # fleet gauges: staged rows visible per tenant (per-member ev/s,
+        # lanes-per-step and shape-cache counters register at enroll time in
+        # the FleetManager)
+        for b in self.fleet_bridges:
+            sm.buffered_tracker(f"fleet.{b.query_name}",
+                                lambda bb=b: len(bb.group.stager))
         # resilience gauges: per-receiver fault counts, sink circuits, device
         # quarantine state (sink_retries / sink_dropped register themselves
         # as counters at wrap time)
@@ -580,7 +620,8 @@ class SiddhiAppRuntime:
             cbs = rt.callback_adapter.callbacks
             if callback in cbs:
                 cbs.remove(callback)
-        for bridge in self.device_bridges + self.host_bridges:
+        for bridge in (self.device_bridges + self.host_bridges
+                       + self.fleet_bridges):
             cbs = getattr(bridge, "query_callbacks", [])
             if callback in cbs:
                 cbs.remove(callback)
@@ -590,7 +631,8 @@ class SiddhiAppRuntime:
         if rt is not None:
             rt.add_callback(callback)
             return
-        for bridge in self.device_bridges + self.host_bridges:
+        for bridge in (self.device_bridges + self.host_bridges
+                       + self.fleet_bridges):
             if bridge.query_name == query_name:
                 bridge.query_callbacks.append(callback)
                 return
@@ -636,6 +678,8 @@ class SiddhiAppRuntime:
             b.finalize()             # drain + close open device segments
         for b in self.host_bridges:
             b.finalize()             # drain columnar host micro-batches
+        for b in self.fleet_bridges:
+            b.finalize()             # drain the shared fleet groups
         for j in self.ctx.stream_junctions.values():
             if j.dispatcher is not None:
                 j.dispatcher.stop()
@@ -658,6 +702,17 @@ class SiddhiAppRuntime:
                 getattr(mgr, f"unregister_{'record_table' if kind == 'table' else kind}_handler")(hid)
         if self.flow is not None:
             self.flow.close()
+        # leave the fleet: this tenant's lanes detach from their shape
+        # groups (shared plans stay cached for the next tenant), and its
+        # metric families tear down through unregister() — a stopped tenant
+        # app must not leak dead gauges into the engine-wide exposition
+        sm = self.ctx.statistics_manager
+        if self.fleet_bridges:
+            self.ctx.siddhi_context.fleet().release_app(self.name)
+            sm.unregister("fleet.")
+            self.fleet_bridges = []
+        for b in self.host_bridges:
+            sm.unregister(f"host_batch.{b.query_name}")
         self.observability.on_shutdown()
         self.ctx.statistics_manager.stop_reporting()
         if self.ctx.ticker is not None:
@@ -687,8 +742,12 @@ class SiddhiAppRuntime:
             b.flush()
 
     def flush_host(self) -> None:
-        """Drain pending micro-batches of columnar host fast-path queries."""
+        """Drain pending micro-batches of columnar host fast-path and fleet
+        queries (a fleet flush drains the whole shape group — staged rows of
+        co-tenant apps resolve with it)."""
         for b in self.host_bridges:
+            b.flush()
+        for b in self.fleet_bridges:
             b.flush()
 
     # -- snapshots ------------------------------------------------------------
@@ -813,6 +872,7 @@ class SiddhiAppRuntime:
         names = set(self.query_runtimes)
         names.update(b.query_name for b in self.device_bridges)
         names.update(b.query_name for b in self.host_bridges)
+        names.update(b.query_name for b in self.fleet_bridges)
         return names
 
     @property

@@ -28,6 +28,16 @@ class SiddhiContext:
         self.source_handler_manager = None
         self.sink_handler_manager = None
         self.record_table_handler_manager = None
+        # multi-tenant shared compilation (siddhi_tpu/fleet/): one manager
+        # per engine so @app:fleet apps share plans and lane-batch cross-app
+        self.fleet_manager = None
+
+    def fleet(self):
+        """The engine's FleetManager, created on first use."""
+        if self.fleet_manager is None:
+            from ..fleet import FleetManager
+            self.fleet_manager = FleetManager()
+        return self.fleet_manager
 
 
 class SiddhiAppContext:

@@ -241,6 +241,16 @@ class ExecutorBuilder:
         if isinstance(expr, Constant):
             v = expr.value
             return (lambda f: v), expr.type
+        if type(expr).__name__ == "ParamRef":
+            # fleet parameter slot (normalized query ASTs): the scalar
+            # interpreter never executes those plans — the tpu passes
+            # re-compile predicates with slot support — but structural
+            # compilers (PatternCompiler) walk the AST eagerly, so give
+            # them a loud stub instead of a build failure
+            def _no_scalar(f):
+                raise ExecutorBuildError(
+                    "fleet ParamRef has no scalar executor")
+            return _no_scalar, expr.type
         if isinstance(expr, Variable):
             return self.resolver.resolve(expr)
         if isinstance(expr, And):

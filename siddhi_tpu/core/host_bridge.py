@@ -332,6 +332,34 @@ class _HostPartitionRT(_HostRTBase):
 # builders
 # ---------------------------------------------------------------------------
 
+def _app_plan_key(query: Query, stream_defs: dict, kind: str):
+    """Shape-and-constants key for the per-APP plan cache: two queries in
+    one app that lower to the SAME program (identical shape AND identical
+    constants/overrides on the same streams) share one compiled plan —
+    state, stagers and junction wiring stay per query. Cross-app sharing is
+    the fleet's job (per-tenant parameter slots); within one app the
+    constants must match exactly, so the plan needs no slots."""
+    try:
+        from ..fleet.shape import normalize_query
+        nq = normalize_query(query, stream_defs)
+    except Exception:       # noqa: BLE001 — no shape → no dedupe, solo build
+        return None
+    if nq.kind != kind:
+        return None
+    try:
+        return (nq.shape_key, tuple(nq.param_values),
+                tuple(sorted(nq.overrides.items())), tuple(nq.stream_ids))
+    except TypeError:       # unhashable constant — skip dedupe
+        return None
+
+
+def _app_plan_cache(app_context) -> dict:
+    c = getattr(app_context, "_host_plan_cache", None)
+    if c is None:
+        c = app_context._host_plan_cache = {}
+    return c
+
+
 def try_build_host_query(query: Query, app_context, stream_defs: dict,
                          get_junction, name: str,
                          cfg: Optional[dict]) -> Optional[HostQueryBridge]:
@@ -359,8 +387,16 @@ def try_build_host_query(query: Query, app_context, stream_defs: dict,
             if d is None:
                 raise DeviceCompileError(
                     f"undefined stream '{ist.stream_id}'")
-            compiled = CompiledStreamQuery(query, d, backend="numpy")
-            hq = HostStreamQuery(compiled)
+            pkey = _app_plan_key(query, stream_defs, "stream")
+            cache = _app_plan_cache(app_context)
+            shared = cache.get(pkey) if pkey is not None else None
+            if shared is None:
+                compiled = CompiledStreamQuery(query, d, backend="numpy")
+                hq = HostStreamQuery(compiled)
+                if pkey is not None:
+                    cache[pkey] = (compiled, hq)
+            else:
+                compiled, hq = shared
             rt = _HostStreamRT(compiled, hq, batch)
             bridge = HostQueryBridge("host_stream", rt, app_context,
                                      [ist.stream_id], target, name)
@@ -369,9 +405,17 @@ def try_build_host_query(query: Query, app_context, stream_defs: dict,
         elif isinstance(ist, StateInputStream):
             from ..tpu.host_exec import HostBlockNFA
             from ..tpu.nfa import DeviceNFACompiler
-            compiler = DeviceNFACompiler(query, stream_defs,
-                                         backend="numpy")
-            engine = HostBlockNFA(compiler)
+            pkey = _app_plan_key(query, stream_defs, "nfa")
+            cache = _app_plan_cache(app_context)
+            shared = cache.get(pkey) if pkey is not None else None
+            if shared is None:
+                compiler = DeviceNFACompiler(query, stream_defs,
+                                             backend="numpy")
+                engine = HostBlockNFA(compiler)
+                if pkey is not None:
+                    cache[pkey] = (compiler, engine)
+            else:
+                compiler, engine = shared
             rt = _HostNFART(compiler, engine, stream_defs, batch)
             bridge = HostQueryBridge("host_nfa", rt, app_context,
                                      compiler.compiled.stream_ids, target,

@@ -1,0 +1,546 @@
+"""FleetGroup: cross-app lane batching over one shared compiled plan.
+
+Tenant-id is just another partition key (CORE's shared-automaton insight,
+PAPERS.md 2111.04635): same-shape queries from different apps stage into ONE
+SoA micro-batch — each row tagged with its member id — and execute through
+one stepped program per flush:
+
+- **batched lanes** (stateless stream shapes — filters/projections/having):
+  the whole merged batch evaluates in one vectorized step; per-tenant
+  constants are per-row parameter columns gathered from the member table,
+  outputs demultiplex back to each tenant's junction by member id;
+- **sliced lanes** (stateful shapes — windows/aggregates, blocked NFAs,
+  partitioned patterns): one step iterates member segments of the merged
+  batch (stable-sorted, so per-tenant event order is preserved) against
+  per-tenant state and parameter bindings — compilation, staging,
+  dictionary encoding and flush scheduling are shared; state is strictly
+  per tenant.
+
+Isolation: every member owns its state (window tails, NFA tables, lane
+states) and snapshot/restores independently. String dictionaries are shared
+per group (codes must be comparable across lanes); they are append-only, so
+a member restore treats the dictionary monotonically — it never shrinks the
+shared table under other tenants.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ..core.event import Event, EventType, StreamEvent
+from ..query_api.definition import DataType
+from ..tpu.backend import NP_HOST
+from ..tpu.host_exec import HostRowStager, decode_columns
+
+log = logging.getLogger("siddhi_tpu.fleet")
+
+
+# ---------------------------------------------------------------------------
+# small state helpers
+# ---------------------------------------------------------------------------
+
+def copy_state_tree(v):
+    if isinstance(v, np.ndarray):
+        return v.copy()
+    if isinstance(v, dict):
+        return {k: copy_state_tree(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [copy_state_tree(x) for x in v]
+    return v
+
+
+def restore_dicts_monotonic(dictionaries: dict, snap: dict) -> None:
+    """Per-tenant dictionary restore against a SHARED table.
+
+    Dictionary codes are append-only and stable, so a snapshot's value list
+    is a prefix of any later state of the same table. Restoring one tenant
+    must not shrink the shared table under the others: apply the snapshot
+    only when it EXTENDS the current table (fresh process), skip when the
+    current table is already a superset, and log a conflict otherwise
+    (mixing snapshots from different fleet generations)."""
+    for name, values in snap.items():
+        d = dictionaries.get(name)
+        if d is None:
+            continue
+        cur = d.snapshot()
+        if len(values) > len(cur) and cur == values[:len(cur)]:
+            d.restore(values)          # extends the live table (fresh process)
+        elif values != cur[:len(values)]:
+            # conflicting generation: NEVER rewrite the shared table under
+            # live co-tenants (their state carries codes of the live table);
+            # this tenant's restore proceeds against the live codes and the
+            # conflict is loud — restore whole-fleet checkpoints from one
+            # generation when reviving a fresh process
+            log.warning("fleet dictionary snapshot for '%s' conflicts with "
+                        "the live shared table; keeping the live table "
+                        "(mixing snapshot generations across tenants?)",
+                        name)
+
+
+def _param_dtype(spec):
+    if spec.string:
+        return NP_HOST[DataType.STRING]
+    return NP_HOST[spec.type]
+
+
+def bind_param_values(specs, values, dictionaries) -> list:
+    """Tenant constants → numpy scalars in plan dtypes; strings encode to
+    codes against the group's shared dictionary."""
+    out = []
+    for spec, v in zip(specs, values):
+        if spec.string:
+            dic = None
+            for d in dictionaries.values():
+                dic = d
+                break
+            if dic is None:
+                raise ValueError(
+                    "string parameter with no dictionary column in the plan")
+            out.append(np.int32(dic.encode(v)))
+        else:
+            out.append(_param_dtype(spec)(0 if v is None else v))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# staging: the shared stager + member-id lane column
+# ---------------------------------------------------------------------------
+
+class FleetStager(HostRowStager):
+    """HostRowStager that tags every staged row with its member id."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._mid: list[int] = []
+
+    def stage_event(self, mid: int, sid: str, data, ts: int) -> None:
+        self.append(sid, data, ts)
+        self._mid.append(mid)
+
+    def stage_events(self, mid: int, sid: str, events: list) -> None:
+        self.append_events(sid, events)
+        self._mid.extend([mid] * len(events))
+
+    def stage_rows(self, mid: int, sid: str, rows: list, timestamps) -> None:
+        self.append_rows(sid, rows, timestamps)
+        self._mid.extend([mid] * len(rows))
+
+    def emit(self) -> dict:
+        b = super().emit()
+        b["mid"] = np.asarray(self._mid, dtype=np.int64)
+        self._mid = []
+        return b
+
+
+# ---------------------------------------------------------------------------
+# members
+# ---------------------------------------------------------------------------
+
+class FleetMember:
+    def __init__(self, mid: int, tenant: str, query_name: str, app_context,
+                 output_junction, params: list, overrides: dict,
+                 local_sids: list):
+        self.mid = mid
+        self.tenant = tenant
+        self.query_name = query_name
+        self.app_context = app_context
+        self.output_junction = output_junction
+        self.params = params
+        self.overrides = overrides
+        self.local_sids = local_sids
+        self.state: Any = None
+        self.prt = None                # partition kind runtime
+        self.bridge: Optional["FleetQueryBridge"] = None
+        self.events_in = 0
+        self.batches = 0
+        self.attached_at = time.monotonic()
+
+    @property
+    def ev_per_s(self) -> float:
+        dt = time.monotonic() - self.attached_at
+        return self.events_in / dt if dt > 0 else 0.0
+
+
+class FleetQueryBridge:
+    """The app-facing face of one fleet member: junction receivers in, demuxed
+    outputs back to the member's own output junction. Mirrors the host-bridge
+    surface (``query_name`` / ``stream_ids`` / ``flush`` / ``finalize`` /
+    ``query_callbacks`` / ``report``) so the app runtime treats fleet and
+    solo columnar queries uniformly."""
+
+    kind = "fleet"
+
+    def __init__(self, group: "FleetGroup", member: FleetMember):
+        self.group = group
+        self.member = member
+        member.bridge = self
+        self.query_name = member.query_name
+        self.stream_ids = list(member.local_sids)
+        self.query_callbacks: list = []
+        self.output_schema = group.output_schema
+
+    # -- junction receivers ----------------------------------------------
+    def receiver_for(self, stream_id: str):
+        group = self.group
+        member = self.member
+        gsid = group.sids[member.local_sids.index(stream_id)]
+
+        class _R:
+            def receive(self, event: StreamEvent) -> None:
+                if event.type is not EventType.CURRENT:
+                    return
+                group.stage_event(member, gsid, event.data, event.timestamp)
+
+            def receive_chunk(self, events: list) -> None:
+                if any(e.type is not EventType.CURRENT for e in events):
+                    events = [e for e in events
+                              if e.type is EventType.CURRENT]
+                    if not events:
+                        return
+                group.stage_events(member, gsid, events)
+
+            def receive_rows(self, rows: list, timestamps) -> None:
+                group.stage_rows(member, gsid, rows, timestamps)
+
+        return _R()
+
+    # -- drain ------------------------------------------------------------
+    def flush(self, cause: str = "drain") -> None:
+        self.group.flush(cause)
+
+    def finalize(self) -> None:
+        self.group.flush("final")
+
+    # -- demuxed output ---------------------------------------------------
+    def deliver(self, ts_list: list, rows: list) -> None:
+        if not rows:
+            return
+        events = [StreamEvent(ts, row, EventType.CURRENT)
+                  for ts, row in zip(ts_list, rows)]
+        if self.query_callbacks:
+            evs = [Event(e.timestamp, e.data) for e in events]
+            for cb in self.query_callbacks:
+                cb.receive(events[-1].timestamp, evs, None)
+        if self.member.output_junction is not None:
+            self.member.output_junction.send_events(events)
+
+    def report(self) -> dict:
+        return {"query": self.query_name, "engine": "fleet",
+                "kind": self.group.kind, "shape": self.group.shape_key,
+                "mode": self.group.mode, "events": self.member.events_in,
+                "batches": self.member.batches,
+                "members": len(self.group.members)}
+
+
+class FleetMemberState:
+    """Per-tenant snapshot adapter (registered in the member app's state
+    registry): flushes the GROUP (staged rows of any tenant resolve before
+    the state walk), then snapshots only this member's state plus the shared
+    dictionary tables its codes decode through."""
+
+    def __init__(self, group: "FleetGroup", member: FleetMember):
+        self.group = group
+        self.member = member
+
+    def snapshot_state(self):
+        self.group.flush("snapshot")
+        return {"state": copy_state_tree(self.group.member_state(self.member)),
+                "dict": self.group.snapshot_dictionaries()}
+
+    def restore_state(self, snap):
+        self.group.flush("restore")
+        restore_dicts_monotonic(self.group.dictionaries,
+                                snap.get("dict", {}))
+        self.group.restore_member_state(self.member,
+                                        copy_state_tree(snap["state"]))
+
+
+# ---------------------------------------------------------------------------
+# the group
+# ---------------------------------------------------------------------------
+
+class FleetGroup:
+    """All tenants of one shape on the columnar backend: shared plan, shared
+    stager, one stepped program per flush."""
+
+    def __init__(self, shape_key: str, kind: str, plan, cfg: dict,
+                 sids: list, stream_defs: dict, param_specs: list):
+        self.shape_key = shape_key
+        self.kind = kind              # 'stream' | 'nfa' | 'partition'
+        self.plan = plan
+        self.cfg = cfg
+        self.sids = list(sids)        # canonical (builder tenant) stream ids
+        self.param_specs = param_specs
+        self.capacity = int(cfg.get("batch", 8192))
+        self.members: dict[int, FleetMember] = {}
+        self._next_mid = 0
+        self._luts = None             # param LUT cache (membership-keyed)
+        self._lock = threading.RLock()
+        self.steps = 0
+        self.lanes_last_step = 0
+        self.events_in = 0
+        self.flush_causes: dict[str, int] = {}
+        if kind == "stream":
+            self.schema = plan.compiled.schema
+            self.stager = FleetStager(self.schema, None, self.capacity)
+            # stateless shapes take the fully-batched lane path (one
+            # vectorized step across every tenant's rows)
+            self.mode = "batched" if plan.stateless else "sliced"
+            self.output_schema = ([s.name for s in plan.compiled.specs],
+                                  [s.dtype for s in plan.compiled.specs])
+        else:
+            self.schema = plan.compiler.merged
+            self.stager = FleetStager(self.schema, dict(stream_defs),
+                                      self.capacity,
+                                      used_cols=plan.compiler.used_cols)
+            self.mode = "sliced"
+            self.output_schema = (
+                [n for n, _, _ in plan.compiler.out_specs],
+                [t for _, _, t in plan.compiler.out_specs])
+
+    # -- dictionaries ------------------------------------------------------
+    @property
+    def dictionaries(self) -> dict:
+        return self.schema.dictionaries
+
+    def snapshot_dictionaries(self) -> dict:
+        return self.schema.snapshot_dictionaries()
+
+    # -- membership --------------------------------------------------------
+    def add_member(self, tenant: str, query_name: str, app_context,
+                   output_junction, param_values: list, overrides: dict,
+                   local_sids: list) -> FleetMember:
+        with self._lock:
+            mid = self._next_mid
+            self._next_mid += 1
+            params = bind_param_values(self.param_specs, param_values,
+                                       self.dictionaries)
+            m = FleetMember(mid, tenant, query_name, app_context,
+                            output_junction, params, overrides, local_sids)
+            m.state = self._init_member_state(m)
+            self.members[mid] = m
+            self._luts = None
+            return m
+
+    def remove_member(self, member: FleetMember) -> int:
+        """Drains the group, detaches the member; returns members left."""
+        with self._lock:
+            self.flush("member-leave")
+            self.members.pop(member.mid, None)
+            self._luts = None
+            return len(self.members)
+
+    def _init_member_state(self, m: FleetMember):
+        ov = m.overrides
+        if self.kind == "stream":
+            st = self.plan.hq.init_state()
+            for k in ("window_n", "window_ms"):
+                if k in ov:
+                    st[k] = ov[k]
+            return st
+        if self.kind == "nfa":
+            st = self.plan.engine.init_state()
+            if "within" in ov:
+                st["within"] = ov["within"]
+            return st
+        # partition: a per-member lane runtime over the SHARED engine
+        from ..tpu.host_exec import HostPartitionedNFA
+        m.prt = HostPartitionedNFA(
+            None, self.plan.stream_defs, self.plan.key_attr,
+            num_partitions=int(self.cfg.get("lanes", 16)),
+            compiler=self.plan.compiler, engine=self.plan.engine)
+        if "within" in ov:
+            for st in m.prt.lane_states:
+                st["within"] = ov["within"]
+        return None
+
+    # -- per-member state (snapshot isolation) -----------------------------
+    def member_state(self, m: FleetMember):
+        if self.kind == "partition":
+            return m.prt.snapshot_state()
+        if self.kind == "nfa":
+            return {"tables": m.state["tables"],
+                    "matches": m.state["matches"]}
+        return m.state
+
+    def restore_member_state(self, m: FleetMember, state) -> None:
+        ov = m.overrides
+        if self.kind == "partition":
+            m.prt.restore_state(state)
+            if "within" in ov:
+                for st in m.prt.lane_states:
+                    st["within"] = ov["within"]
+            return
+        if self.kind == "nfa":
+            st = {"tables": {k: {f: np.asarray(v) for f, v in t.items()}
+                             for k, t in state["tables"].items()},
+                  "matches": state["matches"]}
+            if "within" in ov:
+                st["within"] = ov["within"]
+            m.state = st
+            return
+        st = dict(state)
+        for k in ("window_n", "window_ms"):
+            if k in ov:
+                st[k] = ov[k]
+        m.state = st
+
+    # -- staging -----------------------------------------------------------
+    def stage_event(self, m: FleetMember, gsid: str, data, ts: int) -> None:
+        with self._lock:
+            self.stager.stage_event(m.mid, gsid, data, ts)
+            if self.stager.full:
+                self._step("full")
+
+    def stage_events(self, m: FleetMember, gsid: str, events: list) -> None:
+        with self._lock:
+            self.stager.stage_events(m.mid, gsid, events)
+            if self.stager.full:
+                self._step("full")
+
+    def stage_rows(self, m: FleetMember, gsid: str, rows, timestamps) -> None:
+        with self._lock:
+            self.stager.stage_rows(m.mid, gsid, rows, timestamps)
+            if self.stager.full:
+                self._step("full")
+
+    def flush(self, cause: str = "drain") -> None:
+        with self._lock:
+            if len(self.stager):
+                self._step(cause)
+
+    # -- the stepped program ----------------------------------------------
+    def _param_luts(self) -> list:
+        """Member-id → value lookup tables, one per parameter slot — cached
+        (membership changes only under the group lock, which invalidates)."""
+        luts = self._luts
+        if luts is None:
+            width = max(self._next_mid, 1)
+            luts = []
+            for i, spec in enumerate(self.param_specs):
+                lut = np.zeros(width, dtype=_param_dtype(spec))
+                for m in self.members.values():
+                    lut[m.mid] = m.params[i]
+                luts.append(lut)
+            self._luts = luts
+        return luts
+
+    def _param_cols_for(self, mids: np.ndarray) -> dict:
+        """Per-row parameter columns: value table gathered by member id."""
+        if not self.param_specs:
+            return {}
+        return {f"__fleet_p{spec.index}": lut[mids]
+                for spec, lut in zip(self.param_specs, self._param_luts())}
+
+    def _inject_member_params(self, cols: dict, m: FleetMember,
+                              n: int) -> None:
+        for spec, val in zip(self.param_specs, m.params):
+            cols[f"__fleet_p{spec.index}"] = np.full(
+                n, val, dtype=_param_dtype(spec))
+
+    def _step(self, cause: str) -> None:
+        b = self.stager.emit()
+        n = b["count"]
+        if n == 0:
+            return
+        self.steps += 1
+        self.events_in += n
+        self.flush_causes[cause] = self.flush_causes.get(cause, 0) + 1
+        mids = b["mid"]
+        with np.errstate(all="ignore"):
+            if self.mode == "batched":
+                self._step_batched(b, mids)
+            else:
+                self._step_sliced(b, mids)
+
+    def _step_batched(self, b: dict, mids: np.ndarray) -> None:
+        """One vectorized step over every tenant's rows at once (stateless
+        stream shapes): per-tenant constants ride as gathered per-row
+        parameter columns; outputs demux by member id."""
+        cols = dict(b["cols"])
+        cols.update(self._param_cols_for(mids))
+        _st, res = self.plan.hq.step({}, cols, b["ts"])
+        involved = np.unique(mids)
+        self.lanes_last_step = involved.size
+        for mid in involved.tolist():
+            m = self.members.get(int(mid))
+            if m is not None:
+                m.events_in += int(np.sum(mids == mid))
+                m.batches += 1
+        j = res.get("j")
+        if j is None or j.size == 0:
+            return
+        ts_list, rows = self.plan.hq.decode(res)       # batched decode
+        out_mid = mids[j]
+        order = np.argsort(out_mid, kind="stable")
+        sorted_mid = out_mid[order]
+        starts = np.r_[0, np.nonzero(np.diff(sorted_mid))[0] + 1,
+                       sorted_mid.size]
+        for si in range(starts.size - 1):
+            lo, hi = int(starts[si]), int(starts[si + 1])
+            if lo == hi:
+                continue
+            m = self.members.get(int(sorted_mid[lo]))
+            if m is None or m.bridge is None:
+                continue              # member left with rows in flight
+            idx = order[lo:hi]
+            m.bridge.deliver([ts_list[i] for i in idx],
+                             [rows[i] for i in idx])
+
+    def _step_sliced(self, b: dict, mids: np.ndarray) -> None:
+        """One step iterating member lanes of the merged batch (stateful
+        shapes): stable member segments preserve per-tenant event order."""
+        order = np.argsort(mids, kind="stable")
+        sorted_mid = mids[order]
+        starts = np.r_[0, np.nonzero(np.diff(sorted_mid))[0] + 1,
+                       sorted_mid.size]
+        lanes = 0
+        cols_all = b["cols"]
+        for si in range(starts.size - 1):
+            lo, hi = int(starts[si]), int(starts[si + 1])
+            if lo == hi:
+                continue
+            m = self.members.get(int(sorted_mid[lo]))
+            if m is None:
+                continue
+            lanes += 1
+            idx = order[lo:hi]
+            nseg = idx.size
+            cols_m = {k: v[idx] for k, v in cols_all.items()}
+            self._inject_member_params(cols_m, m, nseg)
+            ts_m = b["ts"][idx]
+            m.events_in += nseg
+            m.batches += 1
+            if self.kind == "stream":
+                m.state, res = self.plan.hq.step(m.state, cols_m, ts_m)
+                ts_list, rows = self.plan.hq.decode(res)
+                m.bridge.deliver(ts_list, rows)
+            elif self.kind == "nfa":
+                tag_m = b["tag"][idx]
+                m.state, outs = self.plan.engine.step(
+                    m.state, cols_m, tag_m, ts_m)
+                if outs and outs["j"].size:
+                    rows = decode_columns(self.plan.engine.out_specs, outs,
+                                          self.dictionaries)
+                    m.bridge.deliver(outs["ts"].tolist(), rows)
+            else:                      # partition
+                _j, outs = m.prt.process(
+                    {"cols": cols_m, "ts": ts_m, "count": nseg})
+                if outs:
+                    m.bridge.deliver(outs["ts"].tolist(),
+                                     m.prt.decode(outs))
+        self.lanes_last_step = lanes
+
+    def report(self) -> dict:
+        with self._lock:
+            return {"shape": self.shape_key, "kind": self.kind,
+                    "mode": self.mode, "members": len(self.members),
+                    "steps": self.steps, "events": self.events_in,
+                    "lanes_last_step": self.lanes_last_step,
+                    "staged": len(self.stager),
+                    "flush_causes": dict(self.flush_causes)}

@@ -1,0 +1,289 @@
+"""FleetManager: cross-app enrollment, the shared plan cache, metrics.
+
+One FleetManager per :class:`~siddhi_tpu.core.context.SiddhiContext` (i.e.
+per SiddhiManager): ``@app:fleet`` apps enroll their queries here at build
+time. Enrollment normalizes the query (``shape.py``), resolves the shape's
+compiled plan through the plan cache (one compile per shape per backend),
+and joins the shape's :class:`~siddhi_tpu.fleet.group.FleetGroup` as a new
+tenant lane. Anything that does not normalize or lower falls back PER QUERY
+to the existing solo paths (device / columnar host / scalar interpreter) —
+one exotic tenant never poisons the fleet.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+from ..query_api import Query, SingleInputStream, StateInputStream
+from ..query_api.annotation import find_annotation
+from ..tpu.expr_compile import DeviceCompileError
+from .cache import PlanCache
+from .group import FleetGroup, FleetMemberState, FleetQueryBridge
+from .shape import (
+    FleetShapeError,
+    NormalizedQuery,
+    normalize_partition_query,
+    normalize_query,
+)
+
+log = logging.getLogger("siddhi_tpu.fleet")
+
+_DEF_BATCH = 8192
+_DEF_LANES = 16
+
+
+def fleet_config(app_annotations) -> Optional[dict]:
+    """App-level opt-in (``@app:fleet`` or SIDDHI_FLEET=1) → config dict."""
+    ann = find_annotation(app_annotations, "fleet")
+    if ann is None and os.environ.get("SIDDHI_FLEET", "") != "1":
+        return None
+    cfg = {"batch": _DEF_BATCH, "lanes": _DEF_LANES}
+    if ann is not None:
+        if ann.get("enable") and ann.get("enable").lower() == "false":
+            return None
+        if ann.get("batch"):
+            cfg["batch"] = int(ann.get("batch"))
+        if ann.get("lanes"):
+            cfg["lanes"] = int(ann.get("lanes"))
+        if ann.get("cache"):
+            cfg["cache"] = int(ann.get("cache"))
+    return cfg
+
+
+class _StreamPlan:
+    """Shared columnar plan for a single-stream shape."""
+
+    def __init__(self, normalized: NormalizedQuery, stream_defs: dict):
+        from ..tpu.host_exec import HostStreamQuery
+        from ..tpu.query_compile import CompiledStreamQuery
+        ist = normalized.query.input_stream
+        d = stream_defs.get(ist.stream_id)
+        if d is None:
+            raise DeviceCompileError(f"undefined stream '{ist.stream_id}'")
+        self.compiled = CompiledStreamQuery(normalized.query, d,
+                                            backend="numpy")
+        self.hq = HostStreamQuery(self.compiled)
+        self.stateless = self.hq.init_state() == {}
+
+
+class _NFAPlan:
+    """Shared columnar plan for a pattern/sequence shape."""
+
+    def __init__(self, normalized: NormalizedQuery, stream_defs: dict):
+        from ..tpu.host_exec import HostBlockNFA
+        from ..tpu.nfa import DeviceNFACompiler
+        self.compiler = DeviceNFACompiler(normalized.query, dict(stream_defs),
+                                          backend="numpy")
+        self.engine = HostBlockNFA(self.compiler)
+
+
+class _PartitionPlan:
+    """Shared columnar plan for a partitioned-pattern shape (key equality
+    injected, so lane-local NFA semantics are per key)."""
+
+    def __init__(self, normalized: NormalizedQuery, stream_defs: dict):
+        from ..tpu.host_exec import HostBlockNFA
+        from ..tpu.nfa import DeviceNFACompiler
+        from ..tpu.partition import _inject_key_equality
+        self.key_attr = normalized.overrides["key_attr"]
+        query = _inject_key_equality(normalized.query, self.key_attr)
+        self.compiler = DeviceNFACompiler(query, dict(stream_defs),
+                                          backend="numpy")
+        if len(self.compiler.merged.stream_ids) != 1:
+            raise DeviceCompileError(
+                "partitioned fleet shapes cover single-stream patterns")
+        self.engine = HostBlockNFA(self.compiler)
+        self.stream_defs = dict(stream_defs)
+
+
+class FleetManager:
+    def __init__(self, cache_size: int = 256):
+        self.plan_cache = PlanCache(cache_size)
+        self.groups: dict[str, FleetGroup] = {}
+        self._lock = threading.RLock()
+        self.fallbacks = 0
+        self.enrolled = 0
+
+    # ------------------------------------------------------------------ enroll
+    def enroll_query(self, query: Query, app_context, stream_defs: dict,
+                     get_junction, name: str,
+                     cfg: dict) -> Optional[FleetQueryBridge]:
+        """Fleet bridge for one top-level query, or None → solo paths."""
+        if "cache" in cfg:
+            # a tenant annotation may only GROW the engine-wide cache —
+            # shrinking it would let one app evict co-tenants' cached plans
+            # (operators resize downward via manager.fleet.plan_cache)
+            self.plan_cache.max_entries = max(self.plan_cache.max_entries,
+                                              int(cfg["cache"]))
+        try:
+            normalized = normalize_query(query, stream_defs)
+        except FleetShapeError as e:
+            self.fallbacks += 1
+            log.info("query '%s' keeps the solo path (no fleet shape): %s",
+                     name, e)
+            return None
+        return self._join(normalized, query, app_context, stream_defs,
+                          get_junction, name, cfg)
+
+    def enroll_partition(self, partition_ast, app_context, stream_defs: dict,
+                         get_junction, name: str,
+                         cfg: dict) -> Optional[list]:
+        """Fleet bridges for a ``partition with`` block of pattern queries —
+        all-or-nothing per block (mirrors the solo columnar partition
+        contract); None → the per-key interpreter / solo columnar path."""
+        if "cache" in cfg:
+            # a tenant annotation may only GROW the engine-wide cache —
+            # shrinking it would let one app evict co-tenants' cached plans
+            # (operators resize downward via manager.fleet.plan_cache)
+            self.plan_cache.max_entries = max(self.plan_cache.max_entries,
+                                              int(cfg["cache"]))
+        plans = []
+        try:
+            for i, q in enumerate(partition_ast.queries):
+                qname = q.name() or f"{name}-query-{i}"
+                normalized = normalize_partition_query(partition_ast, q,
+                                                       stream_defs)
+                plans.append((normalized, q, qname))
+        except FleetShapeError as e:
+            self.fallbacks += 1
+            log.info("partition '%s' keeps the solo path (no fleet shape): "
+                     "%s", name, e)
+            return None
+        bridges = []
+        for normalized, q, qname in plans:
+            bridge = self._join(normalized, q, app_context, stream_defs,
+                                get_junction, qname, cfg)
+            if bridge is None:
+                for b in bridges:      # roll back partial joins
+                    self.release_member(b)
+                return None
+            bridges.append(bridge)
+        return bridges
+
+    def _join(self, normalized: NormalizedQuery, query: Query, app_context,
+              stream_defs: dict, get_junction, name: str,
+              cfg: dict) -> Optional[FleetQueryBridge]:
+        from ..core.host_bridge import _audit_query_surface
+        try:
+            target = _audit_query_surface(query, app_context, get_junction)
+            with self._lock:
+                group = self.groups.get(normalized.shape_key)
+                if group is None:
+                    entry = self.plan_cache.get(
+                        normalized.shape_key, "numpy",
+                        lambda: self._build_plan(normalized, stream_defs))
+                    group = FleetGroup(
+                        normalized.shape_key, normalized.kind, entry.plan,
+                        cfg, normalized.stream_ids, stream_defs,
+                        normalized.param_specs)
+                    self.groups[normalized.shape_key] = group
+                    self.plan_cache.pin(normalized.shape_key, "numpy")
+                else:
+                    self.plan_cache.get(
+                        normalized.shape_key, "numpy",
+                        lambda: group.plan)        # count the shape-cache hit
+        except DeviceCompileError as e:
+            self.fallbacks += 1
+            log.info("query '%s' keeps the solo path (shape does not "
+                     "lower): %s", name, e)
+            return None
+        # local_sids are THIS tenant's stream ids in canonical walk order;
+        # receiver_for maps them positionally onto the group's canonical
+        # (builder tenant) ids — positions align because both tenants walked
+        # the same shape
+        member = group.add_member(
+            app_context.name, name, app_context, target,
+            normalized.param_values, normalized.overrides,
+            list(normalized.stream_ids))
+        bridge = FleetQueryBridge(group, member)
+        app_context.register_state(f"fleet-{name}",
+                                   FleetMemberState(group, member))
+        self._register_metrics(app_context, group, member)
+        self.enrolled += 1
+        return bridge
+
+    def _build_plan(self, normalized: NormalizedQuery, stream_defs: dict):
+        if normalized.kind == "stream":
+            return _StreamPlan(normalized, stream_defs)
+        if normalized.kind == "nfa":
+            return _NFAPlan(normalized, stream_defs)
+        return _PartitionPlan(normalized, stream_defs)
+
+    # -------------------------------------------------------------- device tier
+    def device_plan(self, normalized: NormalizedQuery, stream_defs: dict):
+        """Shared DEVICE (jit) program for a shape — same cache, backend
+        'jax'. N homogeneous tenants cost one trace/compile; per-tenant
+        constants are injected as ``__fleet_p*`` batch columns."""
+        def build():
+            if normalized.kind == "stream":
+                from ..tpu.query_compile import CompiledStreamQuery
+                ist = normalized.query.input_stream
+                return CompiledStreamQuery(normalized.query,
+                                           stream_defs[ist.stream_id])
+            from ..tpu.nfa import DeviceNFACompiler
+            query = normalized.query
+            if normalized.kind == "partition":
+                from ..tpu.partition import _inject_key_equality
+                query = _inject_key_equality(
+                    query, normalized.overrides["key_attr"])
+            return DeviceNFACompiler(query, dict(stream_defs))
+
+        return self.plan_cache.get(normalized.shape_key, "jax", build).plan
+
+    # ---------------------------------------------------------------- teardown
+    def release_member(self, bridge: FleetQueryBridge) -> None:
+        group = bridge.group
+        with self._lock:
+            left = group.remove_member(bridge.member)
+            if left == 0:
+                self.groups.pop(group.shape_key, None)
+                self.plan_cache.unpin(group.shape_key, "numpy")
+
+    def release_app(self, app_name: str) -> int:
+        """Detach every member of one tenant app (app shutdown); the shared
+        plans stay cached (unpinned when their group empties) for the next
+        tenant of the shape. Returns members released."""
+        released = 0
+        with self._lock:
+            for group in list(self.groups.values()):
+                for m in [m for m in group.members.values()
+                          if m.app_context.name == app_name]:
+                    self.release_member(m.bridge)
+                    released += 1
+        return released
+
+    # ----------------------------------------------------------------- metrics
+    def _register_metrics(self, app_context, group: FleetGroup,
+                          member) -> None:
+        sm = app_context.statistics_manager
+        if sm is None:
+            return
+        q = member.query_name
+        sm.gauge_tracker(f"fleet.{q}.events", lambda m=member: m.events_in)
+        sm.gauge_tracker(f"fleet.{q}.batches", lambda m=member: m.batches)
+        sm.gauge_tracker(f"fleet.{q}.ev_per_s", lambda m=member: m.ev_per_s)
+        sm.gauge_tracker(f"fleet.{q}.lanes_per_step",
+                         lambda g=group: g.lanes_last_step)
+        sm.gauge_tracker(f"fleet.{q}.group_members",
+                         lambda g=group: len(g.members))
+        # shape-cache counters surface per app so one tenant's scrape sees
+        # fleet-wide compile amortization
+        sm.gauge_tracker("fleet.shape_cache.hits",
+                         lambda c=self.plan_cache: c.hits)
+        sm.gauge_tracker("fleet.shape_cache.misses",
+                         lambda c=self.plan_cache: c.misses)
+        sm.gauge_tracker("fleet.shape_cache.evictions",
+                         lambda c=self.plan_cache: c.evictions)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"cache": self.plan_cache.stats(),
+                    "groups": {k: g.report()
+                               for k, g in self.groups.items()},
+                    "members": sum(len(g.members)
+                                   for g in self.groups.values()),
+                    "enrolled": self.enrolled,
+                    "fallbacks": self.fallbacks}

@@ -43,6 +43,26 @@ class DeviceCompileError(Exception):
     """Raised when an expression cannot run on the device path (host fallback)."""
 
 
+class ParamRef(Expression):
+    """A per-tenant parameter slot (fleet shared compilation).
+
+    Stands where a ``Constant`` stood in a normalized query: the compiled
+    closure reads the value from the batch env under :attr:`key` — injected
+    at step time as a scalar or a per-row column — so ONE compiled program
+    serves every tenant of the shape, each with its own constants. String
+    params carry dictionary CODES, encoded at bind time against the shared
+    plan schema (``fleet/shape.py`` hoists the constants; ``fleet/group.py``
+    binds and injects them)."""
+
+    def __init__(self, index: int, type: DataType):
+        self.index = index
+        self.type = type
+
+    @property
+    def key(self) -> str:
+        return f"__fleet_p{self.index}"
+
+
 _NUM_ORDER = [DataType.INT, DataType.LONG, DataType.FLOAT, DataType.DOUBLE]
 
 
@@ -102,6 +122,13 @@ def compile_expression(expr: Expression, resolver: ColumnResolver
                        ) -> tuple[Callable[[dict], jnp.ndarray], DataType]:
     """Returns (fn(cols)->array [B], result dtype) on the resolver's backend."""
     xp = resolver_xp(resolver)
+
+    if isinstance(expr, ParamRef):
+        # resolvers with a prefixed env namespace (the NFA's ev_ columns)
+        # override where the injected slot lands
+        key_fn = getattr(resolver, "param_key", None)
+        key = key_fn(expr) if key_fn is not None else expr.key
+        return (lambda cols, key=key: cols[key]), expr.type
 
     if isinstance(expr, Constant):
         if expr.type == DataType.STRING:

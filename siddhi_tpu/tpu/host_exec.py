@@ -338,6 +338,9 @@ class HostBlockNFA:
     def _step(self, state: dict, cols: dict, tag: np.ndarray,
               ts: np.ndarray) -> tuple[dict, dict]:
         n = ts.shape[0]
+        # per-tenant override (fleet shared plans): `within N` is a runtime
+        # parameter of the shape, carried in the state dict
+        within = state.get("within", self.within)
         tables = state["tables"]
         ev_env = {f"ev_{k}": v for k, v in cols.items()}
         jidx = np.arange(n, dtype=np.int64)
@@ -376,7 +379,7 @@ class HostBlockNFA:
             for (name, fn, t) in self.out_specs:
                 out[name] = np.broadcast_to(
                     np.asarray(fn(emit_env)), (seed.size,)).astype(NP_HOST[t])
-            return {"tables": tables,
+            return {**state, "tables": tables,
                     "matches": state["matches"] + int(seed.size)}, out
 
         seed_bf, seed_bi = self._seed_slabs(cols, seed)
@@ -448,9 +451,9 @@ class HostBlockNFA:
                         np.asarray(st.predicate(env)), (g, m))
                 else:
                     grid = np.ones((g, m), bool)
-                if self.within is not None:
+                if within is not None:
                     grid = grid & ((ts_g[:, None] - cand_first[None, :])
-                                   <= self.within)
+                                   <= within)
                 if st.within_ms is not None:
                     grid = grid & ((ts_g[:, None] - cand_last[None, :])
                                    <= st.within_ms)
@@ -507,8 +510,8 @@ class HostBlockNFA:
 
             # survivors (no capacity truncation on the host)
             surv = ~adv
-            if self.within is not None and n:
-                surv &= (ts_last - cand_first) <= self.within
+            if within is not None and n:
+                surv &= (ts_last - cand_first) <= within
             if st.within_ms is not None and n:
                 surv &= (ts_last - cand_last) <= st.within_ms
             if self.is_seq:
@@ -521,7 +524,7 @@ class HostBlockNFA:
                 ntbl["last_ts"] = cand_last[sidx]
             new_tables[f"t{s}"] = ntbl
 
-        return {"tables": new_tables, "matches": matches}, out
+        return {**state, "tables": new_tables, "matches": matches}, out
 
     # -- snapshots -------------------------------------------------------
     def snapshot_state(self, state: dict) -> dict:
@@ -547,17 +550,24 @@ class HostPartitionedNFA:
     """
 
     def __init__(self, query, stream_defs: dict, key_attr: str,
-                 num_partitions: int = 32, query_index: int = 0):
-        from .nfa import DeviceNFACompiler
-        from .partition import _inject_key_equality
-        query = _inject_key_equality(query, key_attr)
-        self.compiler = DeviceNFACompiler(
-            query, dict(stream_defs), backend="numpy")
+                 num_partitions: int = 32, query_index: int = 0,
+                 compiler=None, engine=None):
+        # a prebuilt (compiler, engine) pair shares ONE compiled plan across
+        # runtimes (fleet shared compilation) — the caller already injected
+        # the key-equality rewrite; otherwise compile from the query AST
+        if compiler is None:
+            from .nfa import DeviceNFACompiler
+            from .partition import _inject_key_equality
+            query = _inject_key_equality(query, key_attr)
+            compiler = DeviceNFACompiler(
+                query, dict(stream_defs), backend="numpy")
+        self.compiler = compiler
         if len(self.compiler.merged.stream_ids) != 1:
             raise DeviceCompileError(
                 "partitioned columnar host path covers single-stream "
                 "patterns")
-        self.engine = HostBlockNFA(self.compiler)
+        self.engine = engine if engine is not None \
+            else HostBlockNFA(self.compiler)
         self.P = max(1, int(num_partitions))
         self.key_attr = key_attr
         sid = self.compiler.merged.stream_ids[0]
@@ -703,7 +713,8 @@ class HostStreamQuery:
     # -- step ------------------------------------------------------------
     def step(self, state: dict, cols: dict, ts: np.ndarray
              ) -> tuple[dict, dict]:
-        """→ (state, {"ts": [k], "out": {name: [k]}}) for accepted events."""
+        """→ (state, {"ts": [k], "out": {name: [k]}, "j": [k] row index})
+        for accepted events."""
         cols = dict(cols)
         cols["__ts__"] = ts
         n = ts.shape[0]
@@ -714,6 +725,7 @@ class HostStreamQuery:
             k = int(mask.sum())
             if k == n:                       # nothing rejected: no compaction
                 ccols, cts = cols, ts
+                keep = np.arange(n, dtype=np.int64)
             else:
                 keep = np.nonzero(mask)[0]
                 ccols = {kk: np.asarray(v)[keep] if np.ndim(v) else v
@@ -734,10 +746,20 @@ class HostStreamQuery:
                 state = self._aggregate(state, ccols, cts, wts, k, out)
             hv = self.c.having_fn
             if hv is not None and k:
-                hmask = np.broadcast_to(np.asarray(hv(out)), (k,)).astype(bool)
+                # fleet param slots are visible to the having program too
+                # (hoisted constants in `having` clauses): compacted per-row
+                # param columns merge under the output columns
+                hv_env = out
+                pkeys = [kk for kk in ccols if kk.startswith("__fleet_p")]
+                if pkeys:
+                    hv_env = {**{kk: np.asarray(ccols[kk]) for kk in pkeys},
+                              **out}
+                hmask = np.broadcast_to(np.asarray(hv(hv_env)),
+                                        (k,)).astype(bool)
                 out = {nm: v[hmask] for nm, v in out.items()}
                 cts = cts[hmask]
-        return state, {"ts": cts, "out": out}
+                keep = keep[hmask]
+        return state, {"ts": cts, "out": out, "j": keep}
 
     # -- aggregation paths ----------------------------------------------
     def _args(self, lanes, ccols, k, dt):
@@ -783,6 +805,10 @@ class HostStreamQuery:
 
     def _window_agg(self, state, av_f, av_i, av_m, wts, k, out) -> dict:
         c = self.c
+        # per-tenant overrides (fleet shared plans): window sizes are runtime
+        # parameters of the shape, carried in the state dict
+        N = state.get("window_n", self.N)
+        W = state.get("window_ms", self.W)
         z_ts_raw = np.concatenate([state["tail_ts"], wts])
         z_ts = np.maximum.accumulate(z_ts_raw) if z_ts_raw.size \
             else z_ts_raw
@@ -794,13 +820,13 @@ class HostStreamQuery:
         n_tail = state["tail_ts"].shape[0]
         j = n_tail + np.arange(k, dtype=np.int64)
         if c.window_kind == "length":
-            lo = np.maximum(j - self.N + 1, 0)
-            keep_from = max(z_ts.shape[0] - self.N, 0)
+            lo = np.maximum(j - N + 1, 0)
+            keep_from = max(z_ts.shape[0] - N, 0)
         else:       # sliding time window: live iff ts > now - W
-            lo = np.searchsorted(z_ts, z_ts[j] - self.W, side="right") \
+            lo = np.searchsorted(z_ts, z_ts[j] - W, side="right") \
                 if k else np.zeros(0, np.int64)
             newest = int(z_ts[-1]) if z_ts.size else _TS_NEG
-            keep_from = int(np.searchsorted(z_ts, newest - self.W,
+            keep_from = int(np.searchsorted(z_ts, newest - W,
                                             side="right"))
         cs_f = np.concatenate(
             [np.zeros((z_f.shape[0], 1), np.float64),

@@ -361,6 +361,12 @@ class _NFAResolver:
         self.touched.append((q, variant))
         return variant, t
 
+    def param_key(self, p) -> str:
+        # fleet per-tenant parameter slots ride the event-column namespace
+        # (every cols entry is ev_-prefixed in the step env); they are
+        # injected at step time, never staged, so they are NOT used_ev_cols
+        return f"ev_{p.key}"
+
     def encode_string(self, key: str, value: str) -> int:
         # key may be ev_{merged} or b{q}_...: map back to the merged dictionary
         if key.startswith("ev_"):

@@ -652,6 +652,11 @@ class CompiledStreamQuery:
 
             cts = compact(ts)
             proj_c = {i: compact(specs[i].fn(cols)) for i in value_idx}
+            # fleet per-tenant parameter columns (injected by the caller, not
+            # part of the schema): compacted so having programs over hoisted
+            # constants stay row-aligned with the output columns
+            pcols = {kk: compact(cols[kk]) for kk in cols
+                     if kk.startswith("__fleet_p")}
 
             def make_keys():
                 """Bucket id [B] + exact packed key [B] for the group-by
@@ -701,7 +706,8 @@ class CompiledStreamQuery:
                                    cnts, mins, svars)
                 if having_fn is not None:
                     ovalid = ovalid & jnp.broadcast_to(
-                        having_fn(out), ovalid.shape)
+                        having_fn({**pcols, **out} if pcols else out),
+                        ovalid.shape)
                 return state, {"out": out, "valid": ovalid, "ts": ots,
                                "count": k if count is None else count}
 
