@@ -14,6 +14,13 @@ the columnar micro-batch engine) on a reduced corpus and fails when
    same-machine, so it is robust to container speed differences — absolute
    ev/s numbers are NOT comparable across machines and are only reported).
 
+A ``device_latency`` guard (``run_device_latency_guard``) additionally pins
+the double-buffered pipeline's recorded evidence: when a bench report with a
+``latency_mode`` line exists, its p99 must stay under
+``device_baseline.p99_ceiling_ms`` and the pack/step overlap above
+``device_baseline.overlap_efficiency_min``; phase-partial and host-only
+reports are tolerated with a note instead of a crash.
+
 Exit code 0 = ok, 1 = regression, 2 = could not measure.
 
 Env knobs: ``BENCH_GUARD_EVENTS`` (default 60000), ``BENCH_GUARD_TOL``
@@ -192,14 +199,111 @@ def run_fleet_guard(tol: float, deadline_s: int = 600) -> int:
     return 1 if failures else 0
 
 
+def _latest_device_report():
+    """The report the device_latency guard judges: the file named by
+    ``BENCH_GUARD_DEVICE_REPORT``, else the highest-numbered BENCH_r*.json
+    in the repo root. Returns (path | None, parsed | None, note | None) —
+    unreadable/partial files become notes, never exceptions."""
+    import glob
+    import re
+    path = os.environ.get("BENCH_GUARD_DEVICE_REPORT")
+    if not path:
+        def _round(p):
+            m = re.search(r"BENCH_r(\d+)\.json$", p)
+            return int(m.group(1)) if m else -1
+        cands = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")),
+                       key=_round)
+        if not cands:
+            return None, None, "no BENCH_r*.json reports in the repo"
+        path = cands[-1]
+    try:
+        with open(path) as f:
+            return path, json.load(f), None
+    except (OSError, json.JSONDecodeError) as e:
+        return path, None, f"unreadable report {path}: {e}"
+
+
+def run_device_latency_guard(tol: float) -> int:
+    """Device latency/overlap guard vs BASELINE.json ``device_baseline``:
+    when the newest bench report carries device evidence from the
+    double-buffered pipeline (a ``latency_mode`` line), enforce
+
+    1. p99 detection latency under the stored ceiling (scaled by 1/tol);
+    2. pack/step overlap efficiency above the stored floor (scaled by tol).
+
+    Reports WITHOUT that evidence — host-only fallbacks, phase-partial
+    rounds where the latency or throughput phase died, pre-pipeline
+    rounds — are tolerated: the guard prints what is missing (including
+    per-phase statuses when present) and passes. A wedged tunnel already
+    cost its phase; it must not also turn CI red."""
+    with open(os.path.join(REPO, "BASELINE.json")) as f:
+        baseline = json.load(f).get("device_baseline") or {}
+    if not baseline:
+        print(json.dumps({"device_guard": "skipped",
+                          "reason": "no device_baseline in BASELINE.json"}))
+        return 0
+    ceiling = float(baseline.get("p99_ceiling_ms", 250.0)) / max(tol, 1e-9)
+    overlap_floor = tol * float(baseline.get("overlap_efficiency_min", 1.9))
+
+    path, data, note = _latest_device_report()
+    if data is None:
+        print(json.dumps({"device_guard": "skipped", "reason": note}))
+        return 0
+    skip = {"device_guard": "skipped", "report": os.path.basename(path),
+            "phases": data.get("device_phases")}
+    lm = data.get("latency_mode") or (data.get("device_partial")
+                                      or {}).get("latency_mode")
+    if lm is None:
+        skip["reason"] = ("no latency_mode line (pre-pipeline report, "
+                          "host-only fallback, or dead latency phase)")
+        print(json.dumps(skip))
+        return 0
+
+    failures = []
+    p99 = lm.get("p99_ms")
+    if p99 is None:
+        skip["reason"] = "latency_mode line lacks p99_ms"
+        print(json.dumps(skip))
+        return 0
+    if p99 > ceiling:
+        failures.append(
+            f"latency-mode p99 {p99:.1f}ms above the ceiling "
+            f"{ceiling:.1f}ms ({baseline.get('p99_ceiling_ms')}ms / {tol})")
+    overlap = data.get("ingest_overlap_efficiency") or \
+        (data.get("device_partial") or {}).get("overlap_efficiency")
+    if overlap is None:
+        # throughput phase died but latency survived: judge what exists
+        print(f"GUARD NOTE (device): no overlap line in "
+              f"{os.path.basename(path)} (throughput phase missing)",
+              file=sys.stderr)
+    elif overlap < overlap_floor:
+        failures.append(
+            f"overlap efficiency {overlap:.2f} below the floor "
+            f"{overlap_floor:.2f} ({tol} x stored "
+            f"{baseline.get('overlap_efficiency_min')})")
+
+    print(json.dumps({
+        "report": os.path.basename(path),
+        "latency_mode_p99_ms": p99,
+        "p99_ceiling_ms": ceiling,
+        "overlap_efficiency": overlap,
+        "overlap_floor": overlap_floor,
+        "ok": not failures,
+    }))
+    for f_ in failures:
+        print(f"GUARD REGRESSION (device): {f_}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def main() -> int:
     events = int(os.environ.get("BENCH_GUARD_EVENTS", 60000))
     tol = float(os.environ.get("BENCH_GUARD_TOL", 0.5))
     rc = run_guard(events, tol)
+    drc = run_device_latency_guard(tol)
     if os.environ.get("BENCH_GUARD_SKIP_FLEET", "") == "1":
-        return rc
+        return rc or drc
     frc = run_fleet_guard(tol)
-    return rc or frc
+    return rc or frc or drc
 
 
 if __name__ == "__main__":

@@ -360,7 +360,7 @@ class SiddhiAppRuntime:
             if b.driver is not None:
                 sm.buffered_tracker(
                     f"device.{b.query_name}",
-                    lambda drv=b.driver: len(drv._q))
+                    lambda drv=b.driver: drv.pipeline_depth)
             # device state HBM: nbytes summed over the pytree
             sm.memory_tracker(
                 f"device.{b.query_name}",

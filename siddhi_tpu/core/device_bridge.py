@@ -36,32 +36,68 @@ log = logging.getLogger("siddhi_tpu.device")
 
 
 class AsyncDeviceDriver:
-    """Overlaps host-side micro-batch packing with device compute.
+    """Double-buffered async device pipeline: pack ∥ step ∥ emit.
 
     The VERDICT-named analog of the reference's ``@async`` Disruptor mode for
-    the device path (``StreamJunction.java:279-316``): the producer (junction
-    thread, under the engine lock) packs events into the runtime's builder;
-    full batches are handed to this driver's queue; ONE device worker steps
-    them (``rt.process`` — device state is owned by the worker, no engine lock
-    needed) and then delivers decoded rows back into the engine under the
-    lock. Steady state: the device computes batch N while the engine packs
-    batch N+1.
+    the device path (``StreamJunction.java:279-316``), rebuilt as a software
+    pipeline. Three edges, one FIFO:
+
+    - **pack** (producer, engine lock held): the junction thread packs events
+      into the runtime's staging builder; emitted batches enter this driver's
+      bounded ring (``depth``);
+    - **dispatch** (worker): ``rt.dispatch(batch)`` fires the jitted step and
+      returns an UN-FENCED output token — JAX async dispatch returns while
+      the device still computes, and the carried state round-trips through
+      donated buffers (``jax.jit(..., donate_argnums=(0,))``), so dispatch is
+      fire-and-forget;
+    - **egress** (worker): ``rt.collect(token)`` fences (the ``np.asarray``
+      inside decode is the only host sync on the path) and delivers rows
+      under the engine lock.
+
+    With ``window=2`` (double buffering) the worker keeps one dispatch in
+    flight while fencing the previous token: the device computes batch N
+    while the host decodes batch N−1 and the producer packs batch N+1.
+    Tokens collect strictly FIFO, so a mid-pipeline device fault surfaces at
+    its own egress slot — the DeviceGuard replays the failed batch's shadow
+    there, after every earlier batch delivered, and can neither reorder nor
+    double-emit a micro-batch.
+
+    A latency-mode adaptive controller (``@app:adaptive(latency.target.ms)``)
+    adds a **deadline flush**: when the pipeline idles with a partial batch
+    staged longer than the controller's remaining latency budget, the worker
+    flushes it — detection latency stays bounded by ~fill-wait + one step
+    instead of waiting for capacity.
     """
 
-    def __init__(self, rt, app_context, depth: int = 4):
+    def __init__(self, rt, app_context, depth: int = 4, window: int = 2):
         import collections
         import threading
         self.rt = rt
         self.app_context = app_context
         self.depth = max(1, depth)
-        self._q = collections.deque()
+        # in-flight dispatch window: 2 = double buffering; runtimes whose
+        # collect() reads live state (hopping drain) pin it to 1
+        self.window = max(1, window) \
+            if getattr(rt, "pipeline_safe", True) else 1
+        self._q = collections.deque()            # packed, undispatched
+        self._inflight = collections.deque()     # (batch, token, disp_s, err)
         self._cv = threading.Condition()
-        self._stepping = False           # device state mutation in flight
-        self._busy = False               # step OR delivery in flight
+        self._busy = False          # dispatch/collect/delivery in flight
         self._paused = False
         self._stopped = False
         self.batches_stepped = 0
-        self.step_seconds = 0.0          # cumulative device busy time
+        self.step_seconds = 0.0          # cumulative dispatch+fence time
+        self.pack_seconds = 0.0          # producer pack spans (from batches)
+        self.busy_wall_seconds = 0.0     # wall the pipeline was processing
+        self.starved_seconds = 0.0       # idle with a partial batch staging
+        self.deadline_flushes = 0
+        self._span_t0 = None
+        # counter-check cadence under sustained load: on_drained normally
+        # runs when the pipeline empties, but a saturated pipeline never
+        # empties — force the bookkeeping every N collected batches (one
+        # amortized fence per N steps) so overflow warnings still surface
+        self.drain_check_every = 64
+        self._since_drained = 0
         self._thread = threading.Thread(
             target=self._run, name="device-driver", daemon=True)
         self._thread.start()
@@ -77,76 +113,221 @@ class AsyncDeviceDriver:
             self._q.append(batch)
             self._cv.notify_all()
 
+    # -- introspection --------------------------------------------------------
+    @property
+    def pipeline_depth(self) -> int:
+        """Batches in the driver: packed-but-undispatched + in flight."""
+        return len(self._q) + len(self._inflight)
+
+    def _wall_seconds(self) -> float:
+        """Pipeline wall incl. the OPEN busy span — work counters grow per
+        batch, so a gauge read mid-span (saturated pipelines may never
+        drain) must see the matching wall or the ratios inflate unbounded."""
+        wall = self.busy_wall_seconds + self.starved_seconds
+        t0 = self._span_t0
+        if t0 is not None:
+            wall += max(0.0, time.perf_counter() - t0)
+        return wall
+
+    @property
+    def overlap_efficiency(self) -> float:
+        """(pack + step) work per unit of pipeline wall: 1.0 = serialized,
+        2.0 = two equal phases perfectly hidden behind each other."""
+        wall = self._wall_seconds()
+        if wall <= 0.0:
+            return 0.0
+        return (self.pack_seconds + self.step_seconds) / wall
+
+    @property
+    def device_idle_frac(self) -> float:
+        """Fraction of pipeline wall the device spent waiting on the host."""
+        wall = self._wall_seconds()
+        if wall <= 0.0:
+            return 0.0
+        return max(0.0, 1.0 - self.step_seconds / wall)
+
     # -- worker ---------------------------------------------------------------
     def _run(self) -> None:
-        import time
         while True:
-            with self._cv:
-                while (not self._q or self._paused) and not self._stopped:
-                    self._cv.wait(timeout=0.5)
-                if self._stopped and not self._q:
-                    return
-                if self._paused:
-                    continue
-                batch = self._q.popleft()
-                self._stepping = True
-                self._busy = True
-                self._cv.notify_all()
+            action, batch = self._next_action()
+            if action == "stop":
+                return
+            if action == "dispatch":
+                self._dispatch(batch)
+            elif action == "collect":
+                self._collect_oldest()
+            elif action == "drained":
+                self._run_drained_checks()
+            else:                       # 'deadline'
+                self._deadline_flush()
+
+    def _run_drained_checks(self) -> None:
+        """Deferred host-sync bookkeeping (counter checks need device_get)
+        — OUTSIDE the condition variable: producers blocked in submit()
+        hold the engine lock, and a d2h fetch under _cv would freeze
+        ingress for its whole round-trip."""
+        self._since_drained = 0
+        drained = getattr(self.rt, "on_drained", None)
+        if drained is not None:
             try:
-                try:
-                    t0 = time.perf_counter()
-                    stepped = False
-                    dt = 0.0
-                    rows = self.rt.process(batch)
-                    stepped = True
-                    dt = time.perf_counter() - t0
-                    self.step_seconds += dt
-                    self.batches_stepped += 1
-                except Exception:   # noqa: BLE001 — last-resort worker
-                    # isolation; with the resilience layer active the
-                    # DeviceGuard wrapping rt.process has already rerouted
-                    # the batch to the host path before this can trigger
-                    log.exception("device step failed")
-                    rows = []
-                    dt = time.perf_counter() - t0
-                finally:
-                    try:
-                        # the probe must see EVERY consumed batch (success
-                        # or not) or its FIFO trace groups desynchronize
-                        observe = getattr(self.rt, "observe_step", None)
-                        if observe is not None:
-                            observe(batch.get("count", 0), dt,
-                                    device_path=stepped)
-                    except Exception:   # noqa: BLE001 — a raising observer
-                        # must not kill the sole device worker
-                        log.exception("step observer failed")
-                    finally:
-                        with self._cv:
-                            self._stepping = False
-                            self._cv.notify_all()
-                if rows:
-                    with self.app_context.root_lock:
-                        # stamp outputs with the batch's own last event time —
-                        # the producer-side _out_ts has already advanced to
-                        # newer events by delivery time
-                        self.rt.deliver(rows, batch.get("last_ts"))
-            finally:
-                # busy covers step AND delivery: quiesce() returning with an
-                # undelivered output row would let a snapshot capture device
-                # state advanced past rows downstream never saw
-                with self._cv:
+                drained()
+            except Exception:   # noqa: BLE001 — bookkeeping must not kill
+                # the sole device worker
+                log.exception("on_drained failed")
+
+    def _next_action(self):
+        import time
+        with self._cv:
+            while True:
+                if self._q and not self._paused \
+                        and len(self._inflight) < self.window:
+                    if self._span_t0 is None:
+                        self._span_t0 = time.perf_counter()
+                    self._busy = True
+                    return "dispatch", self._q.popleft()
+                if self._inflight:
+                    # window full, paused, or queue empty: fence the oldest
+                    # token (strict FIFO egress)
+                    return "collect", None
+                # pipeline drained: close the busy span, then idle-wait
+                # (the drained bookkeeping runs in _run, outside this lock)
+                if self._busy:
+                    if self._span_t0 is not None:
+                        self.busy_wall_seconds += \
+                            time.perf_counter() - self._span_t0
+                        self._span_t0 = None
                     self._busy = False
                     self._cv.notify_all()
+                    return "drained", None
+                if self._stopped:
+                    return "stop", None
+                wait_s = 0.5
+                staging = self._builder_staging()
+                if staging and not self._paused:
+                    due_in = self._deadline_due_in_s()
+                    if due_in is not None and due_in <= 0.0:
+                        return "deadline", None
+                    if due_in is not None:
+                        wait_s = min(wait_s, max(due_in, 0.001))
+                t0 = time.perf_counter()
+                self._cv.wait(timeout=wait_s)
+                if staging:
+                    # the device sat idle while a partial batch staged — the
+                    # starvation the overlap accounting must charge as wall
+                    # (and, in latency mode, the deadline flush bounds)
+                    self.starved_seconds += time.perf_counter() - t0
+
+    def _builder_staging(self) -> bool:
+        """Rows staged in the producer's builder while the worker idles —
+        time spent here is device starvation, in any controller mode."""
+        try:
+            return len(self.rt.builder) > 0
+        except Exception:   # noqa: BLE001 — advisory read without the lock
+            return False
+
+    def _deadline_ms(self):
+        """Wall-clock flush deadline for partial batches, or None when no
+        latency-mode controller is attached."""
+        c = getattr(self.rt, "batch_controller", None)
+        if c is None or getattr(c, "mode", "throughput") != "latency":
+            return None
+        if not self._builder_staging():
+            return None
+        return c.flush_deadline_ms
+
+    def _deadline_due_in_s(self):
+        deadline_ms = self._deadline_ms()
+        if deadline_ms is None:
+            return None
+        t0 = getattr(self.rt.builder, "_pack_t0", None)
+        if t0 is None:
+            return None
+        import time
+        return deadline_ms / 1e3 - (time.perf_counter() - t0)
+
+    def _deadline_flush(self) -> None:
+        """Flush a partial batch whose staging age exceeded the latency
+        budget (worker thread, takes the engine lock like any producer)."""
+        with self.app_context.root_lock:
+            due = self._deadline_due_in_s()
+            if due is None or due > 0.0:
+                return      # raced with a producer flush — nothing to do
+            self.rt._count_flush("deadline")
+            self.deadline_flushes += 1
+            # the runtime's own flush: seal + emit + driver submit, so the
+            # deadline path can never diverge from producer-side flushes
+            self.rt.flush()
+
+    def _dispatch(self, batch) -> None:
+        import time
+        self.pack_seconds += float(batch.pop("pack_s", 0.0) or 0.0)
+        t0 = time.perf_counter()
+        err = None
+        token = None
+        try:
+            token = self.rt.dispatch(batch)
+        except Exception as e:  # noqa: BLE001 — without a DeviceGuard
+            # installed a dispatch failure must not kill the worker; the
+            # batch is consumed (counted at its egress slot)
+            log.exception("device dispatch failed")
+            err = e
+        disp_s = time.perf_counter() - t0
+        with self._cv:
+            self._inflight.append((batch, token, disp_s, err))
+            self._cv.notify_all()
+
+    def _collect_oldest(self) -> None:
+        import time
+        with self._cv:
+            batch, token, disp_s, err = self._inflight.popleft()
+        t0 = time.perf_counter()
+        rows = []
+        ok = False
+        try:
+            if err is None:
+                rows = self.rt.collect(token)
+                ok = True
+        except Exception:   # noqa: BLE001 — an async-dispatched step's
+            # failure surfaces at the fence; with the resilience layer
+            # active the DeviceGuard has already rerouted the batch to the
+            # host path before this can trigger
+            log.exception("device step failed")
+            rows = []
+        dt = disp_s + (time.perf_counter() - t0)
+        self.step_seconds += dt
+        self.batches_stepped += 1
+        try:
+            # the probe must see EVERY consumed batch (success or not) or
+            # its FIFO trace groups desynchronize
+            observe = getattr(self.rt, "observe_step", None)
+            if observe is not None:
+                observe(batch.get("count", 0), dt, device_path=ok)
+        except Exception:   # noqa: BLE001 — a raising observer must not
+            # kill the sole device worker
+            log.exception("step observer failed")
+        if rows:
+            with self.app_context.root_lock:
+                # stamp outputs with the batch's own last event time — the
+                # producer-side _out_ts has already advanced to newer events
+                # by delivery time
+                self.rt.deliver(rows, batch.get("last_ts"))
+        self._since_drained += 1
+        if self._since_drained >= self.drain_check_every:
+            # sustained load never drains the pipeline: run the overflow
+            # checks anyway (costs one fence per drain_check_every steps)
+            self._run_drained_checks()
+        with self._cv:
+            self._cv.notify_all()
 
     # -- barriers --------------------------------------------------------------
     def quiesce(self, timeout: float = 60.0) -> bool:
-        """Wait until the queue is empty and no step OR delivery is in
-        flight. Must NOT be called while holding the engine lock (the
-        worker's delivery phase needs it)."""
+        """Wait until the ring is empty and no dispatch, fence, or delivery
+        is in flight. Must NOT be called while holding the engine lock (the
+        worker's egress edge needs it)."""
         import time
         deadline = time.monotonic() + timeout
         with self._cv:
-            while self._q or self._busy:
+            while self._q or self._inflight or self._busy:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
@@ -201,13 +382,43 @@ class AsyncDeviceDriver:
 class _DeviceRTBase(AdaptiveFlushMixin):
     """Shared packing→step dispatch for bridge runtimes: a full builder is
     either handed to the async driver (packing overlaps compute) or stepped
-    synchronously. Subclasses define ``process(batch) -> rows``."""
+    synchronously.
+
+    The step is two-phase: ``dispatch(batch)`` fires the jitted step without
+    fencing (JAX async dispatch — state advances through donated buffers)
+    and returns the un-fetched output pytree; ``collect(token)`` fences at
+    the egress edge (the ``np.asarray`` inside decode) and returns rows.
+    ``process`` is one dispatch immediately collected — the synchronous
+    path, and the shape the DeviceGuard wraps on both phases. Host-sync
+    bookkeeping that would stall the pipeline (counter checks read device
+    scalars) lives in ``on_drained``, which the driver calls whenever the
+    pipeline empties and the sync path calls after every flush."""
 
     driver = None
     callback = None
+    pipeline_safe = True    # False → the driver pins the window to 1
 
     def add_callback(self, fn):
         self.callback = fn
+
+    def dispatch(self, batch):
+        """Fire-and-forget device step: advances ``self.state`` and returns
+        the un-fenced output pytree as the egress token."""
+        self.state, out = self.compiled.step(self.state, batch)
+        return out
+
+    def collect(self, out):
+        """Egress fence + decode for one dispatched step."""
+        return self.compiled.decode_outputs(out)
+
+    def process(self, batch):
+        """Synchronous step + decode (async: worker thread, no engine lock —
+        device state is worker-owned)."""
+        return self.collect(self.dispatch(batch))
+
+    def on_drained(self):
+        """Called when the pipeline empties — the safe point for host-sync
+        bookkeeping (device_get with nothing in flight)."""
 
     def deliver(self, rows, emit_ts=None):
         fn = self.callback
@@ -227,6 +438,7 @@ class _DeviceRTBase(AdaptiveFlushMixin):
             self.driver.submit(b)
             return
         self.deliver(self._timed_process(b), b.get("last_ts"))
+        self.on_drained()
 
     def finalize(self):
         """Terminal flush at shutdown (kernels that hold an open segment
@@ -259,7 +471,7 @@ class DeviceQueryBridge:
 
     def __init__(self, kind: str, runtime, app_context, stream_ids: list[str],
                  output_junction, query_name: str, async_mode: bool = False,
-                 output_rate=None):
+                 output_rate=None, pipeline_window: int = 2):
         self.kind = kind                  # 'stream' | 'nfa' | 'join'
         self.runtime = runtime            # DeviceStreamRuntime | DeviceNFARuntime
         self.app_context = app_context
@@ -279,7 +491,8 @@ class DeviceQueryBridge:
             self.rate_limiter.next = _LimiterSink(self)
         self.driver = None
         if async_mode:
-            self.driver = AsyncDeviceDriver(runtime, app_context)
+            self.driver = AsyncDeviceDriver(runtime, app_context,
+                                            window=pipeline_window)
             runtime.driver = self.driver
 
     # -- junction receiver(s) -------------------------------------------------
@@ -379,6 +592,9 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
     batch = int(ann.get("batch") or 1024)
     slots = int(ann.get("slots") or 64)
     window_cap = int(ann.get("window") or 4096)
+    # in-flight dispatch window of the async pipeline (2 = double
+    # buffering; 1 = serialize dispatch/egress, for A/B comparison)
+    pipeline_window = int(ann.get("pipeline") or 2)
 
     def _input_stream_ids(ist) -> list[str]:
         if isinstance(ist, SingleInputStream):
@@ -490,6 +706,9 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                     # they must not touch the producer's live builder
                     self._drain_builder = BatchBuilder(compiled.schema,
                                                        batch)
+                    # hopping's collect() reads live state between steps:
+                    # the driver pins its dispatch window to 1
+                    self.pipeline_safe = compiled.window_kind != "hopping"
                     self.state = compiled.init_state()
                     # segment clock high-water: arrival ts, or the
                     # externalTimeBatch attribute column
@@ -534,30 +753,23 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                     append(row, sentinel)
                     self.flush()
 
-                def process(self, b):
-                    """Device step + decode (async: worker thread, no engine
-                    lock — device state is worker-owned)."""
-                    self.state, out = self.compiled.step(self.state, b)
+                def collect(self, out):
+                    """Egress fence + decode. Hopping drains deferred
+                    boundary flushes here with empty steps — the runtime is
+                    pipeline-unsafe, so the state read is this step's own."""
                     rows = self.compiled.decode_outputs(out)
-                    # hopping defers boundary flushes past the per-step
-                    # capacity (long gaps span more hops than one step
-                    # covers): drain them with empty steps, same as
-                    # DeviceStreamRuntime.flush
                     if self.compiled.window_kind == "hopping":
-                        from ..tpu.query_compile import _TS_NEG
-                        import jax as _jax
-                        while True:
-                            hop_next, last_ts = (
-                                int(v) for v in _jax.device_get(
-                                    (self.state["hop_next"],
-                                     self.state["last_ts"])))
-                            if hop_next <= _TS_NEG or hop_next > last_ts:
-                                break
-                            self.state, out = self.compiled.step(
-                                self.state, self._drain_builder.emit())
-                            rows.extend(self.compiled.decode_outputs(out))
-                    self._check_counters()
+                        from ..tpu.runtime import drain_hop_boundaries
+                        self.state = drain_hop_boundaries(
+                            self.compiled, self.state, self._drain_builder,
+                            lambda o: rows.extend(
+                                self.compiled.decode_outputs(o)))
                     return rows
+
+                def on_drained(self):
+                    # counter checks device_get state scalars — deferred to
+                    # drain points so they never stall the pipeline
+                    self._check_counters()
 
                 def _check_counters(self):
                     # surface bounded-state overflow instead of silently
@@ -591,7 +803,8 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
             bridge = DeviceQueryBridge("stream", rt, app_context,
                                        [ist.stream_id], target, name,
                                        async_mode=async_mode,
-                                       output_rate=query.output_rate)
+                                       output_rate=query.output_rate,
+                                       pipeline_window=pipeline_window)
             bridge.output_schema = ([s.name for s in compiled.specs],
                                     [s.dtype for s in compiled.specs])
         elif isinstance(ist, StateInputStream):
@@ -617,7 +830,8 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
             bridge = DeviceQueryBridge("nfa", rt, app_context,
                                        compiler.compiled.stream_ids, target,
                                        name, async_mode=async_mode,
-                                       output_rate=query.output_rate)
+                                       output_rate=query.output_rate,
+                                       pipeline_window=pipeline_window)
             bridge.output_schema = ([n for n, _, _ in compiler.out_specs],
                                     [t for _, _, t in compiler.out_specs])
         elif isinstance(ist, JoinInputStream):
@@ -642,9 +856,9 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                     self.builder.append(stream_id, row, timestamp)
                     self._maybe_flush()
 
-                def process(self, b):
-                    self.state, out = self.compiled.step(self.state, b)
-                    rows = self.compiled.decode_outputs(out)
+                def on_drained(self):
+                    # drop counters live in device state: check at drain
+                    # points (device_get would stall the pipeline per-step)
                     drops = int(self.state["join_drops"]) + \
                         int(self.state["ring_drops"])
                     if drops > self._warned_drops:
@@ -652,7 +866,6 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                             "query '%s': %d joined rows/ring entries dropped "
                             "(raise @device(joined=/ring=))", name, drops)
                         self._warned_drops = drops
-                    return rows
 
                 def snapshot_state(self):
                     from ..tpu.batch import device_state_snapshot
@@ -668,7 +881,8 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
             bridge = DeviceQueryBridge(
                 "join", rt, app_context,
                 [compiled.left_id, compiled.right_id], target, name,
-                async_mode=async_mode, output_rate=query.output_rate)
+                async_mode=async_mode, output_rate=query.output_rate,
+                pipeline_window=pipeline_window)
             bridge.output_schema = ([n for (n, _, t, _) in compiled.out_specs],
                                     [t for (n, _, t, _) in compiled.out_specs])
         else:

@@ -45,8 +45,9 @@ __all__ = [
 ]
 
 # every flush site reports one of these causes; registered as counters even
-# when still zero so dashboards see the full breakdown
-FLUSH_CAUSES = ("capacity", "adaptive", "drain", "final")
+# when still zero so dashboards see the full breakdown ("deadline" = the
+# async driver's latency-mode wall-clock flush of a partial batch)
+FLUSH_CAUSES = ("capacity", "adaptive", "deadline", "drain", "final")
 
 
 class DeviceStepProbe:
@@ -65,6 +66,7 @@ class DeviceStepProbe:
         self.capacity = max(1, int(capacity))
         self.latency_tracker = latency_tracker
         self.tracer = tracer
+        self.driver = None      # AsyncDeviceDriver when the bridge pipelines
         self.steps = 0
         self.events = 0
         self.busy_seconds = 0.0
@@ -134,6 +136,31 @@ class DeviceStepProbe:
             return 0.0
         return 1.0 - self.events / (self.steps * self.capacity)
 
+    # -- pipeline health (async double-buffered driver) ----------------------
+    # all three read the driver's counters so the pack/step overlap win is
+    # visible OUTSIDE the bench, as siddhi_tpu_device_* families; a bridge
+    # without a driver (sync mode) reports the serialized identity values
+    @property
+    def pipeline_depth(self) -> int:
+        """Micro-batches inside the driver ring (staged + in flight)."""
+        d = self.driver
+        return d.pipeline_depth if d is not None else 0
+
+    @property
+    def overlap_efficiency(self) -> float:
+        """(pack + step) work per unit of pipeline wall: ~2.0 when a
+        2-deep ring fully hides packing behind device compute, 1.0 when
+        the phases serialize (always 1.0 on the sync path)."""
+        d = self.driver
+        return d.overlap_efficiency if d is not None \
+            else (1.0 if self.steps else 0.0)
+
+    @property
+    def device_idle_frac(self) -> float:
+        """Fraction of pipeline wall the device waited on the host."""
+        d = self.driver
+        return d.device_idle_frac if d is not None else 0.0
+
 
 class ObservabilitySubsystem:
     """One app's observability wiring. Constructed BEFORE the runtime
@@ -196,6 +223,7 @@ class ObservabilitySubsystem:
                 self.tracer)
             self.probes.append(probe)
             bridge.probe = probe
+            probe.driver = bridge.driver
             bridge.runtime.step_observer = probe.on_step
             bridge.runtime.step_sealer = probe.seal
             bridge.runtime.flush_causes = probe.flush_causes
@@ -210,6 +238,14 @@ class ObservabilitySubsystem:
                              lambda p=probe: p.compile_seconds)
             sm.gauge_tracker(f"device.{q}.pad_ratio",
                              lambda p=probe: round(p.pad_ratio, 4))
+            # pipeline-health gauges: the pack/step overlap win measured by
+            # the bench, continuously visible in the exposition
+            sm.gauge_tracker(f"device.{q}.pipeline_depth",
+                             lambda p=probe: p.pipeline_depth)
+            sm.gauge_tracker(f"device.{q}.overlap_efficiency",
+                             lambda p=probe: round(p.overlap_efficiency, 4))
+            sm.gauge_tracker(f"device.{q}.device_idle_frac",
+                             lambda p=probe: round(p.device_idle_frac, 4))
             for cause in FLUSH_CAUSES:
                 sm.gauge_tracker(
                     f"device.{q}.flush_{cause}_total",

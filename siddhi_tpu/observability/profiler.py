@@ -45,19 +45,21 @@ class DeviceProfiler:
             return contextlib.nullcontext()
 
     def install(self, bridge) -> None:
-        """Wrap the bridge runtime's ``process`` so every device step runs
+        """Wrap the bridge runtime's ``dispatch`` so every device step runs
         under a ``siddhi:step:<query>`` annotation (wraps whatever is
-        installed — including a DeviceGuard's fallback dispatch)."""
+        installed — including a DeviceGuard's guarded dispatch). Both paths
+        route through dispatch: the async driver calls it directly and the
+        sync ``process`` is ``collect(dispatch(batch))``."""
         rt = bridge.runtime
-        inner = rt.process
+        inner = rt.dispatch
         label = f"siddhi:step:{bridge.query_name}"
         profiler = self
 
-        def annotated_process(batch):
+        def annotated_dispatch(batch):
             with profiler.annotate(label):
                 return inner(batch)
 
-        rt.process = annotated_process
+        rt.dispatch = annotated_dispatch
 
     # -- trace capture ---------------------------------------------------------
     def start(self) -> None:

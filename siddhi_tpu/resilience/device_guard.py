@@ -104,8 +104,34 @@ class _ShadowBuilder:
         return getattr(self._inner, name)
 
 
+class _GuardToken:
+    """In-flight pipeline slot: the inner runtime's un-fenced output token
+    plus everything needed to replay the batch on the host if the step turns
+    out to have failed. Tokens travel the async driver's FIFO ring, so a
+    failed batch's host replay runs at its own egress slot — after every
+    earlier batch delivered, before every later one — which is what makes a
+    mid-pipeline fault unable to reorder or double-emit a micro-batch."""
+
+    __slots__ = ("inner", "shadow", "batch", "failed", "quarantined")
+
+    def __init__(self, inner, shadow, batch, failed=False, quarantined=False):
+        self.inner = inner
+        self.shadow = shadow
+        self.batch = batch
+        self.failed = failed
+        self.quarantined = quarantined
+
+
 class DeviceGuard:
-    """Wraps one device bridge runtime with failure capture + quarantine."""
+    """Wraps one device bridge runtime with failure capture + quarantine.
+
+    The wrap is two-phase, matching the pipelined runtime API: ``dispatch``
+    captures the batch's host shadow and fires the inner step (fire-and-
+    forget — an asynchronously dispatched step's failure may only surface at
+    the fence), ``collect`` fences and, on failure, replays the shadow
+    through the host fallback at the token's own FIFO egress slot. The
+    synchronous path (``rt.process``) goes through the same two wrapped
+    phases back-to-back."""
 
     def __init__(self, query, query_name: str, app_context, stream_defs: dict,
                  get_junction: Callable, kind: str,
@@ -131,12 +157,16 @@ class DeviceGuard:
 
     # -- installation --------------------------------------------------------
     def install(self, rt) -> None:
-        """Wrap ``rt.process`` and ``rt.builder`` in place. Works for both
-        dispatch paths: the sync ``_timed_process`` and the async driver call
-        ``rt.process(batch)`` — an instance attribute shadows the method."""
+        """Wrap ``rt.dispatch``/``rt.collect`` and ``rt.builder`` in place
+        (instance attributes shadow the methods). Both execution paths go
+        through the wrapped pair: the async driver calls dispatch/collect
+        directly; the sync path's ``rt.process`` is defined as
+        ``collect(dispatch(batch))`` and resolves the instance attributes."""
         rt.builder = _ShadowBuilder(rt.builder, merged=self.kind != "stream")
-        inner_process = rt.process
-        rt.process = lambda batch: self.step(inner_process, batch)
+        inner_dispatch = rt.dispatch
+        inner_collect = rt.collect
+        rt.dispatch = lambda batch: self.dispatch(inner_dispatch, batch)
+        rt.collect = lambda token: self.collect(inner_collect, token)
         # failed/quarantined steps time the HOST replay, not the device —
         # feeding those samples to the adaptive batch controller would tune
         # it on latencies unrelated to device performance. The observability
@@ -150,31 +180,54 @@ class DeviceGuard:
                     device_path=device_path and not self._last_step_fell_back)
             rt.observe_step = observe
 
-    # -- step ----------------------------------------------------------------
-    def step(self, inner_process, batch: dict) -> list:
+    # -- two-phase step ------------------------------------------------------
+    def dispatch(self, inner_dispatch, batch: dict) -> _GuardToken:
+        """Fire the inner step; failures (chaos injection, jit trace errors,
+        an open circuit) do NOT raise — they ride the returned token to its
+        FIFO egress slot, where the host replay happens in order."""
         shadow = batch.pop("_shadow_rows", None)
         if not self.breaker.allow():
-            self._last_step_fell_back = True
-            self._host_fallback(shadow, batch, quarantined=True)
-            return []
+            return _GuardToken(None, shadow, batch,
+                               failed=True, quarantined=True)
         try:
             if self.chaos is not None:
                 self.chaos.on_device(self._site)
-            rows = inner_process(batch)
+            inner = inner_dispatch(batch)
         except Exception as e:  # noqa: BLE001 — quarantine boundary: the
             # failed batch reroutes to the host path, the app keeps running
-            self.failures += 1
-            self.breaker.record_failure()
-            log.warning("%s: device step failed (%d consecutive, circuit %s)"
-                        ": %s", self._site,
-                        self.breaker.consecutive_failures,
-                        self.breaker.state, e, exc_info=True)
+            self._record_failure(e)
+            return _GuardToken(None, shadow, batch, failed=True)
+        return _GuardToken(inner, shadow, batch)
+
+    def collect(self, inner_collect, token: _GuardToken) -> list:
+        """Egress edge: fence the inner token (an async-dispatched step's
+        failure surfaces HERE, not at dispatch) and replay the shadow on
+        failure. Called strictly FIFO by the driver — earlier batches have
+        already delivered, so replay cannot reorder."""
+        if token.failed:
             self._last_step_fell_back = True
-            self._host_fallback(shadow, batch)
+            self._host_fallback(token.shadow, token.batch,
+                                quarantined=token.quarantined)
+            return []
+        try:
+            rows = inner_collect(token.inner)
+        except Exception as e:  # noqa: BLE001 — same quarantine boundary,
+            # one pipeline stage later
+            self._record_failure(e)
+            self._last_step_fell_back = True
+            self._host_fallback(token.shadow, token.batch)
             return []
         self.breaker.record_success()
         self._last_step_fell_back = False
         return rows
+
+    def _record_failure(self, e: Exception) -> None:
+        self.failures += 1
+        self.breaker.record_failure()
+        log.warning("%s: device step failed (%d consecutive, circuit %s)"
+                    ": %s", self._site,
+                    self.breaker.consecutive_failures,
+                    self.breaker.state, e, exc_info=True)
 
     # -- host fallback -------------------------------------------------------
     def _fallback_runtime(self):

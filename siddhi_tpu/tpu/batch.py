@@ -228,6 +228,10 @@ class BatchBuilder:
         }
         self._ts = np.zeros(capacity, dtype=np.int64)
         self._n = 0
+        # wall-clock of the first append since the last emit: the packing
+        # span the async driver charges to the pack phase (overlap
+        # accounting) and checks against the latency-mode flush deadline
+        self._pack_t0 = None
 
     def __len__(self) -> int:
         return self._n
@@ -240,6 +244,9 @@ class BatchBuilder:
         if self._n >= self.capacity:
             raise OverflowError("micro-batch full; call emit() first")
         i = self._n
+        if self._pack_t0 is None:
+            import time
+            self._pack_t0 = time.perf_counter()
         for name, v in zip(self.schema.names, row):
             self._cols[name][i] = self.schema.encode_value(name, v)
         self._ts[i] = ts
@@ -251,7 +258,11 @@ class BatchBuilder:
 
     def emit(self) -> dict:
         """Returns {'cols': {name: np[capacity]}, 'ts', 'valid', 'count'} and
-        resets. Arrays are padded to capacity (static shapes for jit)."""
+        resets. Arrays are padded to capacity (static shapes for jit).
+        ``pack_s`` carries the wall span from first append to emit (pack
+        phase in the driver's overlap accounting; extra keys never reach the
+        jitted step — it indexes the batch dict by name)."""
+        import time
         valid = np.zeros(self.capacity, dtype=bool)
         valid[: self._n] = True
         out = {
@@ -260,8 +271,11 @@ class BatchBuilder:
             "valid": valid,
             "count": self._n,
             "last_ts": int(self._ts[self._n - 1]) if self._n else 0,
+            "pack_s": (time.perf_counter() - self._pack_t0
+                       if self._pack_t0 is not None else 0.0),
         }
         self._n = 0
+        self._pack_t0 = None
         return out
 
     def snapshot(self) -> dict:
@@ -279,6 +293,9 @@ class BatchBuilder:
         for k, v in snap["cols"].items():
             self._cols[k][:n] = v
         self._ts[:n] = snap["ts"]
+        if n:                   # restored rows re-arm the flush deadline
+            import time
+            self._pack_t0 = time.perf_counter()
 
 
 def columns_from_rows(schema: BatchSchema, rows: list[list],

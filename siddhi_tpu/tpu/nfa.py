@@ -41,6 +41,7 @@ zero-occurrence count emit NULL via carried validity flags (host parity).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
@@ -142,6 +143,9 @@ class MergedBatchBuilder:
         self._ts = np.zeros(capacity, dtype=np.int64)
         self._n = 0
         self.ts_clamped = 0        # events whose in-batch ts delta overflowed
+        # wall-clock of the first append since the last emit (pack-phase
+        # span for the async driver's overlap accounting + flush deadline)
+        self._pack_t0 = None
 
     def __len__(self):
         return self._n
@@ -152,6 +156,8 @@ class MergedBatchBuilder:
 
     def append(self, stream_id: str, row: list, ts: int) -> None:
         i = self._n
+        if self._pack_t0 is None:
+            self._pack_t0 = time.perf_counter()
         si = self.schema.stream_index[stream_id]
         d = self.stream_defs[stream_id]
         for a, v in zip(d.attributes, row):
@@ -180,6 +186,8 @@ class MergedBatchBuilder:
         take = min(n_rows, self.capacity - self._n)
         if take <= 0:
             return 0
+        if self._pack_t0 is None:
+            self._pack_t0 = time.perf_counter()
         i = self._n
         si = self.schema.stream_index[stream_id]
         d = self.stream_defs[stream_id]
@@ -215,8 +223,11 @@ class MergedBatchBuilder:
             "ts_base": np.int64(base),
             "count": n,
             "last_ts": int(self._ts[n - 1]) if n else 0,
+            "pack_s": (time.perf_counter() - self._pack_t0
+                       if self._pack_t0 is not None else 0.0),
         }
         self._n = 0
+        self._pack_t0 = None
         return out
 
     def snapshot(self) -> dict:
@@ -236,6 +247,8 @@ class MergedBatchBuilder:
             self._cols[k][:n] = v
         self._tag[:n] = snap["tag"]
         self._ts[:n] = snap["ts"]
+        if n:                   # restored rows re-arm the flush deadline
+            self._pack_t0 = time.perf_counter()
 
 
 # ---------------------------------------------------------------------------
@@ -1836,10 +1849,27 @@ class DeviceNFARuntime(AdaptiveFlushMixin):
         self.builder.append(stream_id, row, timestamp)
         self._maybe_flush()
 
-    def process(self, batch: dict) -> list[list]:
-        """Device step + decode (async driver's worker entry)."""
+    # two-phase step (the async driver's double-buffered pipeline): dispatch
+    # fires the jitted step WITHOUT fencing (JAX async dispatch returns while
+    # the device computes); collect decodes — the np.asarray() inside decode
+    # IS the egress fence. NFA state carries no host-sync bookkeeping, so
+    # dispatch N+1 can overlap collect N.
+    pipeline_safe = True
+
+    def dispatch(self, batch: dict):
+        """Fire-and-forget device step: advances ``self.state`` (donated
+        buffers — the round-trip allocates nothing) and returns the
+        un-fenced output pytree as the egress token."""
         self.state, ys = self.compiler.step(self.state, batch)
+        return ys
+
+    def collect(self, ys) -> list[list]:
+        """Egress edge: fence + decode one dispatched step's outputs."""
         return self.compiler.decode_outputs(ys)
+
+    def process(self, batch: dict) -> list[list]:
+        """Synchronous step + decode (one dispatch immediately collected)."""
+        return self.collect(self.dispatch(batch))
 
     def deliver(self, rows: list[list], emit_ts=None) -> None:
         fn = self.callback

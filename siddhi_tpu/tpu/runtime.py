@@ -18,6 +18,24 @@ from .batch import BatchBuilder
 from .query_compile import CompiledStreamQuery
 
 
+def drain_hop_boundaries(compiled, state, drain_builder, on_out):
+    """Hopping defers boundary flushes past the per-step flush capacity (a
+    long time gap can span more hops than one step covers): step EMPTY
+    batches until the next boundary is in the future, handing each step's
+    outputs to ``on_out``. Shared by every hopping call site (sync flush,
+    pipeline collect, bridge runtimes) — returns the advanced state."""
+    from .query_compile import _TS_NEG
+    while True:
+        hop_next, last_ts = (
+            int(v) for v in jax.device_get(
+                (state["hop_next"], state["last_ts"])))
+        if hop_next <= _TS_NEG or hop_next > last_ts:
+            break
+        state, out = compiled.step(state, drain_builder.emit())
+        on_out(out)
+    return state
+
+
 class DeviceStreamRuntime:
     def __init__(self, app_or_text, batch_capacity: int = 4096,
                  group_capacity: int = 1024, query_index: int = 0,
@@ -38,6 +56,14 @@ class DeviceStreamRuntime:
         self.state = self.compiled.init_state()
         self.callback: Optional[Callable[[list[list]], None]] = None
         self._pending_out = []
+        # hopping steps host-sync on hop boundaries inside collect(): the
+        # pipeline must keep exactly one step in flight (window=1) so the
+        # state collect() reads is the dispatched step's own
+        self.pipeline_safe = self.compiled.window_kind != "hopping"
+        # empty-batch source for hop-boundary drain steps inside collect():
+        # the live builder may hold the NEXT batch's staged rows by then
+        self._drain_builder = BatchBuilder(self.compiled.schema,
+                                           batch_capacity)
 
     def add_callback(self, fn: Callable[[list[list]], None]) -> None:
         self.callback = fn
@@ -52,20 +78,33 @@ class DeviceStreamRuntime:
             batch = self.builder.emit()
             self.state, out = self.compiled.step(self.state, batch)
             self._deliver(out, decode)
-        # hopping defers boundary flushes past the per-step flush capacity
-        # (a long time gap can span more hops than one step covers): drain
-        # them with empty steps until the next boundary is in the future
         if self.compiled.window_kind == "hopping":
-            from .query_compile import _TS_NEG
-            while True:
-                hop_next, last_ts = (
-                    int(v) for v in jax.device_get(
-                        (self.state["hop_next"], self.state["last_ts"])))
-                if hop_next <= _TS_NEG or hop_next > last_ts:
-                    break
-                self.state, out = self.compiled.step(
-                    self.state, self.builder.emit())
-                self._deliver(out, decode)
+            self.state = drain_hop_boundaries(
+                self.compiled, self.state, self._drain_builder,
+                lambda out: self._deliver(out, decode))
+
+    # -- two-phase step (double-buffered pipeline) ---------------------------
+    def dispatch(self, batch: dict):
+        """Fire the jitted step without fencing (JAX async dispatch): device
+        state advances through donated buffers, the un-fetched output pytree
+        is the token ``collect`` later fences at the egress edge."""
+        self.state, out = self.compiled.step(self.state, batch)
+        return out
+
+    def collect(self, out) -> list[list]:
+        """Egress fence + decode for one dispatched step (the np.asarray in
+        ``decode_outputs`` blocks until the step completed). Hopping windows
+        drain deferred boundary flushes here — pipeline-safe only at
+        window=1 (see ``pipeline_safe``)."""
+        rows = self.compiled.decode_outputs(out)
+        if self.compiled.window_kind == "hopping":
+            self.state = drain_hop_boundaries(
+                self.compiled, self.state, self._drain_builder,
+                lambda o: rows.extend(self.compiled.decode_outputs(o)))
+        return rows
+
+    def process(self, batch: dict) -> list[list]:
+        return self.collect(self.dispatch(batch))
 
     def _deliver(self, out, decode: bool) -> None:
         if decode:
