@@ -828,10 +828,19 @@ def _run_tenant_fleet(make_tenant, ann, n_feed: int, chunk: int,
         rt.flush_host()
     dt = time.perf_counter() - t0
     total = tenants * (n_feed - warm * chunk)
+    guard = {}
     if any(rt.fleet_bridges for rt in apps):
         fstats = m.fleet.stats()
         compiles = fstats["cache"]["misses"]
         steps = sum(g["steps"] for g in fstats["groups"].values())
+        lanes = [b.member.lane for rt in apps for b in rt.fleet_bridges
+                 if b.member.lane is not None]
+        if lanes:
+            guard = {"ejections": sum(l.ejections for l in lanes),
+                     "readmissions": sum(l.readmissions for l in lanes),
+                     "containments": sum(
+                         g.get("guard", {}).get("containments", 0)
+                         for g in fstats["groups"].values())}
     else:
         # solo: every app compiled its own plan(s) and stepped its own
         # bridges (the per-APP dedupe cannot cross tenants)
@@ -843,7 +852,7 @@ def _run_tenant_fleet(make_tenant, ann, n_feed: int, chunk: int,
     return {"rate": total / dt, "events": total, "seconds": dt,
             "matches": list(counts), "compiles": compiles,
             "steps": steps, "steps_per_s": steps / dt if dt else 0.0,
-            "engaged": engaged}
+            "engaged": engaged, **guard}
 
 
 def child_fleet() -> None:
@@ -887,6 +896,47 @@ def child_fleet() -> None:
           f"compiles fleet={out['fleet_compiles']} "
           f"solo={out['solo_compiles']}; oracle_ok={out['oracle_ok']}",
           file=sys.stderr)
+    # fault-mode line (FleetGuard containment): tenant 0 faults at the
+    # chaos fleet site — ejected to solo, later re-admitted — and the
+    # innocent tenants' aggregate throughput must stay within ~10% of a
+    # back-to-back no-fault run of the SAME config (small guard batch so
+    # containment actually engages over the reduced feed; the 64-tenant
+    # p=0.05 correctness soak lives in tests/test_fleet_guard.py).
+    # BENCH_FLEET_FAULT=0 skips.
+    if os.environ.get("BENCH_FLEET_FAULT", "1") == "1" and TENANTS > 1:
+        guard_batch = min(FLEET_BATCH, 2048)
+        guard_ann = f"@app:fleet(batch='{guard_batch}', " \
+                    f"lanes='{HOST_LANES}', guard.cooldown.ms='20', " \
+                    f"guard.readmit.batches='2')\n"
+        chaos_ann = "@app:chaos(seed='29', fleet.fault.p='0.2')\n"
+
+        def make_faulted(i, ann):
+            return _tenant_rule_app(
+                i, ann + (chaos_ann if i == 0 else ""))
+
+        base = _run_tenant_fleet(_tenant_rule_app, guard_ann, TENANT_FEED,
+                                 TENANT_CHUNK, TENANTS)
+        fault = _run_tenant_fleet(make_faulted, guard_ann, TENANT_FEED,
+                                  TENANT_CHUNK, TENANTS)
+        innocents_ok = fault["matches"][1:] == base["matches"][1:]
+        # per-tenant offered load is identical, so the innocent tenants'
+        # throughput ratio IS the aggregate wall ratio
+        ratio = fault["rate"] / base["rate"] if base["rate"] else 0.0
+        out.update({
+            "fault_evps": round(fault["rate"]),
+            "fault_baseline_evps": round(base["rate"]),
+            "fault_innocent_ratio": ratio,
+            "fault_ejections": fault.get("ejections", 0),
+            "fault_readmissions": fault.get("readmissions", 0),
+            "fault_containments": fault.get("containments", 0),
+            "fault_innocents_oracle_ok": innocents_ok,
+        })
+        print(f"# fleet fault (p=0.2 tenant 0): {out['fault_evps']:,} "
+              f"ev/s = {ratio:.2f}x no-fault; ejections="
+              f"{out['fault_ejections']} readmissions="
+              f"{out['fault_readmissions']} containments="
+              f"{out['fault_containments']}; innocents_ok={innocents_ok}",
+              file=sys.stderr)
     # stateful line: the bench pattern (64-way partitioned rising chain) as
     # K tenant copies — shared blocked-NFA plan, sliced tenant lanes
     # (BENCH_FLEET_PATTERN_FEED=0 skips it — the CI guard's fast path)

@@ -182,6 +182,20 @@ def run_fleet_guard(tol: float, deadline_s: int = 600) -> int:
         failures.append(
             f"fleet/solo speedup {ratio:.2f}x below the tolerance band "
             f"(floor {floor:.2f}x = {tol} x stored {ratio_min:.2f}x)")
+    # FleetGuard fault line (PR 8): when the bench ran the containment
+    # scenario, the innocent tenants must keep their exact outputs and
+    # their throughput must not collapse (loose wall-clock floor — the
+    # 10% evidence bar lives in the BENCH json; the correctness soak is
+    # tests/test_fleet_guard.py)
+    if "fault_innocent_ratio" in data:
+        if not data.get("fault_innocents_oracle_ok"):
+            failures.append("innocent tenants' outputs diverged under a "
+                            "contained tenant fault")
+        fr = data.get("fault_innocent_ratio") or 0.0
+        if fr < tol:
+            failures.append(
+                f"innocent-tenant throughput collapsed to {fr:.2f}x the "
+                f"no-fault run during containment (floor {tol})")
 
     print(json.dumps({
         "tenants": tenants,
@@ -192,6 +206,8 @@ def run_fleet_guard(tol: float, deadline_s: int = 600) -> int:
         "fleet_compiles": data.get("fleet_compiles"),
         "solo_compiles": data.get("solo_compiles"),
         "oracle_ok": data.get("oracle_ok"),
+        "fault_innocent_ratio": data.get("fault_innocent_ratio"),
+        "fault_ejections": data.get("fault_ejections"),
         "ok": not failures,
     }))
     for f_ in failures:

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Guard-coverage lint: every shared-execution step entry point is wrapped.
+
+Shared execution means one program stepping many events (and, for the
+fleet, many TENANTS) at once — a single unguarded step there is a whole-
+batch (or whole-group) blast radius. This lint builds one minimal app per
+tier and asserts the resilience wrap is actually installed:
+
+1. **fleet group step** — ``FleetGroup.guard`` is a FleetGuard and the
+   group's staging/stepping routes through it (``_step`` consults
+   ``self.guard``, checked structurally);
+2. **device dispatch/collect** — ``try_build_device_query`` runtimes carry
+   the DeviceGuard two-phase wrap (``rt.dispatch``/``rt.collect`` are
+   instance attributes shadowing the class methods, and the app's
+   ResilienceSubsystem holds the guard);
+3. **host_batch step** — columnar host bridges carry the HostStepGuard
+   flush wrap (``rt.flush`` is an instance attribute and the subsystem
+   holds the guard).
+
+Run from tier-1 (tests/test_fleet_guard.py); exits non-zero on any gap.
+"""
+
+import inspect
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STREAM = "define stream S (sym string, v double, n long);\n"
+
+failures = []
+
+
+def check(name, cond, detail=""):
+    if cond:
+        print(f"OK   {name}")
+    else:
+        failures.append(name)
+        print(f"FAIL {name} {detail}")
+
+
+def main() -> int:
+    from siddhi_tpu import SiddhiManager
+
+    m = SiddhiManager()
+    try:
+        # 1) fleet group step
+        rt = m.create_siddhi_app_runtime(
+            "@app(name='lint-fleet')\n@app:fleet(batch='64')\n" + STREAM +
+            "from S[v > 1.0] select v insert into Out;", playback=True)
+        rt.start()
+        group = rt.fleet_bridges[0].group
+        from siddhi_tpu.resilience.fleet_guard import FleetGuard
+        check("fleet group has a FleetGuard",
+              isinstance(group.guard, FleetGuard))
+        src = inspect.getsource(type(group)._step)
+        check("FleetGroup._step routes through the guard",
+              "self.guard" in src and "step_batched" in src)
+        ssrc = inspect.getsource(type(group).stage_rows)
+        check("FleetGroup staging routes through the guard (admit/solo)",
+              "admit" in ssrc and "solo_stage" in ssrc)
+
+        # 2) device dispatch/collect (DeviceGuard two-phase wrap)
+        drt = m.create_siddhi_app_runtime(
+            "@app(name='lint-device')\n" + STREAM +
+            "@device from S[v > 1.0] select v insert into Out;",
+            playback=True)
+        drt.start()
+        check("device query built a bridge", len(drt.device_bridges) == 1)
+        if drt.device_bridges:
+            b = drt.device_bridges[0]
+            inner = b.runtime
+            check("device runtime dispatch/collect wrapped in place",
+                  "dispatch" in vars(inner) and "collect" in vars(inner),
+                  "(DeviceGuard.install shadows the class methods)")
+            check("app resilience holds the DeviceGuard",
+                  len(drt.resilience.guards) == 1)
+            from siddhi_tpu.resilience.device_guard import _ShadowBuilder
+            check("device builder carries the host shadow",
+                  isinstance(inner.builder, _ShadowBuilder))
+
+        # 3) host_batch step (HostStepGuard flush wrap)
+        hrt = m.create_siddhi_app_runtime(
+            "@app(name='lint-host')\n@app:host_batch(batch='64')\n" + STREAM +
+            "from S[v > 1.0] select v insert into Out;", playback=True)
+        hrt.start()
+        check("host query built a bridge", len(hrt.host_bridges) == 1)
+        if hrt.host_bridges:
+            hb = hrt.host_bridges[0]
+            check("host runtime flush wrapped in place",
+                  "flush" in vars(hb.runtime),
+                  "(HostStepGuard.install shadows the class method)")
+            check("app resilience holds the HostStepGuard",
+                  len(hrt.resilience.host_guards) == 1)
+        # ... including partition blocks on the host tier
+        prt = m.create_siddhi_app_runtime(
+            "@app(name='lint-hostpart')\n@app:host_batch(batch='64')\n" + STREAM +
+            "partition with (sym of S) begin "
+            "from every e1=S[v > 90.0] -> e2=S[v > e1.v] "
+            "select e1.v as a, e2.v as b insert into Out; end;",
+            playback=True)
+        prt.start()
+        check("host partition bridges guarded",
+              len(prt.host_bridges) >= 1 and
+              len(prt.resilience.host_guards) == len(prt.host_bridges))
+    finally:
+        m.shutdown()
+
+    if failures:
+        print(f"\n{len(failures)} guard-coverage gap(s)", file=sys.stderr)
+        return 1
+    print("\nguard coverage OK: fleet group step, device dispatch/collect, "
+          "host_batch step")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

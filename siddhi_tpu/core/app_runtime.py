@@ -423,6 +423,14 @@ class SiddhiAppRuntime:
                              lambda x=g: x.breaker.state_code)
             sm.gauge_tracker(f"device.{g.query_name}.fallback_events",
                              lambda x=g: x.fallback_events)
+        # host-batch step containment (HostStepGuard): circuit + replay
+        # evidence per columnar query, torn down with the host_batch.{q}
+        # family on shutdown
+        for g in self.resilience.host_guards:
+            sm.gauge_tracker(f"host_batch.{g.query_name}.circuit_state",
+                             lambda x=g: x.breaker.state_code)
+            sm.gauge_tracker(f"host_batch.{g.query_name}.fallback_events",
+                             lambda x=g: x.fallback_events)
         if self.resilience.chaos is not None:
             for key in self.resilience.chaos.counters:
                 sm.gauge_tracker(

@@ -360,9 +360,21 @@ def _app_plan_cache(app_context) -> dict:
     return c
 
 
+def _guard_host_bridge(bridge, query, app_context, stream_defs,
+                       get_junction) -> None:
+    """Containment for the columnar step (resilience/fleet_guard.py
+    HostStepGuard): a failing micro-batch replays through the scalar
+    interpreter and repeated failures quarantine the columnar path —
+    the host-tier analog of the DeviceGuard wrap."""
+    resilience = getattr(getattr(app_context, "runtime", None),
+                         "resilience", None)
+    if resilience is not None:
+        resilience.guard_host(bridge, query, stream_defs, get_junction)
+
+
 def try_build_host_query(query: Query, app_context, stream_defs: dict,
-                         get_junction, name: str,
-                         cfg: Optional[dict]) -> Optional[HostQueryBridge]:
+                         get_junction, name: str, cfg: Optional[dict],
+                         guard: bool = True) -> Optional[HostQueryBridge]:
     """Columnar host bridge for one top-level query, or None → scalar path.
 
     Tried AFTER the device path (``@device`` wins when both apply): an
@@ -432,6 +444,9 @@ def try_build_host_query(query: Query, app_context, stream_defs: dict,
         return None
     _attach_adaptive(rt, app_context, batch)
     app_context.register_state(f"host-{name}", _HostBridgeState(bridge))
+    if guard:
+        _guard_host_bridge(bridge, query, app_context, stream_defs,
+                           get_junction)
     return bridge
 
 
@@ -487,11 +502,13 @@ def try_build_host_partition(partition_ast, app_context, stream_defs: dict,
     except DeviceCompileError as e:
         log.info("partition '%s' keeps the per-key interpreter: %s", name, e)
         return None
-    for bridge in bridges:
+    for bridge, q in zip(bridges, partition_ast.queries):
         _attach_adaptive(bridge.runtime, app_context, cfg.get("batch",
                                                               _DEF_BATCH))
         app_context.register_state(f"host-{bridge.query_name}",
                                    _HostBridgeState(bridge))
+        _guard_host_bridge(bridge, q, app_context, stream_defs,
+                           get_junction)
     return bridges
 
 
@@ -534,9 +551,11 @@ class HostFallbackRuntime:
 
 def build_host_fallback(query: Query, app_context, stream_defs: dict,
                         get_junction, name: str) -> Optional[HostFallbackRuntime]:
+    # guard=False: this bridge IS a guard's fallback engine (DeviceGuard
+    # quarantine) — wrapping it in a HostStepGuard would nest containment
     bridge = try_build_host_query(query, app_context, stream_defs,
                                   get_junction, name,
-                                  {"batch": _DEF_BATCH})
+                                  {"batch": _DEF_BATCH}, guard=False)
     if bridge is None:
         return None
     return HostFallbackRuntime(bridge)

@@ -159,6 +159,20 @@ class FleetMember:
         self.events_in = 0
         self.batches = 0
         self.attached_at = time.monotonic()
+        # guard surface (resilience/fleet_guard.py): an ejected member's
+        # rows bypass the shared stager and step solo; weight/max_lag drive
+        # the fair-share window quotas; chaos is the member app's injector
+        # (fleet.fault.p targets its OWN lanes)
+        self.ejected = False
+        self.weight = 1.0
+        self.max_lag = 0               # 0 = unlimited
+        self.chaos = None
+        self.lane = None               # TenantLane once guarded
+        # solo-ladder build context (scalar escalation needs the original
+        # query AST + the app's junction resolver)
+        self.query = None
+        self.solo_stream_defs = None
+        self.get_junction = None
 
     @property
     def ev_per_s(self) -> float:
@@ -212,9 +226,11 @@ class FleetQueryBridge:
     # -- drain ------------------------------------------------------------
     def flush(self, cause: str = "drain") -> None:
         self.group.flush(cause)
+        self.group._drain_guard(self.member)
 
     def finalize(self) -> None:
         self.group.flush("final")
+        self.group._drain_guard(self.member)
 
     # -- demuxed output ---------------------------------------------------
     def deliver(self, ts_list: list, rows: list) -> None:
@@ -230,11 +246,14 @@ class FleetQueryBridge:
             self.member.output_junction.send_events(events)
 
     def report(self) -> dict:
-        return {"query": self.query_name, "engine": "fleet",
-                "kind": self.group.kind, "shape": self.group.shape_key,
-                "mode": self.group.mode, "events": self.member.events_in,
-                "batches": self.member.batches,
-                "members": len(self.group.members)}
+        out = {"query": self.query_name, "engine": "fleet",
+               "kind": self.group.kind, "shape": self.group.shape_key,
+               "mode": self.group.mode, "events": self.member.events_in,
+               "batches": self.member.batches,
+               "members": len(self.group.members)}
+        if self.member.lane is not None:
+            out["guard"] = self.member.lane.report()
+        return out
 
 
 class FleetMemberState:
@@ -285,6 +304,9 @@ class FleetGroup:
         self.lanes_last_step = 0
         self.events_in = 0
         self.flush_causes: dict[str, int] = {}
+        self._stream_defs = dict(stream_defs or {})
+        self.guard = None             # FleetGuard (resilience/fleet_guard.py)
+        self.batch_controller = None  # @app:adaptive AIMD window sizing
         if kind == "stream":
             self.schema = plan.compiled.schema
             self.stager = FleetStager(self.schema, None, self.capacity)
@@ -325,6 +347,8 @@ class FleetGroup:
             m.state = self._init_member_state(m)
             self.members[mid] = m
             self._luts = None
+            if self.guard is not None:
+                self.guard.attach(m)
             return m
 
     def remove_member(self, member: FleetMember) -> int:
@@ -332,6 +356,8 @@ class FleetGroup:
         with self._lock:
             self.flush("member-leave")
             self.members.pop(member.mid, None)
+            if self.guard is not None:
+                self.guard.detach(member)
             self._luts = None
             return len(self.members)
 
@@ -391,28 +417,120 @@ class FleetGroup:
         m.state = st
 
     # -- staging -----------------------------------------------------------
+    # each staging entry drains the guard's deferred scalar replays AFTER
+    # releasing the group lock (they acquire the member app's root_lock —
+    # taking it under the group lock would invert the snapshot walk's
+    # root_lock → group._lock order)
+
     def stage_event(self, m: FleetMember, gsid: str, data, ts: int) -> None:
-        with self._lock:
-            self.stager.stage_event(m.mid, gsid, data, ts)
-            if self.stager.full:
-                self._step("full")
+        try:
+            with self._lock:
+                g = self.guard
+                if g is not None:
+                    if m.ejected:
+                        g.solo_stage(m, gsid, [data], [ts])
+                        return
+                    if g.admit(m, gsid, [data]) == 0:
+                        return
+                self.stager.stage_event(m.mid, gsid, data, ts)
+                self._post_stage(m)
+        finally:
+            self._drain_guard(m)
 
     def stage_events(self, m: FleetMember, gsid: str, events: list) -> None:
-        with self._lock:
-            self.stager.stage_events(m.mid, gsid, events)
-            if self.stager.full:
-                self._step("full")
+        try:
+            with self._lock:
+                g = self.guard
+                if g is not None:
+                    if m.ejected:
+                        g.solo_stage(m, gsid, [e.data for e in events],
+                                     [e.timestamp for e in events])
+                        return
+                    k = g.admit(m, gsid, [e.data for e in events])
+                    if k == 0:
+                        return
+                    if k < len(events):
+                        events = events[:k]
+                self.stager.stage_events(m.mid, gsid, events)
+                self._post_stage(m)
+        finally:
+            self._drain_guard(m)
 
-    def stage_rows(self, m: FleetMember, gsid: str, rows, timestamps) -> None:
-        with self._lock:
-            self.stager.stage_rows(m.mid, gsid, rows, timestamps)
-            if self.stager.full:
-                self._step("full")
+    def stage_rows(self, m: FleetMember, gsid: str, rows,
+                   timestamps) -> None:
+        try:
+            with self._lock:
+                g = self.guard
+                if g is not None:
+                    if m.ejected:
+                        g.solo_stage(m, gsid, rows, timestamps)
+                        return
+                    k = g.admit(m, gsid, rows)
+                    if k == 0:
+                        return
+                    if k < len(rows):
+                        rows = rows[:k]
+                        timestamps = timestamps[:k]
+                self.stager.stage_rows(m.mid, gsid, rows, timestamps)
+                self._post_stage(m)
+        finally:
+            self._drain_guard(m)
+
+    def _drain_guard(self, m: FleetMember) -> None:
+        g = self.guard
+        if g is not None:
+            g.drain_deferred(m.app_context)
+
+    def _post_stage(self, m: FleetMember) -> None:
+        if self.stager.full:
+            self._step("full")
+            return
+        c = self.batch_controller
+        if c is not None and len(self.stager) >= c.current:
+            self._step("adaptive")
+            return
+        g = self.guard
+        if g is not None and g.fair_share_flush_due(m):
+            self._step("fair_share")
+
+    def effective_window(self) -> int:
+        """The flush window fair-share quotas divide: the adaptive AIMD
+        threshold when a controller is attached, the static capacity
+        otherwise."""
+        c = self.batch_controller
+        return min(self.capacity, c.current) if c is not None \
+            else self.capacity
+
+    def make_stager(self):
+        """A PRIVATE stager over the group's shared schema (same dictionary
+        tables, so codes stay comparable) — the guard's solo tier stages
+        an ejected tenant's rows here."""
+        from ..tpu.host_exec import HostRowStager
+        if self.kind == "stream":
+            return HostRowStager(self.schema, None, self.capacity)
+        return HostRowStager(self.schema, dict(self._stream_defs),
+                             self.capacity,
+                             used_cols=self.plan.compiler.used_cols)
+
+    def stream_defs_for(self, gsid: str):
+        d = self._stream_defs.get(gsid)
+        if d is None and self.kind == "stream":
+            return self.schema.definition
+        return d
 
     def flush(self, cause: str = "drain") -> None:
         with self._lock:
             if len(self.stager):
                 self._step(cause)
+            g = self.guard
+            if g is not None:
+                for m in list(self.members.values()):
+                    lane = g.lanes.get(m.mid)
+                    if lane is None:
+                        continue
+                    if m.ejected or (lane.solo_stager is not None
+                                     and len(lane.solo_stager)):
+                        g.flush_solo(m, lane, cause)
 
     # -- the stepped program ----------------------------------------------
     def _param_luts(self) -> list:
@@ -444,24 +562,43 @@ class FleetGroup:
                 n, val, dtype=_param_dtype(spec))
 
     def _step(self, cause: str) -> None:
-        b = self.stager.emit()
+        g = self.guard
+        b = g.emit(self.stager) if g is not None else self.stager.emit()
+        mids = b["mid"]
+        if g is not None:
+            b, mids = g.sweep_nonfinite(b, mids)
         n = b["count"]
         if n == 0:
+            if g is not None:
+                g.on_window_reset()
             return
         self.steps += 1
         self.events_in += n
         self.flush_causes[cause] = self.flush_causes.get(cause, 0) + 1
-        mids = b["mid"]
+        t0 = time.perf_counter()
         with np.errstate(all="ignore"):
             if self.mode == "batched":
-                self._step_batched(b, mids)
+                if g is not None:
+                    g.step_batched(b, mids)
+                else:
+                    self._run_batched(b, mids)
             else:
                 self._step_sliced(b, mids)
+        c = self.batch_controller
+        if c is not None:
+            c.observe(n, time.perf_counter() - t0)
 
-    def _step_batched(self, b: dict, mids: np.ndarray) -> None:
+    def _run_batched(self, b: dict, mids: np.ndarray) -> None:
+        self._deliver_batched(self._compute_batched(b, mids))
+
+    def _compute_batched(self, b: dict, mids: np.ndarray) -> list:
         """One vectorized step over every tenant's rows at once (stateless
         stream shapes): per-tenant constants ride as gathered per-row
-        parameter columns; outputs demux by member id."""
+        parameter columns; outputs demux by member id. Returns the demuxed
+        deliveries ``[(member, ts_list, rows)]`` WITHOUT delivering — the
+        guard wraps only this compute phase, so a downstream receiver
+        raising during delivery is never mistaken for a tenant-lane fault
+        (which would replay already-delivered rows)."""
         cols = dict(b["cols"])
         cols.update(self._param_cols_for(mids))
         _st, res = self.plan.hq.step({}, cols, b["ts"])
@@ -474,13 +611,14 @@ class FleetGroup:
                 m.batches += 1
         j = res.get("j")
         if j is None or j.size == 0:
-            return
+            return []
         ts_list, rows = self.plan.hq.decode(res)       # batched decode
         out_mid = mids[j]
         order = np.argsort(out_mid, kind="stable")
         sorted_mid = out_mid[order]
         starts = np.r_[0, np.nonzero(np.diff(sorted_mid))[0] + 1,
                        sorted_mid.size]
+        deliveries = []
         for si in range(starts.size - 1):
             lo, hi = int(starts[si]), int(starts[si + 1])
             if lo == hi:
@@ -489,58 +627,108 @@ class FleetGroup:
             if m is None or m.bridge is None:
                 continue              # member left with rows in flight
             idx = order[lo:hi]
-            m.bridge.deliver([ts_list[i] for i in idx],
-                             [rows[i] for i in idx])
+            deliveries.append((m, [ts_list[i] for i in idx],
+                               [rows[i] for i in idx]))
+        return deliveries
+
+    @staticmethod
+    def _deliver_batched(deliveries: list) -> None:
+        for m, ts_list, rows in deliveries:
+            m.bridge.deliver(ts_list, rows)
 
     def _step_sliced(self, b: dict, mids: np.ndarray) -> None:
         """One step iterating member lanes of the merged batch (stateful
-        shapes): stable member segments preserve per-tenant event order."""
-        order = np.argsort(mids, kind="stable")
-        sorted_mid = mids[order]
-        starts = np.r_[0, np.nonzero(np.diff(sorted_mid))[0] + 1,
-                       sorted_mid.size]
-        lanes = 0
-        cols_all = b["cols"]
-        for si in range(starts.size - 1):
-            lo, hi = int(starts[si]), int(starts[si + 1])
-            if lo == hi:
-                continue
-            m = self.members.get(int(sorted_mid[lo]))
-            if m is None:
-                continue
-            lanes += 1
-            idx = order[lo:hi]
-            nseg = idx.size
-            cols_m = {k: v[idx] for k, v in cols_all.items()}
-            self._inject_member_params(cols_m, m, nseg)
-            ts_m = b["ts"][idx]
+        shapes): stable member segments preserve per-tenant event order.
+        Under a guard each segment runs contained — the faulting segment IS
+        the culprit, co-tenants' segments are untouched."""
+        g = self.guard
+        if g is not None:
+            g.begin_sliced_step(mids)
+        try:
+            order = np.argsort(mids, kind="stable")
+            sorted_mid = mids[order]
+            starts = np.r_[0, np.nonzero(np.diff(sorted_mid))[0] + 1,
+                           sorted_mid.size]
+            lanes = 0
+            cols_all = b["cols"]
+            for si in range(starts.size - 1):
+                lo, hi = int(starts[si]), int(starts[si + 1])
+                if lo == hi:
+                    continue
+                m = self.members.get(int(sorted_mid[lo]))
+                if m is None:
+                    continue
+                lanes += 1
+                idx = order[lo:hi]
+                cols_m = {k: v[idx] for k, v in cols_all.items()}
+                self._inject_member_params(cols_m, m, idx.size)
+                ts_m = b["ts"][idx]
+                tag_m = b["tag"][idx]
+                if g is not None:
+                    g.step_segment(m, cols_m, tag_m, ts_m)
+                else:
+                    self._run_segment(m, cols_m, tag_m, ts_m)
+            self.lanes_last_step = lanes
+        finally:
+            if g is not None:
+                g.end_sliced_step()
+
+    def _run_segment(self, m: FleetMember, cols_m: dict, tag_m,
+                     ts_m) -> None:
+        self._deliver_segment(m, self._compute_segment(m, cols_m, tag_m,
+                                                       ts_m))
+
+    def _compute_segment(self, m: FleetMember, cols_m: dict, tag_m,
+                         ts_m):
+        """One member's slice of the batch through the shared program
+        against its own state — also the guard's solo-tier execution path
+        (a private stager feeds the same call with the member alone).
+        Returns ``(ts_list, rows)`` WITHOUT delivering: the guard wraps
+        only this state-advancing compute, so a downstream receiver
+        raising during delivery cannot be mistaken for a tenant-lane fault
+        (which would double-count the already-advanced state)."""
+        nseg = ts_m.size
+        if self.kind == "stream":
+            m.state, res = self.plan.hq.step(m.state, cols_m, ts_m)
+            ts_list, rows = self.plan.hq.decode(res)
             m.events_in += nseg
             m.batches += 1
-            if self.kind == "stream":
-                m.state, res = self.plan.hq.step(m.state, cols_m, ts_m)
-                ts_list, rows = self.plan.hq.decode(res)
-                m.bridge.deliver(ts_list, rows)
-            elif self.kind == "nfa":
-                tag_m = b["tag"][idx]
-                m.state, outs = self.plan.engine.step(
-                    m.state, cols_m, tag_m, ts_m)
-                if outs and outs["j"].size:
-                    rows = decode_columns(self.plan.engine.out_specs, outs,
-                                          self.dictionaries)
-                    m.bridge.deliver(outs["ts"].tolist(), rows)
-            else:                      # partition
-                _j, outs = m.prt.process(
-                    {"cols": cols_m, "ts": ts_m, "count": nseg})
-                if outs:
-                    m.bridge.deliver(outs["ts"].tolist(),
-                                     m.prt.decode(outs))
-        self.lanes_last_step = lanes
+            return ts_list, rows
+        if self.kind == "nfa":
+            m.state, outs = self.plan.engine.step(
+                m.state, cols_m, tag_m, ts_m)
+            m.events_in += nseg
+            m.batches += 1
+            if outs and outs["j"].size:
+                rows = decode_columns(self.plan.engine.out_specs, outs,
+                                      self.dictionaries)
+                return outs["ts"].tolist(), rows
+            return [], []
+        # partition
+        _j, outs = m.prt.process(
+            {"cols": cols_m, "ts": ts_m, "count": nseg})
+        m.events_in += nseg
+        m.batches += 1
+        if outs:
+            return outs["ts"].tolist(), m.prt.decode(outs)
+        return [], []
+
+    @staticmethod
+    def _deliver_segment(m: FleetMember, out) -> None:
+        ts_list, rows = out
+        if rows and m.bridge is not None:
+            m.bridge.deliver(ts_list, rows)
 
     def report(self) -> dict:
         with self._lock:
-            return {"shape": self.shape_key, "kind": self.kind,
-                    "mode": self.mode, "members": len(self.members),
-                    "steps": self.steps, "events": self.events_in,
-                    "lanes_last_step": self.lanes_last_step,
-                    "staged": len(self.stager),
-                    "flush_causes": dict(self.flush_causes)}
+            out = {"shape": self.shape_key, "kind": self.kind,
+                   "mode": self.mode, "members": len(self.members),
+                   "steps": self.steps, "events": self.events_in,
+                   "lanes_last_step": self.lanes_last_step,
+                   "staged": len(self.stager),
+                   "flush_causes": dict(self.flush_causes)}
+            if self.guard is not None:
+                out["guard"] = self.guard.report()
+            if self.batch_controller is not None:
+                out["adaptive"] = self.batch_controller.report()
+            return out

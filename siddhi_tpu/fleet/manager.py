@@ -36,7 +36,13 @@ _DEF_LANES = 16
 
 
 def fleet_config(app_annotations) -> Optional[dict]:
-    """App-level opt-in (``@app:fleet`` or SIDDHI_FLEET=1) → config dict."""
+    """App-level opt-in (``@app:fleet`` or SIDDHI_FLEET=1) → config dict.
+
+    Guard/fair-share surface: ``weight`` and ``max_lag_events`` are
+    PER-TENANT knobs (this app's lanes); ``guard``, ``guard.threshold``,
+    ``guard.cooldown.ms``, ``guard.readmit.batches``, ``harden`` and
+    ``dict.cap`` configure the shape group's FleetGuard and are taken from
+    the group's FIRST enrolling tenant."""
     ann = find_annotation(app_annotations, "fleet")
     if ann is None and os.environ.get("SIDDHI_FLEET", "") != "1":
         return None
@@ -50,6 +56,24 @@ def fleet_config(app_annotations) -> Optional[dict]:
             cfg["lanes"] = int(ann.get("lanes"))
         if ann.get("cache"):
             cfg["cache"] = int(ann.get("cache"))
+        if ann.get("weight"):
+            cfg["weight"] = float(ann.get("weight"))
+        if ann.get("max_lag_events"):
+            cfg["max_lag_events"] = int(ann.get("max_lag_events"))
+        if ann.get("guard"):
+            cfg["guard"] = ann.get("guard").lower() != "false"
+        if ann.get("guard.threshold"):
+            cfg["guard_threshold"] = int(ann.get("guard.threshold"))
+        if ann.get("guard.cooldown.ms"):
+            cfg["guard_cooldown_s"] = \
+                float(ann.get("guard.cooldown.ms")) / 1000.0
+        if ann.get("guard.readmit.batches"):
+            cfg["guard_readmit_batches"] = \
+                int(ann.get("guard.readmit.batches"))
+        if ann.get("harden"):
+            cfg["harden"] = ann.get("harden").lower() != "false"
+        if ann.get("dict.cap"):
+            cfg["dict_cap"] = int(ann.get("dict.cap"))
     return cfg
 
 
@@ -99,6 +123,9 @@ class _PartitionPlan:
         self.stream_defs = dict(stream_defs)
 
 
+_FALLBACK_LOG_CAP = 100
+
+
 class FleetManager:
     def __init__(self, cache_size: int = 256):
         self.plan_cache = PlanCache(cache_size)
@@ -106,6 +133,16 @@ class FleetManager:
         self._lock = threading.RLock()
         self.fallbacks = 0
         self.enrolled = 0
+        # solo-fallback evidence (satellite): fleets must not degrade
+        # silently — every enrollment that kept the solo path is counted
+        # and its reason kept for GET /siddhi-apps/{name}/fleet
+        self.fallback_reasons: list[dict] = []
+
+    def _note_fallback(self, app: str, name: str, reason: str) -> None:
+        self.fallbacks += 1
+        self.fallback_reasons.append(
+            {"app": app, "query": name, "reason": reason})
+        del self.fallback_reasons[:-_FALLBACK_LOG_CAP]
 
     # ------------------------------------------------------------------ enroll
     def enroll_query(self, query: Query, app_context, stream_defs: dict,
@@ -121,7 +158,8 @@ class FleetManager:
         try:
             normalized = normalize_query(query, stream_defs)
         except FleetShapeError as e:
-            self.fallbacks += 1
+            self._note_fallback(app_context.name, name,
+                                f"no fleet shape: {e}")
             log.info("query '%s' keeps the solo path (no fleet shape): %s",
                      name, e)
             return None
@@ -148,7 +186,8 @@ class FleetManager:
                                                        stream_defs)
                 plans.append((normalized, q, qname))
         except FleetShapeError as e:
-            self.fallbacks += 1
+            self._note_fallback(app_context.name, name,
+                                f"no fleet shape: {e}")
             log.info("partition '%s' keeps the solo path (no fleet shape): "
                      "%s", name, e)
             return None
@@ -179,6 +218,23 @@ class FleetManager:
                         normalized.shape_key, normalized.kind, entry.plan,
                         cfg, normalized.stream_ids, stream_defs,
                         normalized.param_specs)
+                    if cfg.get("guard", True):
+                        from ..resilience.fleet_guard import FleetGuard
+                        group.guard = FleetGuard(group, cfg)
+                    if app_context.adaptive_cfg is not None:
+                        # @app:adaptive of the first enrolling tenant sizes
+                        # the group's shared flush window (AIMD); fair-share
+                        # quotas divide whatever window it picks
+                        from ..flow.adaptive_batch import \
+                            AdaptiveBatchController
+                        acfg = dict(app_context.adaptive_cfg)
+                        acfg["max_batch"] = min(
+                            acfg.get("max_batch", group.capacity),
+                            group.capacity)
+                        acfg["min_batch"] = min(acfg.get("min_batch", 64),
+                                                acfg["max_batch"])
+                        group.batch_controller = \
+                            AdaptiveBatchController(**acfg)
                     self.groups[normalized.shape_key] = group
                     self.plan_cache.pin(normalized.shape_key, "numpy")
                 else:
@@ -186,7 +242,8 @@ class FleetManager:
                         normalized.shape_key, "numpy",
                         lambda: group.plan)        # count the shape-cache hit
         except DeviceCompileError as e:
-            self.fallbacks += 1
+            self._note_fallback(app_context.name, name,
+                                f"shape does not lower: {e}")
             log.info("query '%s' keeps the solo path (shape does not "
                      "lower): %s", name, e)
             return None
@@ -198,6 +255,17 @@ class FleetManager:
             app_context.name, name, app_context, target,
             normalized.param_values, normalized.overrides,
             list(normalized.stream_ids))
+        # guard surface: fair-share knobs are per tenant; the member's own
+        # app chaos injector targets its own lanes (fleet.fault.p), and the
+        # scalar-escalation ladder needs the original query + junctions
+        member.weight = float(cfg.get("weight", 1.0))
+        member.max_lag = int(cfg.get("max_lag_events", 0))
+        runtime = getattr(app_context, "runtime", None)
+        resilience = getattr(runtime, "resilience", None)
+        member.chaos = getattr(resilience, "chaos", None)
+        member.query = query
+        member.solo_stream_defs = dict(stream_defs)
+        member.get_junction = get_junction
         bridge = FleetQueryBridge(group, member)
         app_context.register_state(f"fleet-{name}",
                                    FleetMemberState(group, member))
@@ -277,6 +345,27 @@ class FleetManager:
                          lambda c=self.plan_cache: c.misses)
         sm.gauge_tracker("fleet.shape_cache.evictions",
                          lambda c=self.plan_cache: c.evictions)
+        # solo-fallback evidence: fleets must not degrade silently
+        sm.gauge_tracker("fleet.solo_fallbacks", lambda s=self: s.fallbacks)
+        # guard families (fleet.tenant.*): ejection/readmit/shed evidence
+        # per tenant lane — torn down with the rest of the fleet.* family
+        # on app shutdown (StatisticsManager.unregister("fleet."))
+        lane = member.lane
+        if lane is not None:
+            sm.gauge_tracker(f"fleet.tenant.{q}.ejections",
+                             lambda x=lane: x.ejections)
+            sm.gauge_tracker(f"fleet.tenant.{q}.readmissions",
+                             lambda x=lane: x.readmissions)
+            sm.gauge_tracker(f"fleet.tenant.{q}.shed",
+                             lambda x=lane: x.shed)
+            sm.gauge_tracker(f"fleet.tenant.{q}.poisoned",
+                             lambda x=lane: x.poisoned)
+            sm.gauge_tracker(f"fleet.tenant.{q}.solo_batches",
+                             lambda x=lane: x.solo_batches)
+            sm.gauge_tracker(f"fleet.tenant.{q}.circuit_state",
+                             lambda x=lane: x.breaker.state_code)
+            sm.gauge_tracker(f"fleet.tenant.{q}.arrival_evps",
+                             lambda x=lane: x.arrival_evps)
 
     def stats(self) -> dict:
         with self._lock:
@@ -286,4 +375,5 @@ class FleetManager:
                     "members": sum(len(g.members)
                                    for g in self.groups.values()),
                     "enrolled": self.enrolled,
-                    "fallbacks": self.fallbacks}
+                    "fallbacks": self.fallbacks,
+                    "fallback_reasons": list(self.fallback_reasons)}

@@ -23,7 +23,7 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 _SCOPE_LABEL = {"stream": "stream", "flow": "stream", "device": "query",
                 "query": "query", "partition": "query", "source": "stream",
-                "dcn": "peer"}
+                "dcn": "peer", "host_batch": "query"}
 _SAN = re.compile(r"[^a-z0-9_]+")
 
 
@@ -39,6 +39,17 @@ def _split_key(key: str) -> tuple[str, dict, Optional[str]]:
     if scope == "sink" and len(parts) >= 3:
         field = ".".join(parts[3:]) or None
         return scope, {"stream": parts[1], "ordinal": parts[2]}, field
+    if scope == "fleet" and len(parts) >= 2:
+        # fleet.tenant.{q}.<field> — the FleetGuard per-lane families;
+        # fleet.shape_cache.* / fleet.solo_fallbacks are engine-wide (no
+        # query label); fleet.{q}.<field> are the per-member lane gauges
+        if parts[1] == "tenant" and len(parts) >= 4:
+            return scope, {"query": parts[2]}, \
+                "tenant." + ".".join(parts[3:])
+        if parts[1] in ("shape_cache", "solo_fallbacks"):
+            return scope, {}, ".".join(parts[1:])
+        field = ".".join(parts[2:]) or None
+        return scope, {"query": parts[1]}, field
     if scope in _SCOPE_LABEL and len(parts) >= 2:
         field = ".".join(parts[2:]) or None
         return scope, {_SCOPE_LABEL[scope]: parts[1]}, field

@@ -42,15 +42,17 @@ from .dcn_guard import (
     SpillQueue,
 )
 from .device_guard import DeviceGuard
+from .fleet_guard import FleetGuard, HostStepGuard
 from .sink_pipeline import OnErrorPolicy, ResilientSink, parse_sink_policy
 
 log = logging.getLogger("siddhi_tpu.resilience")
 
 __all__ = [
     "ChaosFault", "ChaosInjector", "CircuitBreaker", "CircuitState",
-    "DCNGuard", "DCNGuardConfig", "DeviceGuard", "LaneGroupSnapshotStore",
-    "OnErrorPolicy", "PeerHealth", "ResilienceSubsystem", "ResilientSink",
-    "SpillQueue", "parse_chaos_annotation", "parse_sink_policy",
+    "DCNGuard", "DCNGuardConfig", "DeviceGuard", "FleetGuard",
+    "HostStepGuard", "LaneGroupSnapshotStore", "OnErrorPolicy",
+    "PeerHealth", "ResilienceSubsystem", "ResilientSink", "SpillQueue",
+    "parse_chaos_annotation", "parse_sink_policy",
 ]
 
 
@@ -82,8 +84,21 @@ class ResilienceSubsystem:
                     res_ann.get("device.circuit.cooldown.ms")) / 1000.0
             self.device_quarantine = (
                 res_ann.get("device.quarantine") or "true").lower() != "false"
+        self.host_threshold = 3
+        self.host_cooldown_s = 30.0
+        self.host_quarantine = True
+        if res_ann is not None:
+            if res_ann.get("host.circuit.threshold"):
+                self.host_threshold = int(
+                    res_ann.get("host.circuit.threshold"))
+            if res_ann.get("host.circuit.cooldown.ms"):
+                self.host_cooldown_s = float(
+                    res_ann.get("host.circuit.cooldown.ms")) / 1000.0
+            self.host_quarantine = (
+                res_ann.get("host.quarantine") or "true").lower() != "false"
         self.sinks: list[ResilientSink] = []
         self.guards: list[DeviceGuard] = []
+        self.host_guards: list[HostStepGuard] = []
         self.shutdown_signal = threading.Event()
         self._sink_ordinals: dict[str, int] = {}
 
@@ -155,6 +170,23 @@ class ResilienceSubsystem:
         if guard is not None:
             guard.bridge = bridge
 
+    # -- host-batch containment ----------------------------------------------
+    def guard_host(self, bridge, query, stream_defs: dict, get_junction):
+        """Install a HostStepGuard over a freshly built columnar host
+        bridge (called from ``try_build_host_query`` /
+        ``try_build_host_partition``): a failing micro-batch replays through
+        the scalar interpreter, repeated failures quarantine the columnar
+        path. Returns the guard, or None when disabled."""
+        if not self.host_quarantine:
+            return None
+        guard = HostStepGuard(
+            bridge, query, self.runtime.ctx, stream_defs, get_junction,
+            failure_threshold=self.host_threshold,
+            cooldown_s=self.host_cooldown_s)
+        guard.install()
+        self.host_guards.append(guard)
+        return guard
+
     # -- sources (chaos only: retry/jitter lives on Source itself) -----------
     def wrap_source_handler(self, stream_id: str, handler):
         if self.chaos is None:
@@ -199,6 +231,7 @@ class ResilienceSubsystem:
         out = {
             "sinks": [s.report() for s in self.sinks],
             "device": [g.report() for g in self.guards],
+            "host_batch": [g.report() for g in self.host_guards],
         }
         if self.chaos is not None:
             out["chaos"] = self.chaos.report()

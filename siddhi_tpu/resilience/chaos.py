@@ -13,7 +13,12 @@ failure-prone surfaces:
   ``connect.fail.p`` fails source ``connect()`` calls to exercise
   ``connect_with_retry``;
 - **device steps** — compiled micro-batch steps raise :class:`ChaosFault`,
-  driving the device guard's host fallback and quarantine;
+  driving the device guard's host fallback and quarantine (``latency.ms``
+  applies here too, so slow-device scenarios are testable);
+- **fleet group steps** — ``fleet.fault.p`` faults the owning tenant's
+  lanes of a shared fleet batch (the injector is app-scoped, so the blast
+  targets exactly one tenant), driving the FleetGuard's bisection
+  containment, ejection and re-admission;
 - **DCN frames** — ``dcn.drop.p`` drops a forwarded frame's ack on the
   sender side (the frame may have applied — exercising retry + receiver
   dedup), ``dcn.kill.p`` kills the serving connection before the frame
@@ -44,7 +49,7 @@ class ChaosInjector:
                  sink_fail_p: float = 0.0, device_fail_p: float = 0.0,
                  connect_fail_p: float = 0.0, latency_ms: float = 0.0,
                  dcn_drop_p: float = 0.0, dcn_kill_p: float = 0.0,
-                 dcn_delay_ms: float = 0.0):
+                 dcn_delay_ms: float = 0.0, fleet_fault_p: float = 0.0):
         self.seed = int(seed)
         self.source_fail_p = float(source_fail_p)
         self.sink_fail_p = float(sink_fail_p)
@@ -54,10 +59,11 @@ class ChaosInjector:
         self.dcn_drop_p = float(dcn_drop_p)
         self.dcn_kill_p = float(dcn_kill_p)
         self.dcn_delay_ms = float(dcn_delay_ms)
+        self.fleet_fault_p = float(fleet_fault_p)
         self._rngs: dict[str, random.Random] = {}
         self.counters = {"source_faults": 0, "sink_faults": 0,
                          "device_faults": 0, "connect_faults": 0,
-                         "dcn_drops": 0, "dcn_kills": 0}
+                         "dcn_drops": 0, "dcn_kills": 0, "fleet_faults": 0}
 
     def _rng(self, site: str) -> random.Random:
         rng = self._rngs.get(site)
@@ -93,10 +99,25 @@ class ChaosInjector:
                 f"chaos: sink fault injected at {site}")
 
     def on_device(self, site: str) -> None:
-        """Raises ChaosFault ahead of a device micro-batch step."""
+        """Raises ChaosFault ahead of a device micro-batch step.
+        ``latency.ms`` injects bounded random delay here too (unlike the
+        original source/sink-only coverage), so slow-device scenarios are
+        testable at the same site."""
+        self._latency(site)
         if self._roll(site, self.device_fail_p):
             self.counters["device_faults"] += 1
             raise ChaosFault(f"chaos: device fault injected at {site}")
+
+    def roll_fleet(self, site: str) -> bool:
+        """One roll of ``fleet.fault.p`` ahead of a shared fleet-group step.
+        The injector is app-scoped, so a hit faults the OWNING tenant's
+        lanes of the shared batch — the FleetGuard rolls ONCE per group step
+        and keeps the verdict across its bisection replays, so containment
+        observes a consistent fault."""
+        if self._roll(site, self.fleet_fault_p):
+            self.counters["fleet_faults"] += 1
+            return True
+        return False
 
     def on_connect(self, site: str) -> None:
         from ..core.io import ConnectionUnavailableError
@@ -134,6 +155,7 @@ class ChaosInjector:
                 "device": self.device_fail_p, "connect": self.connect_fail_p,
                 "dcn_drop": self.dcn_drop_p, "dcn_kill": self.dcn_kill_p,
                 "dcn_delay_ms": self.dcn_delay_ms,
+                "fleet": self.fleet_fault_p,
             },
             "counters": dict(self.counters),
         }
@@ -153,4 +175,5 @@ def parse_chaos_annotation(ann) -> Optional[ChaosInjector]:
         dcn_drop_p=float(ann.get("dcn.drop.p") or 0.0),
         dcn_kill_p=float(ann.get("dcn.kill.p") or 0.0),
         dcn_delay_ms=float(ann.get("dcn.delay.ms") or 0.0),
+        fleet_fault_p=float(ann.get("fleet.fault.p") or 0.0),
     )

@@ -234,6 +234,23 @@ class SpillQueue:
                 "forced": self.forced}
 
 
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a just-renamed entry survives power loss (on
+    POSIX the rename lives in the directory's own data). Platforms that
+    cannot open directories (Windows) skip silently — rename durability is
+    filesystem-provided there."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 class LaneGroupSnapshotStore:
     """Filesystem store of lane-group state revisions keyed by GLOBAL lane
     ids.
@@ -247,8 +264,11 @@ class LaneGroupSnapshotStore:
     ``batch.device_state_snapshot`` pins for single-host checkpoints).
     Because lane state is self-contained and lanes re-group contiguously,
     ANY host can restore a group's revision — that is the failover
-    primitive. Writes are tmp+rename so a reader never sees a torn
-    revision.
+    primitive. Writes are tmp+fsync+rename+dir-fsync: tmp+rename alone
+    keeps readers from seeing a TORN revision but not from losing the
+    revision entirely after power loss (the rename can hit disk before the
+    data, or never), so both writers fsync the tmp file before
+    ``os.replace`` and the parent directory after it.
     """
 
     def __init__(self, root: str, keep_revisions: int = 2):
@@ -293,7 +313,10 @@ class LaneGroupSnapshotStore:
             with open(tmp, "wb") as f:
                 np.savez(f, meta=np.frombuffer(meta.encode(), np.uint8),
                          **arrays)
+                f.flush()
+                os.fsync(f.fileno())    # data durable BEFORE the rename
             os.replace(tmp, path)
+            _fsync_dir(d)               # ... and the rename itself durable
             for stale in self._revisions(group)[:-self.keep_revisions]:
                 try:
                     os.remove(os.path.join(d, stale))
@@ -317,7 +340,12 @@ class LaneGroupSnapshotStore:
             tmp = path + ".tmp"
             with open(tmp, "w", encoding="utf-8") as f:
                 f.write(str(epoch))
+                f.flush()
+                os.fsync(f.fileno())    # an epoch lost to power loss would
+                # resurrect a dead incarnation's sequence space (peer dedup
+                # would then discard every fresh frame)
             os.replace(tmp, path)
+            _fsync_dir(self.root)
             return epoch
 
     def latest(self, group: int) -> Optional[dict]:

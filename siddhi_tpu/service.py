@@ -162,6 +162,10 @@ class SiddhiService:
                         and parts[2] == "dcn":
                     code, payload = service.dcn_stats(parts[1])
                     self._reply(code, payload)
+                elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                        and parts[2] == "fleet":
+                    code, payload = service.fleet_stats(parts[1])
+                    self._reply(code, payload)
                 else:
                     self._reply(404, {"status": "ERROR",
                                       "message": "unknown path"})
@@ -361,6 +365,31 @@ class SiddhiService:
         if worker is None:
             return 200, {"status": "OK", "enabled": False}
         return 200, {"status": "OK", "enabled": True, **worker.report()}
+
+    def fleet_stats(self, name: str) -> tuple[int, dict]:
+        """Fleet-tier guard state for one tenant app: its enrolled lanes
+        (with per-tenant ejection/circuit/shed evidence), the shape groups
+        it belongs to (guard + fair-share reports), and the engine-wide
+        solo-fallback log so quietly degraded fleets are visible."""
+        rt = self.runtimes.get(name)
+        if rt is None:
+            return 404, {"status": "ERROR",
+                         "message": f"no app '{name}' deployed"}
+        bridges = getattr(rt, "fleet_bridges", [])
+        if not bridges:
+            return 200, {"status": "OK", "enabled": False}
+        mgr = self.manager.fleet
+        stats = mgr.stats()
+        keys = {b.group.shape_key for b in bridges}
+        return 200, {
+            "status": "OK", "enabled": True,
+            "queries": [b.report() for b in bridges],
+            "groups": {k: g for k, g in stats["groups"].items()
+                       if k in keys},
+            "solo_fallbacks": stats["fallbacks"],
+            "fallback_reasons": stats["fallback_reasons"],
+            "cache": stats["cache"],
+        }
 
     def recover(self, name: str, body: str = "") -> tuple[int, dict]:
         """Restore the latest (or a named) persisted revision and replay the

@@ -404,6 +404,89 @@ def test_non_lowering_shape_negative_cached(manager):
 
 
 # ---------------------------------------------------------------------------
+# guard: eject → solo → readmit carry-over + poison staging (the full
+# containment/chaos matrix lives in tests/test_fleet_guard.py)
+# ---------------------------------------------------------------------------
+
+def test_eject_solo_readmit_cycle_preserves_window_state(manager):
+    """Snapshot/restore across an eject → solo → readmit cycle: the
+    member's window state steps solo through the shared plan, so sums keep
+    accumulating across the cycle and snapshots round-trip via
+    FleetGroup.member_state/restore_member_state whatever phase the tenant
+    is in."""
+    import time as _time
+
+    body = (lambda i: f"from S#window.length({6 + i}) select sum(v) as s "
+                      f"insert into Out;")
+    ann = "@app:fleet(batch='96', guard.cooldown.ms='5', " \
+          "guard.readmit.batches='2')\n" \
+          "@app:chaos(seed='23', fleet.fault.p='0.5')\n"
+    apps = [f"@app(name='t{i}')\n{ann if i == 0 else FLEET}{STREAM}"
+            f"{body(i)}" for i in range(3)]
+    events = gen_events(300, seed=21)
+    runtimes, got = [], []
+    for text in apps:
+        rt = manager.create_siddhi_app_runtime(text, playback=True)
+        rows = []
+        rt.add_callback("Out", StreamCallback(
+            lambda evs, rows=rows: rows.extend(list(e.data) for e in evs)))
+        rt.start()
+        runtimes.append(rt)
+        got.append(rows)
+    for s in range(0, 300, 7):
+        if (s // 7) % 2 == 0:
+            _time.sleep(0.01)      # let readmission cool-downs elapse
+        for rt in runtimes:
+            rt.input_handler("S").send_rows(
+                [list(r) for r, _ in events[s:s + 7]],
+                [t for _, t in events[s:s + 7]])
+    for rt in runtimes:
+        rt.flush_host()
+    lane = runtimes[0].fleet_bridges[0].member.lane
+    assert lane.ejections >= 1 and lane.readmissions >= 1
+    solo_mgr = SiddhiManager()
+    try:
+        _, solo = run_tenants(
+            solo_mgr, tenant_apps(body, 3, ann="", name="u"), events)
+        for i in range(3):
+            assert_rows_match(solo[i], got[i])
+    finally:
+        solo_mgr.shutdown()
+
+
+def test_mixed_poison_staging_keeps_cotenants_exact(manager):
+    """One tenant interleaves NaN and dtype-poisoned rows into its chunks;
+    only that tenant's bad rows divert (counted in its lane) and the
+    co-tenants' outputs stay complete."""
+    apps = tenant_apps(
+        lambda i: "from S[v > 5.0] select sym, v, n insert into Out;", 3)
+    runtimes, got = [], []
+    for text in apps:
+        rt = manager.create_siddhi_app_runtime(text, playback=True)
+        rows = []
+        rt.add_callback("Out", StreamCallback(
+            lambda evs, rows=rows: rows.extend(list(e.data) for e in evs)))
+        rt.start()
+        runtimes.append(rt)
+        got.append(rows)
+    events = gen_events(120, seed=33)
+    for s in range(0, 120, 6):
+        for i, rt in enumerate(runtimes):
+            chunk = [list(r) for r, _ in events[s:s + 6]]
+            if i == 2 and s % 18 == 0:
+                chunk[0] = ["sP", float("inf"), 5]
+                chunk[1] = ["sQ", None, "not-a-long"]
+            rt.input_handler("S").send_rows(
+                chunk, [t for _, t in events[s:s + 6]])
+    for rt in runtimes:
+        rt.flush_host()
+    assert runtimes[2].fleet_bridges[0].member.lane.poisoned >= 10
+    assert runtimes[0].fleet_bridges[0].member.lane.poisoned == 0
+    expected = sum(1 for r, _ in events if r[1] > 5.0)
+    assert len(got[0]) == expected and len(got[1]) == expected
+
+
+# ---------------------------------------------------------------------------
 # metrics + teardown
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,746 @@
+"""FleetGuard: per-tenant blast-radius isolation for shared-lane execution.
+
+Containment (batched bisection + sliced segment catch), ejection to the
+solo tier with state carry-over, cool-down re-admission, input hardening
+(NaN / dtype poison / dictionary growth caps), fair-share overload control,
+the 64-tenant chaos soak acceptance pin (tenant k faulting at p=0.05 →
+the other 63 tenants byte-identical to their solo oracles), host-batch
+step containment (HostStepGuard), the guard-coverage lint, the fleet
+service endpoint, and the dcn_guard fsync + chaos latency satellites.
+"""
+
+import os
+import random
+import subprocess
+import sys
+import time
+
+import pytest
+
+from util_parity import assert_rows_match
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STREAM = "define stream S (sym string, v double, n long);\n"
+FLEET = "@app:fleet(batch='96', lanes='4', guard.cooldown.ms='5', " \
+        "guard.readmit.batches='2')\n"
+
+
+@pytest.fixture()
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def gen_events(n, seed=0, syms=5, ts_step=40):
+    rng = random.Random(seed)
+    out, ts = [], 1_000_000
+    for i in range(n):
+        out.append(([f"s{rng.randrange(syms)}",
+                     round(rng.uniform(0.0, 100.0), 3),
+                     rng.randrange(1000)], ts))
+        ts += rng.randrange(1, ts_step)
+    return out
+
+
+def run_tenants(manager, apps_text, events, out_stream="Out", chunk=7,
+                pause_every=None):
+    runtimes, got = [], []
+    for text in apps_text:
+        rt = manager.create_siddhi_app_runtime(text, playback=True)
+        rows = []
+        rt.add_callback(out_stream, StreamCallback(
+            lambda evs, rows=rows: rows.extend(list(e.data) for e in evs)))
+        rt.start()
+        runtimes.append(rt)
+        got.append(rows)
+    rows_all = [row for row, _ in events]
+    tss = [ts for _, ts in events]
+    for s in range(0, len(events), chunk):
+        if pause_every and (s // chunk) % pause_every == 0:
+            time.sleep(0.01)    # let guard cool-downs elapse mid-stream
+        for rt in runtimes:
+            rt.input_handler("S").send_rows(
+                [list(r) for r in rows_all[s:s + chunk]],
+                list(tss[s:s + chunk]))
+    for rt in runtimes:
+        rt.flush_host()
+    return runtimes, got
+
+
+def tenant_apps(body_fn, k, ann_fn, name="t"):
+    return [f"@app(name='{name}{i}')\n{ann_fn(i)}{STREAM}{body_fn(i)}"
+            for i in range(k)]
+
+
+def solo_oracle(body_fn, k, events, out="Out"):
+    solo_mgr = SiddhiManager()
+    try:
+        _, rows = run_tenants(
+            solo_mgr, tenant_apps(body_fn, k, lambda i: "", name="u"),
+            events, out_stream=out)
+        return [list(r) for r in rows]
+    finally:
+        solo_mgr.shutdown()
+
+
+def lane_of(rt):
+    return rt.fleet_bridges[0].member.lane
+
+
+# ---------------------------------------------------------------------------
+# containment: ejection → solo → re-admission, oracle parity throughout
+# ---------------------------------------------------------------------------
+
+def test_batched_chaos_containment_eject_readmit_parity(manager):
+    """Stateless (batched) shapes: a chaos-faulted tenant is identified by
+    bisection, ejected, runs solo, re-admits after clean batches — and
+    EVERY tenant (culprit included: its failed batches replay through the
+    solo tier at their own slot) stays byte-identical to its solo oracle."""
+    body = (lambda i: f"from S[v > {10.0 + 7 * i}] select sym, v, n "
+                      f"insert into Out;")
+    chaos = "@app:chaos(seed='7', fleet.fault.p='0.4')\n"
+    events = gen_events(600)
+    runtimes, fleet = run_tenants(
+        manager,
+        tenant_apps(body, 4, lambda i: FLEET + (chaos if i == 2 else "")),
+        events, pause_every=3)
+    oracle = solo_oracle(body, 4, events)
+    for i in range(4):
+        assert oracle[i] == fleet[i], f"tenant {i} diverged"
+    lane = lane_of(runtimes[2])
+    assert lane.ejections >= 1
+    assert lane.readmissions >= 1
+    assert runtimes[2].resilience.chaos.counters["fleet_faults"] >= 1
+    # innocents never tripped
+    for i in (0, 1, 3):
+        assert lane_of(runtimes[i]).ejections == 0
+    group = runtimes[2].fleet_bridges[0].group
+    assert group.guard.containments >= 1
+    assert group.guard.bisect_runs >= 1
+
+
+def test_sliced_chaos_containment_parity(manager):
+    """Stateful (sliced) shapes: the faulting member segment IS the culprit
+    — no bisection — and per-tenant window state carries through the
+    eject → solo → readmit cycle (same state object steps solo)."""
+    body = (lambda i: f"from S#window.length({4 + 3 * i}) "
+                      f"select avg(v) as a, max(n) as mx insert into Out;")
+    chaos = "@app:chaos(seed='11', fleet.fault.p='0.3')\n"
+    events = gen_events(400)
+    runtimes, fleet = run_tenants(
+        manager,
+        tenant_apps(body, 3, lambda i: FLEET + (chaos if i == 1 else "")),
+        events, pause_every=3)
+    oracle = solo_oracle(body, 3, events)
+    for i in range(3):
+        assert_rows_match(oracle[i], fleet[i])
+    lane = lane_of(runtimes[1])
+    assert lane.ejections >= 1
+    assert lane.readmissions >= 1
+
+
+def test_partitioned_pattern_chaos_containment_parity(manager):
+    body = (lambda i: f"partition with (sym of S) begin "
+                      f"from every e1=S[v > {70.0 + 2 * i}] -> "
+                      f"e2=S[v > e1.v] within {2000 + 500 * i} "
+                      f"select e1.v as a, e2.v as b insert into Out; end;")
+    chaos = "@app:chaos(seed='13', fleet.fault.p='0.3')\n"
+    events = gen_events(300)
+    runtimes, fleet = run_tenants(
+        manager,
+        tenant_apps(body, 3, lambda i: FLEET + (chaos if i == 0 else "")),
+        events, pause_every=3)
+    oracle = solo_oracle(body, 3, events)
+    for i in range(3):
+        assert_rows_match(oracle[i], fleet[i])
+    assert lane_of(runtimes[0]).ejections >= 1
+
+
+def test_delivery_fault_is_not_a_tenant_fault(manager):
+    """A downstream consumer raising DURING delivery (query callback) must
+    propagate like the unguarded path — NOT be mistaken for a tenant-lane
+    fault: member state already advanced, so a containment replay would
+    double-count windows and duplicate outputs."""
+    from siddhi_tpu import QueryCallback
+
+    body = (lambda i: "@info(name='w') from S#window.length(5) "
+                      "select sum(v) as s insert into Out;")
+    runtimes, got = run_tenants(
+        manager, tenant_apps(body, 2, lambda i: FLEET, name="dl"),
+        gen_events(40, seed=51), chunk=5)
+    boom = {"armed": True}
+
+    class _CB(QueryCallback):
+        def receive(self, ts, events, removed):
+            if boom["armed"]:
+                boom["armed"] = False
+                raise RuntimeError("downstream consumer crashed")
+
+    runtimes[0].add_query_callback("w", _CB())
+    more = gen_events(60, seed=52)
+    for s in range(0, 60, 5):
+        for rt in runtimes:
+            try:
+                rt.input_handler("S").send_rows(
+                    [list(r) for r, _ in more[s:s + 5]],
+                    [t for _, t in more[s:s + 5]])
+            except RuntimeError:
+                pass    # unguarded propagation to the producer is fine too
+    for rt in runtimes:
+        try:
+            rt.flush_host()
+        except RuntimeError:
+            pass
+    # the crash fired (handled by the producer or by the junction's
+    # per-receiver isolation — either way NOT by the FleetGuard)
+    assert not boom["armed"]
+    assert lane_of(runtimes[0]).ejections == 0      # NOT a tenant fault
+    assert lane_of(runtimes[1]).ejections == 0
+    # state advanced exactly once through the crash: the raising step's
+    # OUTPUTS are lost (baseline semantics — delivery aborted downstream)
+    # but the window state is single-counted, so the final outputs match
+    # the solo oracle's exactly
+    oracle = solo_oracle(body, 2, gen_events(40, seed=51) + more)
+    for i in range(2):
+        tail = got[i][-5:]
+        assert_rows_match(oracle[i][-len(tail):], tail)
+
+
+def test_guard_disabled_keeps_legacy_blast_radius(manager):
+    rt = manager.create_siddhi_app_runtime(
+        "@app(name='g0')\n@app:fleet(guard='false')\n" + STREAM +
+        "from S[v > 1.0] select v insert into Out;", playback=True)
+    rt.start()
+    assert rt.fleet_bridges[0].group.guard is None
+
+
+# ---------------------------------------------------------------------------
+# input hardening
+# ---------------------------------------------------------------------------
+
+def test_poison_rows_divert_only_offending_tenant(manager):
+    """NaN params and dtype-mismatched rows divert at the guard before the
+    shared program runs; co-tenants' outputs are complete and exact."""
+    body = (lambda i: "from S[v > 5.0] select sym, v, n insert into Out;")
+    runtimes, got = [], []
+    for text in tenant_apps(body, 3, lambda i: FLEET, name="p"):
+        rt = manager.create_siddhi_app_runtime(text, playback=True)
+        rows = []
+        rt.add_callback("Out", StreamCallback(
+            lambda evs, rows=rows: rows.extend(list(e.data) for e in evs)))
+        rt.start()
+        runtimes.append(rt)
+        got.append(rows)
+    events = gen_events(100, seed=3)
+    for s in range(0, 100, 5):
+        for i, rt in enumerate(runtimes):
+            chunk = [list(r) for r, _ in events[s:s + 5]]
+            if i == 1 and s % 20 == 0:
+                chunk[0] = ["sX", float("nan"), 1]       # non-finite param
+                chunk[1] = ["sY", "not-a-number", 2]     # dtype mismatch
+            rt.input_handler("S").send_rows(
+                chunk, [t for _, t in events[s:s + 5]])
+    for rt in runtimes:
+        rt.flush_host()
+    assert lane_of(runtimes[1]).poisoned >= 10
+    assert lane_of(runtimes[0]).poisoned == 0
+    expected = sum(1 for r, _ in events if r[1] > 5.0)
+    assert len(got[0]) == expected
+    assert len(got[2]) == expected
+
+
+def test_unencodable_value_cannot_wedge_the_group(manager):
+    """A value that passes the dtype checks but fails the encode (an
+    out-of-int64-range int) used to raise out of the retry emit and leave
+    the poison staged — wedging the whole group forever. The salvage pass
+    must divert only the offending tenant's rows and keep the stager
+    drainable."""
+    body = (lambda i: "from S[v > 5.0] select sym, v, n insert into Out;")
+    runtimes, got = [], []
+    for text in tenant_apps(body, 2, lambda i: FLEET, name="ov"):
+        rt = manager.create_siddhi_app_runtime(text, playback=True)
+        rows = []
+        rt.add_callback("Out", StreamCallback(
+            lambda evs, rows=rows: rows.extend(list(e.data) for e in evs)))
+        rt.start()
+        runtimes.append(rt)
+        got.append(rows)
+    events = gen_events(60, seed=61)
+    for s in range(0, 60, 6):
+        for i, rt in enumerate(runtimes):
+            chunk = [list(r) for r, _ in events[s:s + 6]]
+            if i == 1 and s == 24:
+                chunk[2] = ["a", 2.0, 2 ** 70]      # passes isinstance, not int64
+            rt.input_handler("S").send_rows(
+                chunk, [t for _, t in events[s:s + 6]])
+    for rt in runtimes:
+        rt.flush_host()
+    expected = sum(1 for r, _ in events if r[1] > 5.0)
+    assert len(got[0]) == expected          # innocent tenant: complete
+    assert runtimes[1].fleet_bridges[0].member.lane.poisoned >= 1
+    # the group keeps flowing after the poison batch
+    runtimes[0].input_handler("S").send_rows([["z", 50.0, 1]], [9_999_999])
+    runtimes[0].flush_host()
+    assert len(got[0]) == expected + 1
+
+
+def test_host_guard_emit_failure_does_not_duplicate(manager):
+    """An encode-time failure leaves rows staged in the builder; the guard
+    must clear them after capturing the shadow, or every later flush
+    re-replays the same rows (duplicates). The scalar replay must also
+    contain per-row poison: later rows in the shadow still deliver."""
+    rt = manager.create_siddhi_app_runtime(
+        "@app(name='he0')\n@app:host_batch(batch='64')\n" + STREAM +
+        "@info(name='q') from S[v > 1.0] select sym, v insert into Out;",
+        playback=True)
+    rows = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: rows.extend(list(e.data) for e in evs)))
+    rt.start()
+    guard = rt.resilience.host_guards[0]
+    ih = rt.input_handler("S")
+    # one micro-batch: clean row, dtype-poison row, two clean rows after
+    ih.send_rows([["a", 2.0, 1], ["b", "oops", 2], ["g0", 3.0, 3],
+                  ["g1", 3.0, 4]], [1000, 1001, 1002, 1003])
+    rt.flush_host()
+    ih.send_rows([["c", 4.0, 5]], [1004])
+    rt.flush_host()
+    assert guard.failures >= 1
+    # the clean rows delivered exactly ONCE via scalar replay, the poison
+    # row is counted lost, and the healed path resumes
+    assert rows.count(["a", 2.0]) == 1
+    assert rows.count(["g0", 3.0]) == 1 and rows.count(["g1", 3.0]) == 1
+    assert rows.count(["c", 4.0]) == 1
+    assert guard.lost_events == 1
+
+
+def test_dictionary_growth_cap_diverts_blowup_tenant(manager):
+    apps = tenant_apps(
+        lambda i: "from S[v > 5.0] select sym, v, n insert into Out;",
+        2, lambda i: "@app:fleet(batch='64', dict.cap='10')\n", name="d")
+    runtimes, _ = run_tenants(manager, apps, gen_events(20, seed=5),
+                              chunk=5)
+    blow = [[f"unique-{j}", 50.0, j] for j in range(40)]
+    runtimes[1].input_handler("S").send_rows(
+        [list(r) for r in blow], list(range(1_000_000, 1_000_040)))
+    lane = lane_of(runtimes[1])
+    assert lane.dict_capped
+    assert lane.poisoned >= 40
+    assert not lane_of(runtimes[0]).dict_capped
+    # the shared dictionary did NOT absorb the blow-up tenant's strings
+    group = runtimes[0].fleet_bridges[0].group
+    for dic in group.dictionaries.values():
+        assert all(not (v or "").startswith("unique-")
+                   for v in dic.snapshot())
+
+
+# ---------------------------------------------------------------------------
+# fair-share overload control
+# ---------------------------------------------------------------------------
+
+def test_max_lag_quota_sheds_only_the_hot_tenants_tail(manager):
+    apps = [
+        f"@app(name='f0')\n@app:fleet(batch='64', max_lag_events='8')\n"
+        f"{STREAM}from S[v > 5.0] select sym, v, n insert into Out;",
+        f"@app(name='f1')\n@app:fleet(batch='64')\n"
+        f"{STREAM}from S[v > 5.0] select sym, v, n insert into Out;",
+    ]
+    runtimes = []
+    for text in apps:
+        rt = manager.create_siddhi_app_runtime(text, playback=True)
+        rt.start()
+        runtimes.append(rt)
+    rows = [[f"q{j % 3}", 50.0, j] for j in range(40)]
+    runtimes[0].input_handler("S").send_rows(
+        [list(r) for r in rows], list(range(1_000_000, 1_000_040)))
+    lane = lane_of(runtimes[0])
+    assert lane.shed == 32          # quota of 8 admitted, tail shed
+    assert lane.staged_window == 8
+    assert lane_of(runtimes[1]).shed == 0
+    # a FOLLOW-UP chunk within quota must not shed: quota exhaustion steps
+    # the group (a new window opens) instead of dropping traffic the
+    # engine has idle capacity for
+    group = runtimes[0].fleet_bridges[0].group
+    runtimes[0].input_handler("S").send_rows(
+        [["q0", 50.0, 99]] * 6, list(range(1_000_100, 1_000_106)))
+    assert lane.shed == 32          # unchanged — no new shedding
+    assert group.flush_causes.get("quota", 0) >= 1
+
+
+def test_lone_tenant_under_quota_loses_nothing(manager):
+    """Reproduces the review finding: a lone tenant with max_lag_events
+    far below its feed volume must NOT have its stream silently shed on an
+    idle engine — the quota bounds staging lag per window, with a step
+    opening each next window."""
+    rt = manager.create_siddhi_app_runtime(
+        "@app(name='lq0')\n@app:fleet(batch='8192', max_lag_events='500')\n"
+        + STREAM + "from S[v > 0.0] select sym, v, n insert into Out;",
+        playback=True)
+    rows = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: rows.extend(list(e.data) for e in evs)))
+    rt.start()
+    ih = rt.input_handler("S")
+    for s in range(0, 5000, 100):
+        ih.send_rows([[f"d{j % 7}", 1.0 + j, j] for j in range(100)],
+                     list(range(1_000_000 + s, 1_000_100 + s)))
+    rt.flush_host()
+    lane = lane_of(rt)
+    assert lane.shed == 0
+    assert len(rows) == 5000
+
+
+def test_fair_share_flush_frees_waiting_cotenants(manager):
+    """A firehose that fills its weighted share of the window while a
+    co-tenant's rows wait triggers an early fair_share flush — the idle
+    tenant's latency is bounded by its neighbor's quota, not the whole
+    window."""
+    apps = [
+        f"@app(name='w{i}')\n@app:fleet(batch='1000', weight='1')\n"
+        f"{STREAM}from S[v > 5.0] select sym, v, n insert into Out;"
+        for i in range(2)
+    ]
+    runtimes = []
+    for text in apps:
+        rt = manager.create_siddhi_app_runtime(text, playback=True)
+        rt.start()
+        runtimes.append(rt)
+    group = runtimes[0].fleet_bridges[0].group
+    # idle tenant stages a single row; the firehose then pours: the group
+    # must flush at the firehose's fair share (~500), not at 1000
+    runtimes[1].input_handler("S").send_rows([["a", 50.0, 1]], [1_000_000])
+    fire = [[f"q{j % 3}", 50.0, j] for j in range(600)]
+    runtimes[0].input_handler("S").send_rows(
+        [list(r) for r in fire], list(range(1_000_100, 1_000_700)))
+    assert group.flush_causes.get("fair_share", 0) >= 1
+    assert group.steps >= 1
+
+
+def test_adaptive_controller_sizes_group_window(manager):
+    """@app:adaptive on the first enrolling tenant attaches an AIMD
+    controller to the shape group: the flush window (and so the fair-share
+    quotas) follows controller.current instead of the static batch."""
+    rt = manager.create_siddhi_app_runtime(
+        "@app(name='ad0')\n@app:fleet(batch='4096')\n"
+        "@app:adaptive(target.ms='25', min='64', initial='128')\n"
+        + STREAM + "from S[v > 1.0] select v insert into Out;",
+        playback=True)
+    rt.start()
+    group = rt.fleet_bridges[0].group
+    assert group.batch_controller is not None
+    assert group.effective_window() == 128      # controller, not capacity
+    events = gen_events(300, seed=15)
+    rt.input_handler("S").send_rows(
+        [list(r) for r, _ in events], [t for _, t in events])
+    assert group.flush_causes.get("adaptive", 0) >= 1
+    assert group.report()["adaptive"]["batch_size"] >= 64
+
+
+def test_arrival_ema_tracked_per_tenant(manager):
+    apps = tenant_apps(
+        lambda i: "from S[v > 5.0] select sym, v insert into Out;",
+        2, lambda i: FLEET, name="e")
+    runtimes, _ = run_tenants(manager, apps, gen_events(200, seed=9),
+                              chunk=10)
+    assert lane_of(runtimes[0]).arrival_evps > 0
+
+
+# ---------------------------------------------------------------------------
+# state carry-over across eject → solo → readmit (snapshot surface)
+# ---------------------------------------------------------------------------
+
+def test_eject_readmit_state_carry_over_parity(manager):
+    """Windowed state built BEFORE an ejection must keep aggregating
+    through the solo phase and after re-admission — pinned against a solo
+    oracle fed the identical stream, plus snapshot/restore round-trips
+    through FleetGroup.snapshot_state/restore_member_state mid-cycle."""
+    body = (lambda i: f"from S#window.length({6 + i}) select sum(v) as s "
+                      f"insert into Out;")
+    chaos = "@app:chaos(seed='23', fleet.fault.p='0.5')\n"
+    events = gen_events(300, seed=21)
+    runtimes, fleet = run_tenants(
+        manager,
+        tenant_apps(body, 3, lambda i: FLEET + (chaos if i == 0 else "")),
+        events, pause_every=2)
+    lane = lane_of(runtimes[0])
+    assert lane.ejections >= 1 and lane.readmissions >= 1
+    oracle = solo_oracle(body, 3, events)
+    for i in range(3):
+        assert_rows_match(oracle[i], fleet[i])
+    # snapshot while healthy, stream more, restore, replay → identical
+    snap = runtimes[0].snapshot()
+    more = gen_events(80, seed=22)
+    fleet[0].clear()
+    for row, ts in more:
+        runtimes[0].input_handler("S").send(list(row), timestamp=ts)
+    runtimes[0].flush_host()
+    first = [list(r) for r in fleet[0]]
+    runtimes[0].restore(snap)
+    fleet[0].clear()
+    for row, ts in more:
+        runtimes[0].input_handler("S").send(list(row), timestamp=ts)
+    runtimes[0].flush_host()
+    assert_rows_match(first, fleet[0])
+
+
+# ---------------------------------------------------------------------------
+# the 64-tenant chaos soak (acceptance pin)
+# ---------------------------------------------------------------------------
+
+def test_64_tenant_chaos_soak_innocents_byte_identical(manager):
+    """Tenant k faults at fleet.fault.p=0.05 over a 64-tenant group: the
+    culprit ejects to solo and later re-admits, the other 63 tenants'
+    outputs are BYTE-IDENTICAL to their solo oracle runs, and the
+    fleet.tenant.* metrics + service endpoint report the ejection."""
+    k = 64
+    culprit = 17
+    body = (lambda i: f"@info(name='rule') from S[v > {20.0 + i * 0.5}] "
+                      f"select sym, v, n insert into Out;")
+    chaos = "@app:chaos(seed='29', fleet.fault.p='0.05')\n"
+    ann = "@app:fleet(batch='256', guard.cooldown.ms='5', " \
+          "guard.readmit.batches='2')\n"
+    events = gen_events(400, seed=31)
+    runtimes, fleet = run_tenants(
+        manager,
+        tenant_apps(body, k,
+                    lambda i: ann + (chaos if i == culprit else "")),
+        events, chunk=8, pause_every=8)
+    lane = lane_of(runtimes[culprit])
+    assert lane.ejections >= 1, "culprit never ejected"
+    assert lane.readmissions >= 1, "culprit never re-admitted"
+    # stateless rule + exactly-once containment → strict equality holds
+    # for the culprit too; the acceptance bar is the 63 innocents
+    oracle = solo_oracle(body, k, events)
+    for i in range(k):
+        assert oracle[i] == fleet[i], f"tenant {i} diverged"
+    for i in range(k):
+        if i != culprit:
+            assert lane_of(runtimes[i]).ejections == 0
+    # metrics evidence on the culprit app
+    sm = runtimes[culprit].ctx.statistics_manager
+    gauges = sm.snapshot_trackers()["gauges"]
+    assert gauges["fleet.tenant.rule.ejections"].value >= 1
+    assert gauges["fleet.tenant.rule.readmissions"].value >= 1
+    assert gauges["fleet.solo_fallbacks"].value == 0
+    # service endpoint evidence
+    from siddhi_tpu.service import SiddhiService
+    svc = SiddhiService(manager, port=0)
+    svc.runtimes = {rt.name: rt for rt in runtimes}
+    try:
+        code, payload = svc.fleet_stats(runtimes[culprit].name)
+        assert code == 200 and payload["enabled"]
+        guard = payload["queries"][0]["guard"]
+        assert guard["ejections"] >= 1 and guard["readmissions"] >= 1
+        gk = runtimes[culprit].fleet_bridges[0].group.shape_key
+        assert payload["groups"][gk]["guard"]["containments"] >= 1
+    finally:
+        svc._server.server_close()      # never started; just free the port
+
+
+# ---------------------------------------------------------------------------
+# solo-fallback evidence (manager satellite)
+# ---------------------------------------------------------------------------
+
+def test_solo_fallback_counter_and_reasons_surface(manager):
+    rt = manager.create_siddhi_app_runtime(
+        "@app(name='sf0')\n@app:fleet(batch='64')\n" + STREAM +
+        "@info(name='odd') from S select stdDev(v) as sd insert into Out;",
+        playback=True)
+    rt.start()
+    assert not rt.fleet_bridges
+    stats = manager.fleet.stats()
+    assert stats["fallbacks"] >= 1
+    reasons = stats["fallback_reasons"]
+    assert any(r["app"] == "sf0" and r["query"] == "odd"
+               for r in reasons)
+    from siddhi_tpu.service import SiddhiService
+    svc = SiddhiService(manager, port=0)
+    svc.runtimes = {"sf0": rt}
+    try:
+        code, payload = svc.fleet_stats("sf0")
+        assert code == 200
+        assert payload == {"status": "OK", "enabled": False}
+    finally:
+        svc._server.server_close()      # never started; just free the port
+
+
+# ---------------------------------------------------------------------------
+# host-batch step containment (HostStepGuard)
+# ---------------------------------------------------------------------------
+
+def test_host_step_guard_replays_failed_batch_through_scalar(manager):
+    rt = manager.create_siddhi_app_runtime(
+        "@app(name='h0')\n@app:host_batch(batch='64')\n" + STREAM +
+        "@info(name='q') from S[v > 10.0] select sym, v insert into Out;",
+        playback=True)
+    rows = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: rows.extend(list(e.data) for e in evs)))
+    rt.start()
+    assert len(rt.resilience.host_guards) == 1
+    guard = rt.resilience.host_guards[0]
+    events = gen_events(100, seed=41)
+    for row, ts in events[:50]:
+        rt.input_handler("S").send(list(row), timestamp=ts)
+    rt.flush_host()
+    # sabotage the columnar step: the guard must replay through the
+    # scalar interpreter with zero loss, then the healed path resumes
+    hq = rt.host_bridges[0].runtime.hq
+    inner_step = hq.step
+
+    def broken(*a, **kw):
+        raise RuntimeError("sabotaged columnar step")
+
+    hq.step = broken
+    for row, ts in events[50:80]:
+        rt.input_handler("S").send(list(row), timestamp=ts)
+    rt.flush_host()
+    hq.step = inner_step
+    for row, ts in events[80:]:
+        rt.input_handler("S").send(list(row), timestamp=ts)
+    rt.flush_host()
+    assert guard.failures >= 1
+    assert guard.fallback_events >= 1
+    assert guard.lost_events == 0
+    expected = [[r[0], r[1]] for r, _ in events if r[1] > 10.0]
+    assert_rows_match(expected, rows)
+    # metrics surface + teardown
+    sm = rt.ctx.statistics_manager
+    gauges = sm.snapshot_trackers()["gauges"]
+    assert gauges["host_batch.q.circuit_state"].value is not None
+    assert gauges["host_batch.q.fallback_events"].value >= 1
+    rt.shutdown()
+    assert not any(kk.startswith("host_batch.q")
+                   for d in sm.snapshot_trackers().values() for kk in d)
+
+
+def test_host_step_guard_quarantines_after_threshold(manager):
+    rt = manager.create_siddhi_app_runtime(
+        "@app(name='h1')\n@app:host_batch(batch='16')\n"
+        "@app:resilience(host.circuit.threshold='2', "
+        "host.circuit.cooldown.ms='60000')\n" + STREAM +
+        "from S[v > 10.0] select sym, v insert into Out;", playback=True)
+    rows = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: rows.extend(list(e.data) for e in evs)))
+    rt.start()
+    guard = rt.resilience.host_guards[0]
+    hq = rt.host_bridges[0].runtime.hq
+
+    def broken(*a, **kw):
+        raise RuntimeError("persistently broken")
+
+    hq.step = broken
+    events = gen_events(90, seed=43)
+    for s in range(0, 90, 10):
+        rt.input_handler("S").send_rows(
+            [list(r) for r, _ in events[s:s + 10]],
+            [t for _, t in events[s:s + 10]])
+    rt.flush_host()
+    from siddhi_tpu.resilience import CircuitState
+    assert guard.breaker.state == CircuitState.OPEN
+    assert guard.failures == 2          # quarantined after the threshold
+    assert guard.lost_events == 0
+    expected = [[r[0], r[1]] for r, _ in events if r[1] > 10.0]
+    assert_rows_match(expected, rows)
+
+
+# ---------------------------------------------------------------------------
+# chaos latency satellite: device + fleet sites, seeded determinism
+# ---------------------------------------------------------------------------
+
+def test_chaos_latency_covers_device_and_fleet_sites(monkeypatch):
+    from siddhi_tpu.resilience.chaos import ChaosInjector
+
+    def record_run(seed):
+        sleeps = []
+        monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+        inj = ChaosInjector(seed=seed, latency_ms=5.0)
+        for _ in range(10):
+            inj.on_device("device:app/q")
+        inj2 = ChaosInjector(seed=seed, latency_ms=5.0, fleet_fault_p=0.0)
+        for _ in range(10):
+            inj2._latency("fleet:app/q")
+        return sleeps
+
+    a = record_run(7)
+    b = record_run(7)
+    c = record_run(8)
+    assert len(a) == 20 and a == b          # seeded-deterministic
+    assert a != c                           # seed actually matters
+    assert all(0.0 <= s <= 0.005 for s in a)
+
+
+def test_roll_fleet_deterministic_per_site():
+    from siddhi_tpu.resilience.chaos import ChaosInjector
+    a = ChaosInjector(seed=3, fleet_fault_p=0.3)
+    b = ChaosInjector(seed=3, fleet_fault_p=0.3)
+    seq_a = [a.roll_fleet("fleet:t/q") for _ in range(50)]
+    seq_b = [b.roll_fleet("fleet:t/q") for _ in range(50)]
+    assert seq_a == seq_b
+    assert any(seq_a) and not all(seq_a)
+    assert a.counters["fleet_faults"] == sum(seq_a)
+
+
+# ---------------------------------------------------------------------------
+# dcn_guard fsync satellite: crash durability of the snapshot store
+# ---------------------------------------------------------------------------
+
+def test_snapshot_store_fsyncs_file_and_dir_before_rename(tmp_path,
+                                                          monkeypatch):
+    import numpy as np
+
+    from siddhi_tpu.resilience.dcn_guard import LaneGroupSnapshotStore
+
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync",
+                        lambda fd: (synced.append(fd), real_fsync(fd)))
+    store = LaneGroupSnapshotStore(str(tmp_path))
+    rev = store.save(0, [1, 2], [np.arange(4)], {"0": (0, 1)})
+    # data fsync BEFORE the rename + the parent-dir fsync after: an
+    # interrupted save leaves either the previous revision or the new one,
+    # never an empty/absent file
+    assert len(synced) >= 2
+    got = store.latest(0)
+    assert got["revision"] == rev
+    assert [int(x) for x in got["global_lanes"]] == [1, 2]
+    synced.clear()
+    epoch0 = store.next_epoch(0)
+    assert store.next_epoch(0) == epoch0 + 1
+    assert len(synced) >= 2             # epoch writer fsyncs too
+    # no stray tmp files survive a clean save
+    leftovers = [p for p in tmp_path.rglob("*.tmp")]
+    assert not leftovers
+
+
+def test_snapshot_store_survives_torn_tmp(tmp_path):
+    """A tmp file left by a crash mid-write must not shadow or corrupt the
+    committed revision."""
+    import numpy as np
+
+    from siddhi_tpu.resilience.dcn_guard import LaneGroupSnapshotStore
+
+    store = LaneGroupSnapshotStore(str(tmp_path))
+    store.save(1, [7], [np.arange(3)], {})
+    d = tmp_path / "group_1"
+    (d / "rev_00000001.npz.tmp").write_bytes(b"torn")
+    got = store.latest(1)
+    assert got is not None and got["revision"] == 0
+
+
+# ---------------------------------------------------------------------------
+# guard-coverage lint (CI satellite)
+# ---------------------------------------------------------------------------
+
+def test_guard_coverage_lint_passes():
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_guard_coverage.py")],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert p.returncode == 0, p.stderr + p.stdout

@@ -104,3 +104,45 @@ def test_custom_reporter_receives_reports():
     finally:
         del REPORTERS["capture"]
     assert calls and calls[0]["app"] == "x"
+
+
+def test_guard_metric_families_unregister_on_shutdown():
+    """PR 6 pinned the fleet.* / host_batch.* teardown contract; the guard
+    families ride the same prefixes: fleet.tenant.* (ejections/readmit/
+    shed/circuit) and the host_batch.{q}.circuit_state /fallback_events
+    gauges must disappear with their app — a stopped tenant must not leak
+    dead gauges into the engine-wide exposition."""
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            "@app(name='gm0')\n@app:fleet(batch='64')\n"
+            "define stream S (sym string, v double);\n"
+            "@info(name='fq') from S[v > 1.0] select v insert into Out;",
+            playback=True)
+        rt.start()
+        sm = rt.ctx.statistics_manager
+        gauges = sm.snapshot_trackers()["gauges"]
+        assert gauges["fleet.tenant.fq.ejections"].value == 0
+        assert gauges["fleet.tenant.fq.circuit_state"].value == 0
+        assert gauges["fleet.solo_fallbacks"].value == 0
+        rt.shutdown()
+        snap = sm.snapshot_trackers()
+        assert not any(k.startswith("fleet.")
+                       for d in snap.values() for k in d)
+
+        hrt = m.create_siddhi_app_runtime(
+            "@app(name='gm1')\n@app:host_batch(batch='64')\n"
+            "define stream S (sym string, v double);\n"
+            "@info(name='hq') from S[v > 1.0] select v insert into Out;",
+            playback=True)
+        hrt.start()
+        hsm = hrt.ctx.statistics_manager
+        gauges = hsm.snapshot_trackers()["gauges"]
+        assert gauges["host_batch.hq.circuit_state"].value == 0
+        assert gauges["host_batch.hq.fallback_events"].value == 0
+        hrt.shutdown()
+        snap = hsm.snapshot_trackers()
+        assert not any(k.startswith("host_batch.")
+                       for d in snap.values() for k in d)
+    finally:
+        m.shutdown()
