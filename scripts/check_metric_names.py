@@ -10,7 +10,16 @@ histograms), renders the exposition, and enforces:
 - each (metric, labels) sample appears exactly once per app — a tracker
   registered twice per app would double-expose here;
 - ``# TYPE`` is declared exactly once per family, before its samples;
-- histogram bucket counts are cumulative (monotone, ``+Inf`` == count).
+- histogram bucket counts are cumulative (monotone, ``+Inf`` == count);
+- OpenMetrics exemplars (`` # {trace_id="..."} value ts``) appear ONLY on
+  histogram ``_bucket`` samples, parse, carry a bounded label set
+  (``trace_id`` only, ≤ 128 runes total per the OpenMetrics spec), and
+  their value lies within the bucket's ``le`` bound;
+- label cardinality stays bounded: per family no label fans out past
+  ``MAX_LABEL_VALUES`` distinct values, and unbounded-identity label
+  names (``tenant``/``user``/``trace_id``/...) never appear as labels —
+  per-tenant families must aggregate or exemplar-link, not explode the
+  time-series space.
 
 Usage: ``python scripts/check_metric_names.py``. Exit code 1 on findings.
 Run by ``tests/test_observability.py`` so it gates CI (the
@@ -31,8 +40,21 @@ METRIC_RE = re.compile(r"^siddhi_tpu_[a-z][a-z0-9_]*$")
 LABEL_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
 SAMPLE_RE = re.compile(
     r"^(?P<name>[A-Za-z_:][A-Za-z0-9_:]*)"
-    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$")
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)"
+    r"(?P<exemplar> # \{[^}]*\} \S+(?: \S+)?)?$")
+EXEMPLAR_RE = re.compile(
+    r"^ # \{(?P<labels>[^}]*)\} (?P<value>\S+)(?: (?P<ts>\S+))?$")
 LABEL_PAIR_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+# identity-shaped label names that would make a family's cardinality grow
+# with the user population — these belong in exemplars or report payloads
+FORBIDDEN_LABELS = {"tenant", "tenant_id", "user", "user_id", "trace_id",
+                    "session", "session_id", "event_id"}
+# per-family distinct-value bound per label within one exposition
+MAX_LABEL_VALUES = 64
+# OpenMetrics: exemplar label set must stay under 128 runes
+MAX_EXEMPLAR_RUNES = 128
+EXEMPLAR_LABELS = {"trace_id"}
 
 APP = """
 @app(name='LintApp', statistics='detail')
@@ -59,9 +81,62 @@ def build_exposition() -> str:
         ih.send([float(i)], timestamp=1000 + i)
     rt.drain_async()
     rt.flush_device()
-    text = render([rt.ctx.statistics_manager])
+    # the OpenMetrics-flavored exposition: exemplars present, so their
+    # syntax/placement/bounds are exercised by every lint run
+    text = render([rt.ctx.statistics_manager], with_exemplars=True)
     m.shutdown()
     return text
+
+
+def _check_exemplar(lineno: int, name: str, family: str, typed: dict,
+                    labels: dict, raw_ex: str, problems: list) -> None:
+    """Exemplar syntax + placement + bound lint for one sample line."""
+    if typed.get(family) != "histogram" or not name.endswith("_bucket"):
+        problems.append(
+            f"line {lineno}: exemplar on non-bucket sample '{name}' — "
+            f"exemplars attach to histogram le buckets only")
+        return
+    m = EXEMPLAR_RE.match(raw_ex)
+    if m is None:
+        problems.append(f"line {lineno}: malformed exemplar: {raw_ex!r}")
+        return
+    ex_labels = {}
+    raw = m.group("labels")
+    consumed = sum(len(p.group(0)) for p in LABEL_PAIR_RE.finditer(raw))
+    if len(raw.replace(",", "")) != consumed:
+        problems.append(
+            f"line {lineno}: malformed exemplar labels: {{{raw}}}")
+    for p in LABEL_PAIR_RE.finditer(raw):
+        ex_labels[p.group(1)] = p.group(2)
+    extra = set(ex_labels) - EXEMPLAR_LABELS
+    if extra:
+        problems.append(
+            f"line {lineno}: exemplar labels {sorted(extra)} — only "
+            f"{sorted(EXEMPLAR_LABELS)} may ride an exemplar")
+    runes = sum(len(k) + len(v) for k, v in ex_labels.items())
+    if runes > MAX_EXEMPLAR_RUNES:
+        problems.append(
+            f"line {lineno}: exemplar label set is {runes} runes "
+            f"(OpenMetrics bound: {MAX_EXEMPLAR_RUNES})")
+    try:
+        ex_value = float(m.group("value"))
+    except ValueError:
+        problems.append(
+            f"line {lineno}: non-numeric exemplar value "
+            f"{m.group('value')!r}")
+        return
+    if m.group("ts") is not None:
+        try:
+            float(m.group("ts"))
+        except ValueError:
+            problems.append(
+                f"line {lineno}: non-numeric exemplar timestamp "
+                f"{m.group('ts')!r}")
+    le = labels.get("le")
+    if le is not None and le != "+Inf" and ex_value > float(le) * 1.0001:
+        problems.append(
+            f"line {lineno}: exemplar value {ex_value} exceeds its "
+            f"bucket's le={le}")
 
 
 def check(text: str) -> list[str]:
@@ -70,6 +145,7 @@ def check(text: str) -> list[str]:
     seen_samples: set[tuple] = set()
     histograms: dict[tuple, list[tuple[float, float]]] = {}
     hist_counts: dict[tuple, float] = {}
+    label_values: dict[tuple, set] = {}   # (family, label) -> value set
 
     for lineno, line in enumerate(text.splitlines(), 1):
         if not line.strip():
@@ -113,7 +189,16 @@ def check(text: str) -> list[str]:
             if not LABEL_RE.match(k):
                 problems.append(
                     f"line {lineno}: label '{k}' is not snake_case")
+            if k in FORBIDDEN_LABELS:
+                problems.append(
+                    f"line {lineno}: label '{k}' is an unbounded identity "
+                    f"— per-tenant families must carry bounded label sets")
             labels[k] = v
+            if k != "le":
+                label_values.setdefault((family, k), set()).add(v)
+        if m.group("exemplar"):
+            _check_exemplar(lineno, name, family, typed, labels,
+                            m.group("exemplar"), problems)
         try:
             value = float(m.group("value"))
         except ValueError:
@@ -153,6 +238,12 @@ def check(text: str) -> list[str]:
             problems.append(
                 f"{family}{dict(series)}: +Inf bucket {buckets[-1][1]} "
                 f"!= _count {total}")
+    for (family, label), values in label_values.items():
+        if len(values) > MAX_LABEL_VALUES:
+            problems.append(
+                f"{family}: label '{label}' has {len(values)} distinct "
+                f"values (bound {MAX_LABEL_VALUES}) — cardinality must not "
+                f"scale with population")
     return problems
 
 

@@ -69,6 +69,8 @@ class SiddhiAppContext:
         self.statistics_manager = None
         self.tracer = None          # PipelineTracer when @app:trace (hot
         # paths gate on one attribute, like flow/debugger)
+        self.flight = None          # FlightRecorder (always set for built
+        # apps; None only on bare contexts) — control-plane transition ring
 
     # -- ids -----------------------------------------------------------------
     def element_id(self, prefix: str) -> str:

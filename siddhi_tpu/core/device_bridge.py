@@ -260,7 +260,7 @@ class AsyncDeviceDriver:
 
     def _dispatch(self, batch) -> None:
         import time
-        self.pack_seconds += float(batch.pop("pack_s", 0.0) or 0.0)
+        self.pack_seconds += float(batch.get("pack_s", 0.0) or 0.0)
         t0 = time.perf_counter()
         err = None
         token = None
@@ -273,13 +273,13 @@ class AsyncDeviceDriver:
             err = e
         disp_s = time.perf_counter() - t0
         with self._cv:
-            self._inflight.append((batch, token, disp_s, err))
+            self._inflight.append((batch, token, t0, disp_s, err))
             self._cv.notify_all()
 
     def _collect_oldest(self) -> None:
         import time
         with self._cv:
-            batch, token, disp_s, err = self._inflight.popleft()
+            batch, token, t_disp0, disp_s, err = self._inflight.popleft()
         t0 = time.perf_counter()
         rows = []
         ok = False
@@ -293,24 +293,47 @@ class AsyncDeviceDriver:
             # host path before this can trigger
             log.exception("device step failed")
             rows = []
-        dt = disp_s + (time.perf_counter() - t0)
+        fence_s = time.perf_counter() - t0
+        dt = disp_s + fence_s
         self.step_seconds += dt
         self.batches_stepped += 1
+        publish_s = 0.0
+        if rows:
+            tp0 = time.perf_counter()
+            try:
+                with self.app_context.root_lock:
+                    # stamp outputs with the batch's own last event time —
+                    # the producer-side _out_ts has already advanced to
+                    # newer events by delivery time
+                    self.rt.deliver(rows, batch.get("last_ts"))
+            except Exception:   # noqa: BLE001 — a raising downstream
+                # receiver must not kill the sole device worker, and the
+                # probe below must still see this batch (FIFO trace groups)
+                log.exception("device delivery failed")
+            publish_s = time.perf_counter() - tp0
         try:
             # the probe must see EVERY consumed batch (success or not) or
-            # its FIFO trace groups desynchronize
+            # its FIFO trace groups desynchronize; observed AFTER delivery
+            # so the phase attribution covers the whole serial waterfall
+            # (fill → pack → ring wait → dispatch → fence → publish)
             observe = getattr(self.rt, "observe_step", None)
             if observe is not None:
-                observe(batch.get("count", 0), dt, device_path=ok)
+                t_emit = batch.get("_t_emit")
+                queue_s = max(0.0, t_disp0 - t_emit) \
+                    if t_emit is not None else 0.0
+                queue_s += max(0.0, t0 - (t_disp0 + disp_s))
+                observe(batch.get("count", 0), dt, device_path=ok, phases={
+                    "fill_span_s": batch.get("pack_s", 0.0),
+                    "pack_s": batch.get("pack_exec_s", 0.0),
+                    "queue_s": queue_s,
+                    "step_s": disp_s,
+                    "fence_s": fence_s,
+                    "publish_s": publish_s,
+                    "cause": batch.get("_cause"),
+                })
         except Exception:   # noqa: BLE001 — a raising observer must not
             # kill the sole device worker
             log.exception("step observer failed")
-        if rows:
-            with self.app_context.root_lock:
-                # stamp outputs with the batch's own last event time — the
-                # producer-side _out_ts has already advanced to newer events
-                # by delivery time
-                self.rt.deliver(rows, batch.get("last_ts"))
         self._since_drained += 1
         if self._since_drained >= self.drain_check_every:
             # sustained load never drains the pipeline: run the overflow
@@ -346,14 +369,20 @@ class AsyncDeviceDriver:
             self._q.extend(batches)
             self._cv.notify_all()
 
-    def flush_sync(self) -> None:
+    def flush_sync(self, cause=None) -> None:
         """Submit any partial batch and drain: device state reflects every
-        event sent so far. Call without the engine lock."""
+        event sent so far. Call without the engine lock. ``cause`` counts
+        the flush and stamps the batch UNDER the lock — cause bookkeeping
+        is single-slot, so it must not race producer-side flushes."""
         with self.app_context.root_lock:
             if len(self.rt.builder):
+                if cause is not None:
+                    self.rt._count_flush(cause)
                 self.rt._seal()     # trace group closes WITH the emit,
                 # under the lock producers pack under
-                self.submit(self.rt.builder.emit())
+                b = self.rt.builder.emit()
+                b["_cause"] = self.rt._take_cause()
+                self.submit(b)
         self.quiesce()
 
     def pause(self) -> None:
@@ -434,6 +463,8 @@ class _DeviceRTBase(AdaptiveFlushMixin):
             return
         self._seal()            # trace group closes exactly at the emit
         b = self.builder.emit()
+        b["_cause"] = self._take_cause()    # phase attribution keys the
+        # deadline-queueing share off the flush cause riding the batch
         if self.driver is not None:
             self.driver.submit(b)
             return
@@ -522,16 +553,17 @@ class DeviceQueryBridge:
             self.runtime.send(stream_id, event.data, event.timestamp)
 
     def flush(self, cause: str = "drain") -> None:
-        if len(self.runtime.builder):
-            # cause accounting only — the trace-group seal happens at the
-            # emit itself (runtime.flush / driver.flush_sync, under the
-            # engine lock), so groups can never drift from batches
-            self.runtime._count_flush(cause)
         if self.driver is not None:
             # async: submit the partial batch and drain the device queue.
-            # Must not hold the engine lock (the worker's delivery needs it).
-            self.driver.flush_sync()
-        else:
+            # Must not hold the engine lock here (the worker's delivery
+            # needs it); the cause is counted inside flush_sync UNDER the
+            # lock so concurrent producer/deadline flushes can't swap the
+            # single-slot pending cause
+            self.driver.flush_sync(cause)
+            return
+        with self.app_context.root_lock:
+            if len(self.runtime.builder):
+                self.runtime._count_flush(cause)
             self.runtime.flush()
 
     def finalize(self) -> None:

@@ -92,6 +92,7 @@ class _HostRTBase(AdaptiveFlushMixin):
         if len(self.builder) == 0:
             return
         b = self.builder.emit()
+        b["_cause"] = self._take_cause()
         self.deliver(self._timed_process(b))
 
     def finalize(self):

@@ -14,7 +14,8 @@ Latency trackers are log-bucketed histograms
 the average — taken/closed with explicit tokens so concurrent or
 re-entrant measurements on one tracker can't mis-pair
 (``t = tracker.start(); ...; tracker.stop(t)``). The reference-style
-``mark_in``/``mark_out`` pair survives as a deprecated single-slot shim.
+``mark_in``/``mark_out`` single-slot shim is gone (PR 10): it dropped
+overlapping measurements by design and every caller now uses tokens.
 
 Reporters: ``@app(statistics='true')`` enables BASIC; @app elements
 ``statistics.reporter`` ('log' | 'console' | registered name) and
@@ -63,8 +64,6 @@ class LatencyTracker:
     def __init__(self, name: str):
         self.name = name
         self.hist = LogHistogram()
-        self._start: Optional[int] = None       # deprecated-shim slot only
-        self._shim_warned = False
 
     def start(self) -> int:
         return time.perf_counter_ns()
@@ -75,24 +74,12 @@ class LatencyTracker:
         self.hist.record(dt_ns / 1e9)
         return dt_ns
 
-    def record_seconds(self, seconds: float) -> None:
-        """Record an externally-timed sample (device step durations)."""
-        self.hist.record(seconds)
-
-    # -- deprecated single-slot shim ------------------------------------------
-    def mark_in(self) -> None:
-        """Deprecated: single-slot pairing drops/mis-pairs overlapping
-        measurements — use the ``start()``/``stop(token)`` API."""
-        if not self._shim_warned:
-            self._shim_warned = True
-            log.warning("LatencyTracker('%s').mark_in/mark_out is "
-                        "deprecated; use t = start(); stop(t)", self.name)
-        self._start = self.start()
-
-    def mark_out(self) -> None:
-        if self._start is not None:
-            self.stop(self._start)
-            self._start = None
+    def record_seconds(self, seconds: float, n: int = 1,
+                       exemplar=None) -> None:
+        """Record an externally-timed sample (device step durations);
+        ``n`` event-weights batch segments, ``exemplar`` stamps a sampled
+        trace id onto the bucket for OpenMetrics exemplar exposition."""
+        self.hist.record(seconds, n, exemplar=exemplar)
 
     # -- readouts --------------------------------------------------------------
     @property

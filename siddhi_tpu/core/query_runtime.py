@@ -254,7 +254,11 @@ class ObservedReceiver:
         finally:
             dt = time.perf_counter_ns() - t0
             if track:
-                self.tracker.record_seconds(dt / 1e9)
+                # a sampled trace becomes the bucket's exemplar: the tail
+                # links to a concrete journey
+                self.tracker.record_seconds(
+                    dt / 1e9,
+                    exemplar=tr.trace_id if tr is not None else None)
             if tr is not None:
                 tr.add_span("query", self.query_name, dt, 1)
 
@@ -269,7 +273,9 @@ class ObservedReceiver:
         finally:
             dt = time.perf_counter_ns() - t0
             if track:
-                self.tracker.record_seconds(dt / 1e9)
+                self.tracker.record_seconds(
+                    dt / 1e9,
+                    exemplar=tr.trace_id if tr is not None else None)
             if tr is not None:
                 tr.add_span("query", self.query_name, dt, len(events))
 

@@ -71,8 +71,11 @@ class StreamJunction:
             tracer = self.app_context.tracer
             if tracer is not None and event.trace is None:
                 # the delivery worker is a different thread: the sampled
-                # trace must ride the event across the queue
+                # trace must ride the event across the queue (the handoff
+                # mark becomes an ingress-queue span at delivery)
                 event.trace = tracer.active
+                if event.trace is not None:
+                    event.trace.mark_handoff()
             self.dispatcher.enqueue(("event", event))
             return
         self.deliver_event(event)
@@ -86,16 +89,20 @@ class StreamJunction:
             tracer = self.app_context.tracer
             if tracer is not None and events[0].trace is None:
                 events[0].trace = tracer.active
+                if events[0].trace is not None:
+                    events[0].trace.mark_handoff()
             self.dispatcher.enqueue(("chunk", events))
             return
         self.deliver_events(events)
 
     def _activate_trace(self, trace):
         """Re-activate a queue-carried trace on the delivery thread; returns
-        True when a matching pop() is owed."""
+        True when a matching pop() is owed. The enqueue-to-delivery wait
+        closes as an ``ingress-queue`` span (the handoff mark)."""
         tracer = self.app_context.tracer
         if tracer is None or trace is None or tracer.active is trace:
             return False
+        trace.close_handoff(self.definition.id)
         tracer.push(trace)
         return True
 
@@ -387,6 +394,24 @@ class InputHandler:
             raise ValueError(
                 f"send_rows: {len(rows)} rows but {len(timestamps)} "
                 f"timestamps")
+        tracer = self.app_context.tracer
+        if tracer is not None:
+            # bulk ingress samples per CHUNK (one maybe_trace per call):
+            # the columnar fast path must not pay per-row sampling checks
+            tr = tracer.maybe_trace(self.stream_id)
+            if tr is not None:
+                t0 = time.perf_counter_ns()
+                tracer.push(tr)
+                try:
+                    self._send_rows(rows, timestamps)
+                finally:
+                    tracer.pop()
+                    tr.add_span("ingress", self.stream_id,
+                                time.perf_counter_ns() - t0, len(rows))
+                return
+        self._send_rows(rows, timestamps)
+
+    def _send_rows(self, rows: list, timestamps) -> None:
         if self.flow is not None and not self.flow.replaying:
             self._send([Event(ts, row) for row, ts in zip(rows, timestamps)])
             return

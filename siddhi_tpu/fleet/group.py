@@ -28,6 +28,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from typing import Any, Optional
 
 import numpy as np
@@ -168,6 +169,10 @@ class FleetMember:
         self.max_lag = 0               # 0 = unlimited
         self.chaos = None
         self.lane = None               # TenantLane once guarded
+        # sampled traces whose events are staged in the shared (or solo)
+        # window: (Trace, stage perf_counter_ns); the step drains them with
+        # a 'fleet' span — the X-Ray handoff across the shared-lane hop
+        self.trace_pending: deque = deque()
         # solo-ladder build context (scalar escalation needs the original
         # query AST + the app's junction resolver)
         self.query = None
@@ -282,6 +287,35 @@ class FleetMemberState:
 # ---------------------------------------------------------------------------
 # the group
 # ---------------------------------------------------------------------------
+
+class GroupFlight:
+    """Flight-recorder fan-out for group-scoped control-plane transitions
+    (AIMD window resizes, group flush-cause flips): the shared window is
+    every tenant's latency policy, so the transition lands on EVERY
+    member app's timeline — a group has no app (and no recorder) of its
+    own."""
+
+    def __init__(self, group: "FleetGroup"):
+        self.group = group
+
+    def _recorders(self):
+        seen = set()
+        for m in self.group.members.values():
+            fl = getattr(m.app_context, "flight", None)
+            if fl is not None and id(fl) not in seen:
+                seen.add(id(fl))
+                yield fl
+
+    def record(self, category, kind, site="", detail=None,
+               trace_id=None) -> None:
+        for fl in self._recorders():
+            fl.record(category, kind, site, detail, trace_id)
+
+    def record_transition(self, category, kind, site="", detail=None,
+                          trace_id=None) -> None:
+        for fl in self._recorders():
+            fl.record_transition(category, kind, site, detail, trace_id)
+
 
 class FleetGroup:
     """All tenants of one shape on the columnar backend: shared plan, shared
@@ -428,10 +462,14 @@ class FleetGroup:
                 g = self.guard
                 if g is not None:
                     if m.ejected:
+                        self._register_trace(m)
                         g.solo_stage(m, gsid, [data], [ts])
                         return
                     if g.admit(m, gsid, [data]) == 0:
+                        # shed/diverted BEFORE staging: no trace handoff —
+                        # the event never reaches the shared step
                         return
+                self._register_trace(m)
                 self.stager.stage_event(m.mid, gsid, data, ts)
                 self._post_stage(m)
         finally:
@@ -443,6 +481,7 @@ class FleetGroup:
                 g = self.guard
                 if g is not None:
                     if m.ejected:
+                        self._register_trace(m)
                         g.solo_stage(m, gsid, [e.data for e in events],
                                      [e.timestamp for e in events])
                         return
@@ -451,6 +490,7 @@ class FleetGroup:
                         return
                     if k < len(events):
                         events = events[:k]
+                self._register_trace(m)
                 self.stager.stage_events(m.mid, gsid, events)
                 self._post_stage(m)
         finally:
@@ -463,6 +503,7 @@ class FleetGroup:
                 g = self.guard
                 if g is not None:
                     if m.ejected:
+                        self._register_trace(m)
                         g.solo_stage(m, gsid, rows, timestamps)
                         return
                     k = g.admit(m, gsid, rows)
@@ -471,6 +512,7 @@ class FleetGroup:
                     if k < len(rows):
                         rows = rows[:k]
                         timestamps = timestamps[:k]
+                self._register_trace(m)
                 self.stager.stage_rows(m.mid, gsid, rows, timestamps)
                 self._post_stage(m)
         finally:
@@ -480,6 +522,36 @@ class FleetGroup:
         g = self.guard
         if g is not None:
             g.drain_deferred(m.app_context)
+
+    # -- trace handoff across the shared-lane hop --------------------------
+    def _register_trace(self, m: FleetMember) -> None:
+        """A sampled trace active on the staging thread rides the member's
+        pending list until the shared (or solo) step closes its span —
+        the fleet analog of the device probe's trace groups."""
+        tracer = m.app_context.tracer
+        if tracer is None:
+            return
+        tr = tracer.active
+        if tr is not None:
+            m.trace_pending.append((tr, time.perf_counter_ns()))
+
+    def _drain_traces(self, m: FleetMember, n: int,
+                      outcome: str = "ok") -> None:
+        if not m.trace_pending:
+            return
+        now = time.perf_counter_ns()
+        while True:
+            try:
+                tr, t0 = m.trace_pending.popleft()
+            except IndexError:
+                break
+            tr.add_span("fleet", m.query_name, now - t0, batch_size=n,
+                        outcome=outcome)
+
+    def _drain_all_traces(self, n: int, outcome: str = "ok") -> None:
+        for m in self.members.values():
+            if not m.ejected:
+                self._drain_traces(m, n, outcome)
 
     def _post_stage(self, m: FleetMember) -> None:
         if self.stager.full:
@@ -571,6 +643,9 @@ class FleetGroup:
         if n == 0:
             if g is not None:
                 g.on_window_reset()
+            # the whole window was swept/diverted: pending traces still
+            # close (outcome says so) instead of bleeding into a later step
+            self._drain_all_traces(0, outcome="swept")
             return
         self.steps += 1
         self.events_in += n
@@ -587,6 +662,9 @@ class FleetGroup:
         c = self.batch_controller
         if c is not None:
             c.observe(n, time.perf_counter() - t0)
+        # every in-group member's pending traces close with a 'fleet' span
+        # once the shared step consumed the window they staged into
+        self._drain_all_traces(n)
 
     def _run_batched(self, b: dict, mids: np.ndarray) -> None:
         self._deliver_batched(self._compute_batched(b, mids))

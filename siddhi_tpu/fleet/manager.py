@@ -235,6 +235,12 @@ class FleetManager:
                                                 acfg["max_batch"])
                         group.batch_controller = \
                             AdaptiveBatchController(**acfg)
+                        # group-window resizes are every tenant's story:
+                        # fan the flight-recorder hook out to all members
+                        from .group import GroupFlight
+                        group.batch_controller.flight = GroupFlight(group)
+                        group.batch_controller.site = \
+                            f"fleet:{normalized.shape_key[:40]}"
                     self.groups[normalized.shape_key] = group
                     self.plan_cache.pin(normalized.shape_key, "numpy")
                 else:

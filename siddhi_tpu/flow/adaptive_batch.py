@@ -63,6 +63,10 @@ class AdaptiveBatchController:
         self.current = min(self.max_batch,
                            max(self.min_batch,
                                int(initial) if initial else self.min_batch))
+        # flight recorder hook: AIMD resizes are control-plane transitions
+        # (set post-construction by the observability wiring)
+        self.flight = None
+        self.site = ""
         self._lat_ms: collections.deque = collections.deque(maxlen=history)
         self._cooldown = max(1, int(cooldown))
         self._since_adjust = 0
@@ -126,8 +130,14 @@ class AdaptiveBatchController:
         else:
             return self.current
         if nxt != self.current:
-            self.current = nxt
+            old, self.current = self.current, nxt
             self.adjustments += 1
+            f = self.flight
+            if f is not None:
+                f.record("flow", "aimd_resize", site=self.site,
+                         detail={"from": old, "to": nxt,
+                                 "metric_ms": round(metric, 3),
+                                 "budget_ms": round(budget, 3)})
         self._since_adjust = 0
         return self.current
 
@@ -199,11 +209,29 @@ class AdaptiveFlushMixin:
     step_sealer = None          # DeviceStepProbe.seal — closes the probe's
     # open trace group when a batch is emitted (FIFO group-per-batch)
     flush_causes = None         # probe's flush-cause counter dict
+    flight = None               # FlightRecorder (observability wiring)
+    flight_site = ""
+    _pending_cause = None       # cause of the flush whose emit comes next
 
     def _count_flush(self, cause: str) -> None:
         fc = self.flush_causes
         if fc is not None:
             fc[cause] = fc.get(cause, 0) + 1
+        # the emitted batch inherits this cause (phase attribution keys the
+        # deadline-queueing share off it)
+        self._pending_cause = cause
+        f = self.flight
+        if f is not None:
+            # transition-recorded: only a CHANGE of flush cause lands on the
+            # flight timeline (capacity→deadline is the story; ten thousand
+            # capacity flushes are not)
+            f.record_transition("flow", f"flush:{cause}",
+                                site=self.flight_site)
+
+    def _take_cause(self):
+        c = self._pending_cause
+        self._pending_cause = None
+        return c
 
     def _maybe_flush(self) -> None:
         """Flush on the hard capacity OR the adaptive soft threshold (jitted
@@ -225,27 +253,52 @@ class AdaptiveFlushMixin:
             s()
 
     def observe_step(self, n_events: int, latency_s: float,
-                     device_path: bool = True) -> None:
+                     device_path: bool = True,
+                     phases: Optional[dict] = None) -> None:
         """Feed one stepped batch's latency to the adaptive controller and
         the observability step probe (the async driver reports its own step
         timing through this hook). ``device_path=False`` marks a step whose
         work the resilience layer rerouted to the host interpreter — the
         controller must not tune on it, but the probe still drains its
-        trace group."""
+        trace group. ``phases`` carries the batch's measured waterfall
+        segments (X-Ray phase attribution)."""
         c = self.batch_controller
         if c is not None and device_path:
             c.observe(n_events, latency_s)
         obs = self.step_observer
         if obs is not None:
-            obs(n_events, latency_s, device_path)
+            obs(n_events, latency_s, device_path, phases=phases)
 
     def _timed_process(self, batch: dict):
-        """Sync-path ``process(batch)``, timed for the controller/probe."""
+        """Sync-path step, timed for the controller/probe with the
+        dispatch/fence split measured separately (the ``device_step`` /
+        ``egress_fence`` phases; on the sync path there is no ring wait,
+        so ``ingress_queue`` is the emit→dispatch gap alone)."""
         if self.batch_controller is None and self.step_observer is None:
             return self.process(batch)
+        cause = batch.get("_cause")
+        if getattr(self, "dispatch", None) is None:
+            # host-tier runtime (no two-phase step): the whole step is one
+            # serial host_exec segment
+            t0 = time.perf_counter()
+            try:
+                rows = self.process(batch)
+            except BaseException:
+                self.observe_step(batch.get("count", 0),
+                                  time.perf_counter() - t0,
+                                  device_path=False)
+                raise
+            dt = time.perf_counter() - t0
+            self.observe_step(batch.get("count", 0), dt, phases={
+                "fill_span_s": batch.get("pack_s", 0.0),
+                "pack_s": batch.get("pack_exec_s", 0.0),
+                "host_s": dt, "cause": cause})
+            return rows
         t0 = time.perf_counter()
         try:
-            rows = self.process(batch)
+            token = self.dispatch(batch)
+            t1 = time.perf_counter()
+            rows = self.collect(token)
         except BaseException:
             # a raising step still consumed its batch: the probe must pop
             # this batch's trace group or every later device span would be
@@ -253,7 +306,16 @@ class AdaptiveFlushMixin:
             self.observe_step(batch.get("count", 0),
                               time.perf_counter() - t0, device_path=False)
             raise
-        self.observe_step(batch.get("count", 0), time.perf_counter() - t0)
+        t2 = time.perf_counter()
+        t_emit = batch.get("_t_emit")
+        self.observe_step(batch.get("count", 0), t2 - t0, phases={
+            "fill_span_s": batch.get("pack_s", 0.0),
+            "pack_s": batch.get("pack_exec_s", 0.0),
+            "queue_s": max(0.0, t0 - t_emit) if t_emit is not None else 0.0,
+            "step_s": t1 - t0,
+            "fence_s": t2 - t1,
+            "cause": cause,
+        })
         return rows
 
 

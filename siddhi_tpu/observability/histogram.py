@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from typing import Optional
 
 # quarter-octave ladder: 128 buckets cover 1e-6 s .. ~6000 s
@@ -42,6 +43,9 @@ class LogHistogram:
         # counts[i] guards (bounds[i-1], bounds[i]]; counts[-1] is overflow
         self._bounds = [min_value * growth ** i for i in range(num_buckets)]
         self._counts = [0] * (num_buckets + 1)
+        # bucket index -> (trace_id, value, unix_ts); None until the first
+        # exemplar so untraced apps allocate nothing
+        self._exemplars: Optional[dict] = None
         self._lock = threading.Lock()
         self.count = 0
         self.sum = 0.0
@@ -54,19 +58,32 @@ class LogHistogram:
         i = int(math.ceil(math.log(value / self.min_value) / self._log_growth))
         return min(i, len(self._bounds))       # len(_bounds) == overflow slot
 
-    def record(self, value: float) -> None:
+    def record(self, value: float, n: int = 1, exemplar=None) -> None:
+        """Record ``n`` samples of ``value`` (event-weighted batch segments
+        record their per-event average once with the batch's event count).
+        ``exemplar`` links a trace id to the bucket the sample landed in —
+        stored lazily, so untraced apps pay nothing and the exposition is
+        byte-identical until the first exemplar arrives."""
         v = float(value)
         if v < 0.0 or v != v:                  # negative / NaN: clamp out
             v = 0.0
+        if n < 1:
+            return
         i = self._index(v)
         with self._lock:
-            self._counts[i] += 1
-            self.count += 1
-            self.sum += v
+            self._counts[i] += n
+            self.count += n
+            self.sum += v * n
             if self.min is None or v < self.min:
                 self.min = v
             if self.max is None or v > self.max:
                 self.max = v
+            if exemplar is not None:
+                if self._exemplars is None:
+                    self._exemplars = {}
+                # one exemplar per bucket (the newest) — bounded by the
+                # bucket count, per the OpenMetrics le-bucket exemplar model
+                self._exemplars[i] = (str(exemplar), v, time.time())
 
     # -- readouts --------------------------------------------------------------
     def percentile(self, q: float) -> float:
@@ -104,6 +121,20 @@ class LogHistogram:
                 cum += self._counts[i]
                 out.append((self._bounds[i], cum))
             return out, self.count, self.sum
+
+    def exemplars(self) -> dict:
+        """``le_bound -> (trace_id, value, unix_ts)`` for buckets holding an
+        exemplar (empty when tracing never stamped one). The overflow
+        bucket's exemplar reports under ``+Inf`` (math.inf key)."""
+        with self._lock:
+            if not self._exemplars:
+                return {}
+            out = {}
+            for i, ex in self._exemplars.items():
+                le = self._bounds[i] if i < len(self._bounds) \
+                    else math.inf
+                out[le] = ex
+            return out
 
     def buckets(self) -> list[tuple[float, int]]:
         """Cumulative ``(le_bound, count)`` pairs, trimmed past the last

@@ -16,14 +16,22 @@ falls back to a sanitized literal rather than being dropped.
 
 from __future__ import annotations
 
+import math
 import re
 from typing import Iterable, Optional
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+# exemplars are an OpenMetrics feature: strict Prometheus-0.0.4 parsers
+# reject a trailing `# {...}` on a sample line, so the exemplar-bearing
+# exposition is served ONLY when the scraper negotiates OpenMetrics via
+# Accept (and then carries the required `# EOF` terminator). The default
+# 0.0.4 exposition never contains exemplars — byte-identical to pre-X-Ray.
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
 _SCOPE_LABEL = {"stream": "stream", "flow": "stream", "device": "query",
                 "query": "query", "partition": "query", "source": "stream",
-                "dcn": "peer", "host_batch": "query"}
+                "dcn": "peer", "host_batch": "query", "detection": "query"}
 _SAN = re.compile(r"[^a-z0-9_]+")
 
 
@@ -36,6 +44,11 @@ def _split_key(key: str) -> tuple[str, dict, Optional[str]]:
     """Registration key → (scope, labels, field)."""
     parts = key.split(".")
     scope = parts[0]
+    if scope == "phase" and len(parts) >= 3:
+        # phase.{query}.{phase_name}: the X-Ray per-phase histograms — the
+        # phase becomes a bounded label on ONE family, not a name suffix
+        return scope, {"query": parts[1],
+                       "phase": _sanitize(".".join(parts[2:]))}, None
     if scope == "sink" and len(parts) >= 3:
         field = ".".join(parts[3:]) or None
         return scope, {"stream": parts[1], "ordinal": parts[2]}, field
@@ -77,6 +90,12 @@ _LATENCY_FAMILIES = {
     "query": "siddhi_tpu_query_latency_seconds",
     "sink": "siddhi_tpu_sink_publish_latency_seconds",
     "device": "siddhi_tpu_device_step_latency_seconds",
+    # the X-Ray split: segments in ONE family keyed by a bounded phase
+    # label, the end-to-end distribution in its OWN family — putting the
+    # sum alongside its parts would double every
+    # sum-over-phases aggregation
+    "phase": "siddhi_tpu_phase_latency_seconds",
+    "detection": "siddhi_tpu_detection_latency_seconds",
 }
 
 
@@ -105,13 +124,22 @@ class _Family:
         self.name = name
         self.type = mtype
         self.help = help_text
-        self.samples: list[tuple[str, str, str]] = []  # (suffix, labels, val)
+        # (suffix, labels, val, exemplar_text)
+        self.samples: list[tuple[str, str, str, str]] = []
 
-    def add(self, labels: dict, value, suffix: str = "") -> None:
-        self.samples.append((suffix, _fmt_labels(labels), _fmt_value(value)))
+    def add(self, labels: dict, value, suffix: str = "",
+            exemplar=None) -> None:
+        ex = ""
+        if exemplar is not None:
+            # OpenMetrics exemplar syntax on a bucket sample:
+            #   ... <count> # {trace_id="<id>"} <value> <unix_ts>
+            tid, v, ts = exemplar
+            ex = f' # {{trace_id="{_escape(tid)}"}} {v:.9g} {ts:.3f}'
+        self.samples.append(
+            (suffix, _fmt_labels(labels), _fmt_value(value), ex))
 
 
-def _collect(sm, families: dict) -> None:
+def _collect(sm, families: dict, with_exemplars: bool = False) -> None:
     """Append one app's samples into the shared family map."""
     from ..core.metrics import Level
 
@@ -170,23 +198,33 @@ def _collect(sm, families: dict) -> None:
             scope, f"siddhi_tpu_{_sanitize(key)}_latency_seconds")
         f = fam(name, "histogram", f"{scope} latency distribution (seconds)")
         buckets, count, total = tracker.hist.export()   # one atomic read
+        # OpenMetrics exemplars: a tail bucket links to the concrete trace
+        # that landed in it. Only present when the scrape negotiated
+        # OpenMetrics AND a sampled trace stamped one — the 0.0.4
+        # exposition stays byte-identical to before in all cases.
+        exemplars = tracker.hist.exemplars() if with_exemplars else {}
         for le, cum in buckets:
-            f.add({**app, **labels, "le": f"{le:.6g}"}, cum, "_bucket")
-        f.add({**app, **labels, "le": "+Inf"}, count, "_bucket")
+            f.add({**app, **labels, "le": f"{le:.6g}"}, cum, "_bucket",
+                  exemplar=exemplars.get(le))
+        f.add({**app, **labels, "le": "+Inf"}, count, "_bucket",
+              exemplar=exemplars.get(math.inf))
         f.add({**app, **labels}, total, "_sum")
         f.add({**app, **labels}, count, "_count")
 
 
-def render(managers: Iterable) -> str:
-    """Prometheus text for one or more apps' StatisticsManagers."""
+def render(managers: Iterable, with_exemplars: bool = False) -> str:
+    """Prometheus text for one or more apps' StatisticsManagers.
+    ``with_exemplars=True`` renders the OpenMetrics-flavored exposition
+    (trace-id exemplars on ``le`` buckets; serve it under
+    :data:`OPENMETRICS_CONTENT_TYPE` with a trailing ``# EOF``)."""
     families: dict[str, _Family] = {}
     for sm in managers:
-        _collect(sm, families)
+        _collect(sm, families, with_exemplars)
     lines: list[str] = []
     for name in sorted(families):
         f = families[name]
         lines.append(f"# HELP {f.name} {f.help}")
         lines.append(f"# TYPE {f.name} {f.type}")
-        for suffix, labels, value in f.samples:
-            lines.append(f"{f.name}{suffix}{labels} {value}")
+        for suffix, labels, value, exemplar in f.samples:
+            lines.append(f"{f.name}{suffix}{labels} {value}{exemplar}")
     return "\n".join(lines) + ("\n" if lines else "")

@@ -37,7 +37,20 @@ class CircuitBreaker:
         self.consecutive_failures = 0
         self.opened_at: float | None = None
         self.open_count = 0               # times the circuit tripped
+        # flight-recorder hook: called (old_state, new_state) OUTSIDE the
+        # lock on every transition; must never raise (it is wrapped anyway)
+        self.listener = None
         self._lock = threading.Lock()
+
+    def _notify(self, old: str, new: str) -> None:
+        fn = self.listener
+        if fn is None or old == new:
+            return
+        try:
+            fn(old, new)
+        except Exception:   # noqa: BLE001 — observability must never take
+            # the guarded path down
+            pass
 
     @property
     def state_code(self) -> int:
@@ -55,12 +68,14 @@ class CircuitBreaker:
         """Force OPEN immediately (an unambiguous hard failure — e.g. a
         peer's process is known dead — should not wait out the threshold)."""
         with self._lock:
+            old = self.state
             if self.state != CircuitState.OPEN:
                 self.open_count += 1
             self.state = CircuitState.OPEN
             self.consecutive_failures = max(self.consecutive_failures,
                                             self.failure_threshold)
             self.opened_at = self.clock()
+        self._notify(old, CircuitState.OPEN)
 
     def allow(self) -> bool:
         """True when an attempt may proceed. An OPEN circuit past its
@@ -72,27 +87,36 @@ class CircuitBreaker:
                 if self.opened_at is not None and \
                         self.clock() - self.opened_at >= self.cooldown_s:
                     self.state = CircuitState.HALF_OPEN
-                    return True
+                else:
+                    return False
+            else:
+                # HALF_OPEN: one probe is already in flight; further
+                # attempts wait for its verdict
                 return False
-            # HALF_OPEN: one probe is already in flight; further attempts
-            # wait for its verdict
-            return False
+        self._notify(CircuitState.OPEN, CircuitState.HALF_OPEN)
+        return True
 
     def record_success(self) -> None:
         with self._lock:
+            old = self.state
             self.consecutive_failures = 0
             self.state = CircuitState.CLOSED
             self.opened_at = None
+        self._notify(old, CircuitState.CLOSED)
 
     def record_failure(self) -> None:
+        old = None
         with self._lock:
             self.consecutive_failures += 1
             if self.state == CircuitState.HALF_OPEN or \
                     self.consecutive_failures >= self.failure_threshold:
+                old = self.state
                 if self.state != CircuitState.OPEN:
                     self.open_count += 1
                 self.state = CircuitState.OPEN
                 self.opened_at = self.clock()
+        if old is not None:
+            self._notify(old, CircuitState.OPEN)
 
     def remaining_cooldown(self) -> float:
         with self._lock:

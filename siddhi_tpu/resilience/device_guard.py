@@ -29,7 +29,7 @@ import threading
 from typing import Callable, Optional
 
 from .chaos import ChaosInjector
-from .circuit import CircuitBreaker
+from .circuit import CircuitBreaker, CircuitState
 
 log = logging.getLogger("siddhi_tpu.resilience")
 
@@ -150,6 +150,7 @@ class DeviceGuard:
         self.fallback_events = 0        # events replayed through the host
         self.lost_events = 0            # shadow-less batches (bulk ingress)
         self.bridge = None              # set by guard_device for callbacks
+        self.flight = None              # FlightRecorder (observability wiring)
         self._last_step_fell_back = False
         self._fb_runtime = None
         self._fb_engine = None          # 'columnar' | 'scalar' once built
@@ -174,10 +175,11 @@ class DeviceGuard:
         # trace groups would pile up for the whole quarantine.
         inner_observe = getattr(rt, "observe_step", None)
         if inner_observe is not None:
-            def observe(n_events, latency_s, device_path=True):
+            def observe(n_events, latency_s, device_path=True, phases=None):
                 inner_observe(
                     n_events, latency_s,
-                    device_path=device_path and not self._last_step_fell_back)
+                    device_path=device_path and not self._last_step_fell_back,
+                    phases=phases)
             rt.observe_step = observe
 
     # -- two-phase step ------------------------------------------------------
@@ -223,11 +225,21 @@ class DeviceGuard:
 
     def _record_failure(self, e: Exception) -> None:
         self.failures += 1
+        was_open = self.breaker.state == CircuitState.OPEN
         self.breaker.record_failure()
         log.warning("%s: device step failed (%d consecutive, circuit %s)"
                     ": %s", self._site,
                     self.breaker.consecutive_failures,
                     self.breaker.state, e, exc_info=True)
+        fl = self.flight
+        if fl is not None:
+            fl.record("device", "step_failed", site=self.query_name,
+                      detail={"error": f"{type(e).__name__}: {e}"[:200]})
+            if not was_open and self.breaker.state == CircuitState.OPEN:
+                # quarantine engaged: dump the control-plane timeline so the
+                # post-mortem ships with the fault
+                fl.record("device", "quarantined", site=self.query_name)
+                fl.on_fault("device_quarantine", site=self.query_name)
 
     # -- host fallback -------------------------------------------------------
     def _fallback_runtime(self):

@@ -220,7 +220,25 @@ class FleetGuard:
         lane = TenantLane(member, self.threshold, self.cooldown_s)
         self.lanes[member.mid] = lane
         member.lane = lane
+        fl = self._flight(member)
+        if fl is not None:
+            # the tenant's circuit transitions land on ITS app's timeline
+            lane.breaker.listener = fl.breaker_listener(
+                "breaker", f"fleet:{member.query_name}")
         return lane
+
+    @staticmethod
+    def _flight(member):
+        return getattr(member.app_context, "flight", None)
+
+    def _record_shed(self, member, lane: TenantLane) -> None:
+        fl = self._flight(member)
+        if fl is not None:
+            # transition-recorded: a sustained shed storm is ONE timeline
+            # entry per onset, not one per chunk
+            fl.record_transition(
+                "fleet", "shed", site=f"fleet:{member.query_name}",
+                detail={"tenant": member.tenant, "shed_total": lane.shed})
 
     def detach(self, member) -> None:
         self.lanes.pop(member.mid, None)
@@ -239,6 +257,7 @@ class FleetGuard:
         n = k = len(rows)
         lane.observe_arrival(n)
         if member.max_lag:
+            fl = self._flight(member)
             allowed = member.max_lag - lane.staged_window
             if allowed <= 0 and len(self.group.stager):
                 # quota exhausted for this window: STEP the group to open a
@@ -249,10 +268,18 @@ class FleetGuard:
                 allowed = member.max_lag - lane.staged_window
             if allowed <= 0:
                 lane.shed += n
+                self._record_shed(member, lane)
                 return 0
             if allowed < k:
                 lane.shed += k - allowed
                 k = allowed
+                self._record_shed(member, lane)
+            elif fl is not None:
+                # the shed↔flowing flip is the recorded transition (the
+                # device probe's step_ok/fallback pattern): without it a
+                # second shed onset after recovery would dedupe away
+                fl.record_transition("fleet", "flowing",
+                                     site=f"fleet:{member.query_name}")
         if self.harden and not self._admit_dictionary(lane, gsid, rows[:k]):
             lane.poisoned += k
             return 0
@@ -646,6 +673,12 @@ class FleetGuard:
                     "after %d consecutive fault(s): %s", self._site,
                     m.tenant, m.query_name,
                     lane.breaker.consecutive_failures, err)
+        fl = self._flight(m)
+        if fl is not None:
+            fl.record("fleet", "ejected", site=f"fleet:{m.query_name}",
+                      detail={"tenant": m.tenant,
+                              "reason": lane.eject_reason[:200]})
+            fl.on_fault("fleet_ejection", site=f"fleet:{m.query_name}")
 
     def _replay_shadow(self, m, lane: TenantLane) -> None:
         if self._shadow is None:
@@ -697,6 +730,7 @@ class FleetGuard:
             stager._rows, stager._ts = [], []
             if hasattr(stager, "_mid"):
                 stager._mid = []
+            self.group._drain_traces(m, 0, outcome="scalar")
             return
         if b["count"] == 0:
             return
@@ -725,6 +759,9 @@ class FleetGuard:
     def _after_solo_batch(self, m, lane: TenantLane, n: int) -> None:
         lane.solo_events += n
         lane.solo_batches += 1
+        # pending sampled traces close with a solo-tier span (the X-Ray
+        # handoff contract: every hop stamps its span, fallback included)
+        self.group._drain_traces(m, n, outcome="solo")
         self._maybe_readmit(m, lane)
 
     def _maybe_readmit(self, m, lane: TenantLane) -> None:
@@ -750,6 +787,11 @@ class FleetGuard:
         log.info("%s: tenant '%s' re-admitted to the fleet group after %d "
                  "clean solo batches", self._site, m.tenant,
                  lane.solo_batches)
+        fl = self._flight(m)
+        if fl is not None:
+            fl.record("fleet", "readmitted", site=f"fleet:{m.query_name}",
+                      detail={"tenant": m.tenant,
+                              "clean_solo_batches": lane.solo_batches})
 
     def _scalar_replay(self, m, lane: TenantLane, shadow) -> None:
         """Queue the shadow for scalar replay — NEVER executed under the
@@ -839,6 +881,7 @@ class HostStepGuard:
         self.breaker = CircuitBreaker(failure_threshold, cooldown_s)
         self.query_name = bridge.query_name
         self._site = f"host_batch:{app_context.name}/{bridge.query_name}"
+        self.flight = None          # FlightRecorder (observability wiring)
         self.failures = 0
         self.fallback_events = 0
         self.lost_events = 0
@@ -871,11 +914,23 @@ class HostStepGuard:
             except Exception as e:  # noqa: BLE001 — quarantine boundary:
                 # the failed micro-batch reroutes to the scalar path
                 guard.failures += 1
+                was_open = guard.breaker.state == CircuitState.OPEN
                 guard.breaker.record_failure()
                 log.warning("%s: columnar step failed (%d consecutive, "
                             "circuit %s): %s", guard._site,
                             guard.breaker.consecutive_failures,
                             guard.breaker.state, e, exc_info=True)
+                fl = guard.flight
+                if fl is not None:
+                    fl.record("host", "step_failed", site=guard.query_name,
+                              detail={"error":
+                                      f"{type(e).__name__}: {e}"[:200]})
+                    if not was_open and \
+                            guard.breaker.state == CircuitState.OPEN:
+                        fl.record("host", "quarantined",
+                                  site=guard.query_name)
+                        fl.on_fault("host_quarantine",
+                                    site=guard.query_name)
                 # an EMIT-time failure (encode of a poison row) leaves the
                 # rows staged (the stager resets only on success) — clear
                 # them, or every later flush would fail again and re-replay

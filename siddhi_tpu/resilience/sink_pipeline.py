@@ -162,8 +162,11 @@ class ResilientSink:
             dt = time.perf_counter_ns() - t0
             if track:
                 # publish latency includes retries/backoff — that IS the
-                # egress cost the pipeline imposed on this event
-                self._latency.record_seconds(dt / 1e9)
+                # egress cost the pipeline imposed on this event; a sampled
+                # trace becomes the bucket's exemplar
+                self._latency.record_seconds(
+                    dt / 1e9,
+                    exemplar=tr.trace_id if tr is not None else None)
             if tr is not None:
                 tr.add_span("sink", self._site, dt, 1, outcome)
 

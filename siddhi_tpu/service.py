@@ -29,10 +29,21 @@ on request. Endpoints (stdlib http.server, threaded; no framework deps):
                                              counts (apps with an attached
                                              ``runtime.dcn_worker``)
     GET    /siddhi-apps/{name}/metrics       Prometheus 0.0.4 text exposition
-                                             of the app's statistics
+                                             of the app's statistics (tail
+                                             buckets carry trace exemplars
+                                             when @app:trace sampled one)
     GET    /metrics                          same, across every deployed app
     GET    /siddhi-apps/{name}/trace         sampled pipeline span chains
-                                             (@app:trace); ?limit=N caps it
+                                             (@app:trace); ?limit=N caps it,
+                                             ?stream=S filters by ingress
+                                             stream
+    GET    /siddhi-apps/{name}/latency       X-Ray detection-latency
+                                             attribution: per-query phase
+                                             histograms + end-to-end
+                                             reconciliation
+    GET    /siddhi-apps/{name}/flightrecorder
+                                             control-plane transition ring
+                                             (?category=, ?limit= filters)
     DELETE /siddhi-apps/{name}               undeploy (shutdown + forget)
     POST   /siddhi-apps/{name}/streams/{sid} body = JSON {"data": [...],
                                              "timestamp": ms?} → send event
@@ -84,6 +95,27 @@ class SiddhiService:
                 n = int(self.headers.get("Content-Length") or 0)
                 return self.rfile.read(n) if n else b""
 
+            def _wants_openmetrics(self) -> bool:
+                # exemplars ride only the OpenMetrics exposition — strict
+                # 0.0.4 parsers reject them, so the scraper must ask
+                return "application/openmetrics-text" in \
+                    (self.headers.get("Accept") or "")
+
+            def _parse_limit(self, query: dict):
+                """``?limit=`` → (ok, limit|None); replies 400 itself on a
+                malformed value (shared by the ring-paging endpoints)."""
+                limit = query.get("limit")
+                try:
+                    limit = int(limit) if limit else None
+                    if limit is not None and limit < 0:
+                        raise ValueError(limit)
+                except ValueError:
+                    self._reply(400, {
+                        "status": "ERROR",
+                        "message": "limit must be a non-negative integer"})
+                    return False, None
+                return True, limit
+
             def do_POST(self):
                 parts = [p for p in self.path.split("/") if p]
                 if parts == ["siddhi-apps"]:
@@ -114,32 +146,37 @@ class SiddhiService:
                     self._reply(200, {"status": "OK",
                                       "apps": sorted(service.runtimes)})
                 elif parts == ["metrics"]:
-                    from .observability import CONTENT_TYPE
-                    code, text = service.metrics_text(None)
-                    self._reply_text(code, text, CONTENT_TYPE)
+                    code, text, ctype = service.metrics_text(
+                        None, openmetrics=self._wants_openmetrics())
+                    self._reply_text(code, text, ctype)
                 elif len(parts) == 3 and parts[0] == "siddhi-apps" \
                         and parts[2] == "metrics":
-                    from .observability import CONTENT_TYPE
-                    code, text = service.metrics_text(parts[1])
+                    code, text, ctype = service.metrics_text(
+                        parts[1], openmetrics=self._wants_openmetrics())
                     if code == 200:
-                        self._reply_text(code, text, CONTENT_TYPE)
+                        self._reply_text(code, text, ctype)
                     else:
                         self._reply(code, {"status": "ERROR",
                                            "message": text})
                 elif len(parts) == 3 and parts[0] == "siddhi-apps" \
                         and parts[2] == "trace":
-                    limit = query.get("limit")
-                    try:
-                        limit = int(limit) if limit else None
-                        if limit is not None and limit < 0:
-                            raise ValueError(limit)
-                    except ValueError:
-                        self._reply(400, {
-                            "status": "ERROR",
-                            "message": "limit must be a non-negative "
-                                       "integer"})
+                    ok, limit = self._parse_limit(query)
+                    if not ok:
                         return
-                    code, payload = service.trace_export(parts[1], limit)
+                    code, payload = service.trace_export(
+                        parts[1], limit, query.get("stream"))
+                    self._reply(code, payload)
+                elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                        and parts[2] == "latency":
+                    code, payload = service.latency_stats(parts[1])
+                    self._reply(code, payload)
+                elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                        and parts[2] == "flightrecorder":
+                    ok, limit = self._parse_limit(query)
+                    if not ok:
+                        return
+                    code, payload = service.flight_export(
+                        parts[1], query.get("category"), limit)
                     self._reply(code, payload)
                 elif len(parts) == 3 and parts[0] == "siddhi-apps" \
                         and parts[2] == "status":
@@ -317,28 +354,62 @@ class SiddhiService:
             return 500, {"status": "ERROR", "message": str(e)}
         return 200, {"status": "OK", **report}
 
-    def metrics_text(self, name: Optional[str]) -> tuple[int, str]:
+    def metrics_text(self, name: Optional[str],
+                     openmetrics: bool = False) -> tuple[int, str, str]:
         """Prometheus text exposition: one app, or every deployed app when
-        ``name`` is None (the all-apps scrape endpoint)."""
-        from .observability import render
+        ``name`` is None (the all-apps scrape endpoint). Returns
+        ``(code, text, content_type)``; with ``openmetrics=True`` the
+        exposition carries trace-id exemplars and the ``# EOF`` terminator
+        under the OpenMetrics content type."""
+        from .observability import CONTENT_TYPE, render
+        from .observability.prometheus import OPENMETRICS_CONTENT_TYPE
+        ctype = OPENMETRICS_CONTENT_TYPE if openmetrics else CONTENT_TYPE
         if name is None:
             managers = [rt.ctx.statistics_manager
                         for _, rt in sorted(self.runtimes.items())]
-            return 200, render(managers)
-        rt = self.runtimes.get(name)
-        if rt is None:
-            return 404, f"no app '{name}' deployed"
-        return 200, render([rt.ctx.statistics_manager])
+        else:
+            rt = self.runtimes.get(name)
+            if rt is None:
+                return 404, f"no app '{name}' deployed", CONTENT_TYPE
+            managers = [rt.ctx.statistics_manager]
+        text = render(managers, with_exemplars=openmetrics)
+        if openmetrics:
+            text += "# EOF\n"
+        return 200, text, ctype
 
-    def trace_export(self, name: str,
-                     limit: Optional[int] = None) -> tuple[int, dict]:
-        """Sampled span chains from the app's @app:trace ring."""
+    def trace_export(self, name: str, limit: Optional[int] = None,
+                     stream: Optional[str] = None) -> tuple[int, dict]:
+        """Sampled span chains from the app's @app:trace ring; ``stream``
+        filters by ingress stream so a 2048-deep ring is usable without
+        client-side paging."""
         rt = self.runtimes.get(name)
         if rt is None:
             return 404, {"status": "ERROR",
                          "message": f"no app '{name}' deployed"}
         return 200, {"status": "OK",
-                     **rt.observability.trace_export(limit)}
+                     **rt.observability.trace_export(limit, stream)}
+
+    def latency_stats(self, name: str) -> tuple[int, dict]:
+        """X-Ray detection-latency attribution: per-query per-phase
+        percentiles reconciled against the end-to-end histogram."""
+        rt = self.runtimes.get(name)
+        if rt is None:
+            return 404, {"status": "ERROR",
+                         "message": f"no app '{name}' deployed"}
+        return 200, {"status": "OK", **rt.observability.latency_report()}
+
+    def flight_export(self, name: str, category: Optional[str] = None,
+                      limit: Optional[int] = None) -> tuple[int, dict]:
+        """The app's flight-recorder ring: timestamped control-plane
+        transitions (AIMD resizes, flush causes, breaker flips, ejections,
+        takeovers), trace-cross-referenced where provoked by a traced
+        batch."""
+        rt = self.runtimes.get(name)
+        if rt is None:
+            return 404, {"status": "ERROR",
+                         "message": f"no app '{name}' deployed"}
+        return 200, {"status": "OK",
+                     **rt.observability.flight_export(category, limit)}
 
     def resilience_stats(self, name: str) -> tuple[int, dict]:
         """Sink circuits/retries, device quarantine, chaos counters."""

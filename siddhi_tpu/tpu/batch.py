@@ -263,6 +263,7 @@ class BatchBuilder:
         phase in the driver's overlap accounting; extra keys never reach the
         jitted step — it indexes the batch dict by name)."""
         import time
+        t_emit0 = time.perf_counter()
         valid = np.zeros(self.capacity, dtype=bool)
         valid[: self._n] = True
         out = {
@@ -271,9 +272,14 @@ class BatchBuilder:
             "valid": valid,
             "count": self._n,
             "last_ts": int(self._ts[self._n - 1]) if self._n else 0,
-            "pack_s": (time.perf_counter() - self._pack_t0
+            "pack_s": (t_emit0 - self._pack_t0
                        if self._pack_t0 is not None else 0.0),
         }
+        # X-Ray waterfall stamps: SoA staging cost (the `pack` phase) and
+        # the emit instant, from which the driver derives ring-queue wait
+        t_emit = time.perf_counter()
+        out["pack_exec_s"] = t_emit - t_emit0
+        out["_t_emit"] = t_emit
         self._n = 0
         self._pack_t0 = None
         return out

@@ -67,6 +67,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability.tracing import TraceContext
 from ..resilience.chaos import ChaosFault
 from ..resilience.dcn_guard import (
     PEER_DOWN,
@@ -86,7 +87,38 @@ K_PING, K_PONG, K_OWNER, K_ADOPT = 5, 6, 7, 8
 # K_ROWS payload prefix: sender host, lane group, sender epoch (incarnation),
 # per-(sender→group) sequence number. Epoch lets a restarted sender's fresh
 # seq space supersede its dead incarnation's; seq drives receiver dedup.
+# After the prefix: a u16-counted block of sampled TraceContexts (X-Ray
+# cross-host stitching — baked into the frame bytes, so a context survives
+# retry, spill replay and failover with the rows it describes), then the
+# SoA row body.
 _ROWS_HDR = struct.Struct(">BBIQ")
+_CTX_COUNT = struct.Struct(">H")
+
+
+def _pack_ctxs(ctxs: list) -> bytes:
+    if not ctxs:
+        return _CTX_COUNT.pack(0)
+    return _CTX_COUNT.pack(len(ctxs)) + b"".join(c.pack() for c in ctxs)
+
+
+def _unpack_ctxs(payload: bytes, offset: int) -> tuple[list, int]:
+    """Parse the trace-context block; returns (contexts, body_offset).
+
+    Sanity-bounds the declared count against the payload size so a frame
+    from an incompatible peer (pre-X-Ray wire format — mixed-version
+    meshes are unsupported, as with every prior framing change) fails as
+    a DETECTED connection error instead of decoding garbage rows."""
+    (n,) = _CTX_COUNT.unpack_from(payload, offset)
+    offset += _CTX_COUNT.size
+    if offset + n * TraceContext.size > len(payload):
+        raise ConnectionError(
+            f"K_ROWS trace-context block claims {n} contexts past the "
+            f"frame end (incompatible peer wire format?)")
+    ctxs = []
+    for _ in range(n):
+        ctxs.append(TraceContext.unpack_from(payload, offset))
+        offset += TraceContext.size
+    return ctxs, offset
 # K_OWNER / K_ADOPT payloads
 _OWNER_FMT = struct.Struct(">BB")        # (group, owner host)
 _ADOPT_FMT = struct.Struct(">B")         # (group,)
@@ -290,13 +322,21 @@ class DCNWorker:
                  snapshot_every_frames: Optional[int] = None,
                  connect_timeout_s: float = CONNECT_TIMEOUT_S,
                  io_timeout_s: float = IO_TIMEOUT_S,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 tracer=None, flight=None):
         self.host_index = host_index
         self.topo = topology
         self.key_attr = key_attr
         self.stream_id = stream_id
         self.peers = dict(peers)
         self.on_rows = on_rows
+        # X-Ray: a PipelineTracer samples ingest calls and stitches across
+        # hosts (its mesh host index pins the trace-id namespace); a
+        # FlightRecorder logs takeover/rejoin control-plane transitions
+        self.tracer = tracer
+        if tracer is not None and tracer.host is None:
+            tracer.host = host_index
+        self.flight = flight
         # incarnation number: a restarted sender MUST come back with a
         # higher epoch or peers' dedup tables (which persist in snapshots)
         # silently discard its fresh seq space as retries. With a store the
@@ -364,6 +404,7 @@ class DCNWorker:
         self._hb_socks: dict = {}
         self._ever_connected: set = set()
         self._sm = None               # StatisticsManager, when registered
+        self._transit_tracker = None  # dcn_transit phase histogram (ditto)
 
         self.guard = DCNGuard(self, guard_config, clock=clock)
 
@@ -403,7 +444,13 @@ class DCNWorker:
     def ingest(self, rows: list, timestamps: list) -> None:
         """Accepts arbitrary rows; applies locally-owned ones, forwards the
         rest in ONE frame per destination lane group (acked — see
-        ``_forward``; peer-down frames spill for in-order replay)."""
+        ``_forward``; peer-down frames spill for in-order replay). With a
+        tracer attached, every Nth call opens a trace whose context rides
+        the outgoing frames — the receiving host re-activates it, so one
+        trace id spans the mesh."""
+        tr = self.tracer.maybe_trace(self.stream_id) \
+            if self.tracer is not None else None
+        t_ing0 = time.perf_counter_ns() if tr is not None else 0
         key_pos = self._key_pos
         by_group: dict = {}
         # a locally-owned group with a spill backlog (takeover window) must
@@ -421,20 +468,31 @@ class DCNWorker:
                     r, t = by_group.setdefault(g, ([], []))
                     r.append(row)
                     t.append(ts)
+        if tr is not None:
+            tr.add_span("ingress", self.stream_id,
+                        time.perf_counter_ns() - t_ing0, len(rows))
         for g, (prows, pts) in by_group.items():
             # framing errors (malformed row data) raise to the caller,
             # exactly like a malformed row on the local-apply path — only
             # POST-framing failures are swallowed, because by then the
             # frame is guaranteed parked in the spill queue
             body = pack_rows(self._types, prows, pts)
+            ctxs = [self.tracer.context_of(tr)] if tr is not None else []
+            t_fwd0 = time.perf_counter_ns() if tr is not None else 0
             try:
-                acked = self._forward(g, body, len(prows))
+                acked = self._forward(g, body, len(prows), ctxs)
             except Exception:   # noqa: BLE001 — logged; the frame is
                 # already parked in the spill queue by _forward, and one
                 # group's failure must not drop the REMAINING groups' rows
                 log.exception("host %d: forward to group %d failed",
                               self.host_index, g)
                 continue
+            finally:
+                if tr is not None:
+                    # the sender-side half of the hop: frame build + send +
+                    # ack wait (or the spill decision) for this lane group
+                    tr.add_span("dcn", f"h{self.host_index}->g{g}",
+                                time.perf_counter_ns() - t_fwd0, len(prows))
             if acked:
                 # count under the lock, and only rows actually acked —
                 # spilled/failed frames are counted by the spill queue
@@ -453,10 +511,13 @@ class DCNWorker:
         if b.full:
             shard.flush(decode=self.on_rows is not None)
 
-    def _forward(self, group: int, body: bytes, n: int) -> int:
+    def _forward(self, group: int, body: bytes, n: int,
+                 ctxs: Optional[list] = None) -> int:
         """Deliver one lane group's pre-packed rows; returns rows acked by
         the remote owner (0 when spilled, failed, or applied locally after
-        an ownership change mid-flight)."""
+        an ownership change mid-flight). ``ctxs`` (sampled TraceContexts)
+        bake into the frame bytes — retries, spill replay and failover all
+        resend the SAME frame, so the contexts travel with the rows."""
         spill_q = self.guard.spill(group)
         if self.guard.must_spill(group):
             # BLOCK-policy admission wait happens OUTSIDE the group lock so
@@ -466,7 +527,7 @@ class DCNWorker:
             seq = self._next_seq.get(group, 0) + 1
             self._next_seq[group] = seq
             frame = _ROWS_HDR.pack(self.host_index, group, self.epoch,
-                                   seq) + body
+                                   seq) + _pack_ctxs(ctxs or []) + body
             if not spill_q.empty:
                 # a backlog exists for a group WE now own (takeover window):
                 # drain it before this frame applies, or the locally-applied
@@ -568,7 +629,8 @@ class DCNWorker:
         the SAME dedup path a remote receiver uses (takeover replay and
         ownership changes mid-send land here)."""
         sender, group, epoch, seq = _ROWS_HDR.unpack_from(frame)
-        rows, tss = unpack_rows(frame[_ROWS_HDR.size:])
+        ctxs, body_off = _unpack_ctxs(frame, _ROWS_HDR.size)
+        rows, tss = unpack_rows(frame[body_off:])
         with self._engine_lock:
             if group not in self._shards:
                 raise ConnectionError(
@@ -585,7 +647,29 @@ class DCNWorker:
             # group's owner — us"), keeping the row totals reconcilable
             # across a takeover's spill replay
             self.forwarded += len(rows)
+        self._adopt_ctxs(ctxs, sender, group, len(rows))
         return len(rows)
+
+    def _adopt_ctxs(self, ctxs: list, sender: int, group: int,
+                    n_rows: int) -> None:
+        """Re-activate sampled trace contexts that rode an APPLIED frame:
+        each stitches into this host's ring under its original trace id
+        with a ``dcn`` hop span (send wall-clock → apply wall-clock, so
+        retry and spill-replay delay count as transit — loopback/NTP skew
+        is the documented error bar). Dup frames never reach here —
+        exactly-once applies to spans too."""
+        if not ctxs:
+            return
+        now_unix = time.time_ns()
+        for ctx in ctxs:
+            hop_ns = max(0, now_unix - ctx.sent_unix_ns)
+            if self.tracer is not None:
+                tr = self.tracer.adopt(ctx)
+                tr.add_span("dcn", f"h{ctx.origin_host}->h{self.host_index}",
+                            hop_ns, batch_size=n_rows)
+            if self._transit_tracker is not None:
+                self._transit_tracker.record_seconds(
+                    hop_ns / 1e9, exemplar=ctx.trace_id)
 
     # -- dedup (exactly-once across retries, restarts, and failover) ----------
     def _is_dup_locked(self, group: int, sender: int, epoch: int,
@@ -768,6 +852,10 @@ class DCNWorker:
                 self.rt = shard
             self.topo.reassign(group, self.host_index)
             self.takeovers += 1
+        if self.flight is not None:
+            self.flight.record("dcn", "takeover", site=f"group{group}",
+                               detail={"refresh": refresh,
+                                       "host": self.host_index})
         log.info("host %d: took over lane group %d", self.host_index, group)
         # announce off the caller (usually the heartbeat thread): serial
         # request/reply to every peer at io_timeout each would stall
@@ -793,6 +881,10 @@ class DCNWorker:
                 self._save_group_locked(group, shard)
             del self._shards[group]
             self.topo.reassign(group, home)
+        if self.flight is not None:
+            self.flight.record("dcn", "rejoin", site=f"group{group}",
+                               detail={"home": home,
+                                       "host": self.host_index})
         log.info("host %d: released lane group %d back to host %d",
                  self.host_index, group, home)
         # no K_OWNER broadcast here: home's own take_over announces once the
@@ -968,9 +1060,11 @@ class DCNWorker:
         site = f"dcn:serve:{self.host_index}"
         if self.chaos is not None:
             self.chaos.on_dcn_serve(site)   # kill-peer site: abort, no ack
-        rows, tss = unpack_rows(payload[_ROWS_HDR.size:])
+        ctxs, body_off = _unpack_ctxs(payload, _ROWS_HDR.size)
+        rows, tss = unpack_rows(payload[body_off:])
         redirect = None
         due = False
+        applied = False
         with self._engine_lock:
             if group not in self._shards:
                 redirect = self.topo.owner[group]
@@ -984,6 +1078,7 @@ class DCNWorker:
                     lane = self.topo.lane_of(row[self._key_pos])
                     self._apply_locked(group, lane, row, ts)
                 self._mark_locked(group, sender, epoch, seq)
+                applied = True
                 # the durability cadence is PER GROUP: a global counter
                 # with interleaved senders could systematically skip one
                 # group's snapshots (unbounded loss instead of <= N-1
@@ -992,6 +1087,10 @@ class DCNWorker:
                 self._frames_applied[group] = c
                 n = self.snapshot_every_frames
                 due = bool(n) and c % n == 0
+        if applied:
+            # adopt ONLY on an actual apply — a deduped retry must not
+            # double-stamp hop spans
+            self._adopt_ctxs(ctxs, sender, group, len(rows))
         if redirect is not None:
             # stale routing at the sender: point it at the current owner;
             # it re-sends the SAME frame there, so dedup state stays with
@@ -1078,6 +1177,9 @@ class DCNWorker:
         sm.gauge_tracker("dcn.self.snapshots_total", lambda: self.snapshots)
         sm.gauge_tracker("dcn.self.owned_groups",
                          lambda: len(self._shards))
+        # the dcn_transit phase histogram: cross-host hop time (send
+        # wall-clock → apply) for frames carrying sampled trace contexts
+        self._transit_tracker = sm.latency_tracker("dcn.self.transit")
         self._sm = sm
 
     def close(self) -> None:

@@ -204,6 +204,7 @@ class MergedBatchBuilder:
         return take
 
     def emit(self) -> dict:
+        t_emit0 = time.perf_counter()
         n = self._n
         base = int(self._ts[:n].min()) if n else 0
         deltas = self._ts - base
@@ -223,9 +224,13 @@ class MergedBatchBuilder:
             "ts_base": np.int64(base),
             "count": n,
             "last_ts": int(self._ts[n - 1]) if n else 0,
-            "pack_s": (time.perf_counter() - self._pack_t0
+            "pack_s": (t_emit0 - self._pack_t0
                        if self._pack_t0 is not None else 0.0),
         }
+        # X-Ray waterfall stamps (see BatchBuilder.emit)
+        t_emit = time.perf_counter()
+        out["pack_exec_s"] = t_emit - t_emit0
+        out["_t_emit"] = t_emit
         self._n = 0
         self._pack_t0 = None
         return out
@@ -1885,6 +1890,7 @@ class DeviceNFARuntime(AdaptiveFlushMixin):
             return None
         self._seal()            # trace group closes exactly at the emit
         batch = self.builder.emit()
+        batch["_cause"] = self._take_cause()
         if self.driver is not None:
             self.driver.submit(batch)
             return None

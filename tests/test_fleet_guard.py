@@ -495,7 +495,10 @@ def test_64_tenant_chaos_soak_innocents_byte_identical(manager):
     """Tenant k faults at fleet.fault.p=0.05 over a 64-tenant group: the
     culprit ejects to solo and later re-admits, the other 63 tenants'
     outputs are BYTE-IDENTICAL to their solo oracle runs, and the
-    fleet.tenant.* metrics + service endpoint report the ejection."""
+    fleet.tenant.* metrics + service endpoint report the ejection.
+    Extended (ISSUE 10): the culprit app's flight recorder must hold the
+    whole story — ejection, readmission, breaker transitions, and at
+    least one AIMD resize — in timestamp order, over the HTTP endpoint."""
     k = 64
     culprit = 17
     body = (lambda i: f"@info(name='rule') from S[v > {20.0 + i * 0.5}] "
@@ -503,11 +506,16 @@ def test_64_tenant_chaos_soak_innocents_byte_identical(manager):
     chaos = "@app:chaos(seed='29', fleet.fault.p='0.05')\n"
     ann = "@app:fleet(batch='256', guard.cooldown.ms='5', " \
           "guard.readmit.batches='2')\n"
+    # the FIRST tenant's @app:adaptive sizes the shared group window; a
+    # sub-ms target guarantees one multiplicative decrease (128 → 64),
+    # which must land on every member's flight recorder as an AIMD resize
+    adaptive = "@app:adaptive(target.ms='0.001', min='64', initial='128')\n"
     events = gen_events(400, seed=31)
     runtimes, fleet = run_tenants(
         manager,
         tenant_apps(body, k,
-                    lambda i: ann + (chaos if i == culprit else "")),
+                    lambda i: ann + (adaptive if i == 0 else "")
+                    + (chaos if i == culprit else "")),
         events, chunk=8, pause_every=8)
     lane = lane_of(runtimes[culprit])
     assert lane.ejections >= 1, "culprit never ejected"
@@ -530,6 +538,7 @@ def test_64_tenant_chaos_soak_innocents_byte_identical(manager):
     from siddhi_tpu.service import SiddhiService
     svc = SiddhiService(manager, port=0)
     svc.runtimes = {rt.name: rt for rt in runtimes}
+    started = False
     try:
         code, payload = svc.fleet_stats(runtimes[culprit].name)
         assert code == 200 and payload["enabled"]
@@ -537,8 +546,39 @@ def test_64_tenant_chaos_soak_innocents_byte_identical(manager):
         assert guard["ejections"] >= 1 and guard["readmissions"] >= 1
         gk = runtimes[culprit].fleet_bridges[0].group.shape_key
         assert payload["groups"][gk]["guard"]["containments"] >= 1
+
+        # flight-recorder evidence, retrieved over REAL HTTP (ISSUE 10
+        # acceptance): ejection, readmission, breaker transitions, and at
+        # least one AIMD resize — all on ONE app's timeline, in order
+        import http.client
+        import json
+        svc.start()
+        started = True
+        conn = http.client.HTTPConnection("127.0.0.1", svc.port,
+                                          timeout=10)
+        conn.request(
+            "GET", f"/siddhi-apps/{runtimes[culprit].name}/flightrecorder")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        flight = json.loads(resp.read().decode())
+        conn.close()
+        assert flight["enabled"]
+        entries = flight["entries"]
+        kinds = [e["kind"] for e in entries]
+        assert "ejected" in kinds, "ejection missing from flight recorder"
+        assert "readmitted" in kinds, "readmission missing"
+        assert "aimd_resize" in kinds, "AIMD resize missing"
+        breaker_kinds = {e["kind"] for e in entries
+                         if e["category"] == "breaker"}
+        assert "circuit:open" in breaker_kinds, "breaker open missing"
+        assert "circuit:closed" in breaker_kinds, "breaker re-close missing"
+        # timestamp order, and the causal order of the story itself
+        assert [e["t"] for e in entries] == sorted(e["t"] for e in entries)
+        assert kinds.index("ejected") < kinds.index("readmitted")
     finally:
-        svc._server.server_close()      # never started; just free the port
+        if started:
+            svc._server.shutdown()      # HTTP only — the manager fixture
+        svc._server.server_close()      # owns runtime shutdown
 
 
 # ---------------------------------------------------------------------------

@@ -103,13 +103,31 @@ def test_latency_tracker_concurrent_threads_drop_no_samples():
     assert t.count == n_threads * per_thread
 
 
-def test_latency_tracker_mark_in_out_shim_still_records():
+def test_latency_tracker_mark_in_out_shim_removed():
+    # the deprecated single-slot shim is gone (PR 10): every measurement
+    # pairs through explicit tokens, so overlapping sites can't mis-pair
     t = LatencyTracker("legacy")
-    t.mark_in()
-    t.mark_out()
-    t.mark_out()               # unpaired second out is a no-op
+    assert not hasattr(t, "mark_in")
+    assert not hasattr(t, "mark_out")
+    tok = t.start()
+    t.stop(tok)
     assert t.count == 1
     assert t.total_ns >= 0
+
+
+def test_latency_tracker_weighted_and_exemplar_records():
+    t = LatencyTracker("weighted")
+    t.record_seconds(0.010, n=8, exemplar=41)
+    assert t.count == 8
+    assert abs(t.hist.sum - 0.08) < 1e-9
+    ex = t.hist.exemplars()
+    assert len(ex) == 1
+    (le, (tid, value, ts)), = ex.items()
+    assert tid == "41" and abs(value - 0.010) < 1e-12 and value <= le
+    # no exemplar → no allocation, empty map
+    t2 = LatencyTracker("bare")
+    t2.record_seconds(0.010)
+    assert t2.hist.exemplars() == {} and t2.hist._exemplars is None
 
 
 # ------------------------------------------------------------ dead gauges
