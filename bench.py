@@ -96,8 +96,21 @@ TENANT_FEED = int(os.environ.get("BENCH_TENANT_FEED", 12_000))
 TENANT_CHUNK = int(os.environ.get("BENCH_TENANT_CHUNK", 16))
 FLEET_BATCH = int(os.environ.get("BENCH_FLEET_BATCH", 8192))
 FLEET_PATTERN_FEED = int(os.environ.get("BENCH_FLEET_PATTERN_FEED", 4_000))
+# zero-object edge line (--edge-child): raw CSV transport bytes parsed
+# straight into columns (native ingress when a toolchain exists) and fed
+# through send_columns into the columnar host tier — measures host
+# bytes-in → rows-out with NO per-event Python objects (asserted)
+EDGE_EVENTS = int(os.environ.get("BENCH_EDGE_EVENTS", 1_000_000))
+EDGE_CHUNK_BYTES = int(os.environ.get("BENCH_EDGE_CHUNK_BYTES", 1 << 20))
+EDGE_BATCH = int(os.environ.get("BENCH_EDGE_BATCH", 65536))
+# parallel columnar host tier line: the bench pattern corpus under
+# @app:host_batch(workers=W) for W in {1,2,4}
+EDGE_PAR_EVENTS = int(os.environ.get("BENCH_EDGE_PAR_EVENTS", 200_000))
+EDGE_PAR_BATCH = int(os.environ.get("BENCH_EDGE_PAR_BATCH", 32768))
+EDGE_PAR_LANES = int(os.environ.get("BENCH_EDGE_PAR_LANES", 16))
 HOST_DEADLINE_S = int(os.environ.get("BENCH_HOST_DEADLINE_S", 300))
 FLEET_DEADLINE_S = int(os.environ.get("BENCH_FLEET_DEADLINE_S", 300))
+EDGE_DEADLINE_S = int(os.environ.get("BENCH_EDGE_DEADLINE_S", 300))
 SMOKE_DEADLINE_S = int(os.environ.get("BENCH_SMOKE_DEADLINE_S", 60))
 # (the r1-r4 escalating probe ladder is gone: it is what starved r4's
 # device attempt — see VERDICT r4 "what's weak" item 3)
@@ -804,6 +817,239 @@ def child_host() -> None:
     print(json.dumps(child_out))
 
 
+def _edge_csv(events) -> bytes:
+    """The transport payload a socket/file would deliver (building it is
+    data generation, not ingest — untimed)."""
+    return "".join(f"{dev},{v},{ts}\n" for dev, v, ts in events).encode()
+
+
+def _edge_rule_app(name: str, batch: int, topic: str = "edge-warm") -> str:
+    # rows-capable sink on the output stream: the measured path covers the
+    # FULL edge — bytes → columns → engine → columnar sink publish
+    return f"""
+@app(name='{name}')
+@app:host_batch(batch='{batch}', lanes='8')
+define stream S (dev string, v double);
+@sink(type='inMemory', topic='{topic}', @map(type='passThrough'))
+define stream Alerts (dev string, v double);
+from S[v > 90.0] select dev, v insert into Alerts;
+"""
+
+
+def _edge_pattern_app(name: str, workers: int) -> str:
+    states = " -> ".join(
+        f"e{i}=S[v > e{i-1}.v]" if i > 1 else "e1=S[v > 90.0]"
+        for i in range(1, N_STATES + 1))
+    sel = ", ".join(f"e{i}.v as v{i}" for i in range(1, N_STATES + 1))
+    return f"""
+@app(name='{name}')
+@app:host_batch(batch='{EDGE_PAR_BATCH}', lanes='{EDGE_PAR_LANES}',
+                workers='{workers}')
+define stream S (dev string, v double);
+partition with (dev of S)
+begin
+from every {states} within 4000
+select {sel} insert into Alerts;
+end;
+"""
+
+
+def _edge_feed(parser, csv: bytes, ih, flush) -> float:
+    """Stream the payload in transport-sized reads through parse →
+    send_columns; returns wall seconds."""
+    pos, total = 0, len(csv)
+    t0 = time.perf_counter()
+    while pos < total:
+        end = csv.rfind(b"\n", 0, pos + EDGE_CHUNK_BYTES) + 1
+        if end <= pos:
+            end = total
+        for ch in parser.parse(csv[pos:end]):
+            ih.send_columns(ch.cols, ch.ts, ch.count)
+        pos = end
+    flush()
+    return time.perf_counter() - t0
+
+
+def _thread_ceiling() -> float:
+    """What THIS container's cores/bandwidth allow: 2-thread speedup on a
+    representative memory-bound boolean-grid op mix (the parallel tier
+    cannot beat this no matter how it shards)."""
+    import numpy as np
+    from concurrent.futures import ThreadPoolExecutor
+
+    def work(seed):
+        rng = np.random.default_rng(seed)
+        a = rng.random((2048, 400))
+        b = rng.random((1, 400))
+        t = rng.random(2048)[:, None]
+        s = 0.0
+        for _ in range(20):
+            s += ((a > b) & (t < 0.5)).any(axis=0).sum()
+        return s
+
+    t0 = time.perf_counter()
+    for i in range(4):
+        work(i)
+    seq = time.perf_counter() - t0
+    with ThreadPoolExecutor(2) as ex:
+        t0 = time.perf_counter()
+        list(ex.map(work, range(4)))
+        par = time.perf_counter() - t0
+    return seq / par if par else 0.0
+
+
+def child_edge() -> None:
+    """Zero-object edge line: host bytes-in → rows-out.
+
+    1. **edge rule line** — EDGE_EVENTS rows of raw CSV transport bytes
+       parsed into columns (native C++ ingress when available) and fed via
+       ``send_columns`` through a columnar rule query into a rows-capable
+       in-memory sink: rows/s end to end, parse share, and an allocation
+       assertion that ZERO ``Event``/``StreamEvent`` objects were built on
+       the measured path (instrumented constructors stay armed during the
+       timed run — they cost nothing when never called);
+    2. **parallel tier line** — the bench pattern corpus through
+       ``@app:host_batch(workers=W)`` for W ∈ {1,2,4}: rates, speedups and
+       a zero-mismatch parity pin across worker counts, plus this
+       container's measured thread-scaling ceiling for context.
+    """
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.columns import CsvColumnParser, RowsChunk
+    from siddhi_tpu.core.event import Event, StreamEvent
+    from siddhi_tpu.core.io import InMemoryBroker
+
+    out = {"events": EDGE_EVENTS, "chunk_bytes": EDGE_CHUNK_BYTES,
+           "batch": EDGE_BATCH, "cpus": os.cpu_count()}
+    events = gen_events(EDGE_EVENTS)
+    csv = _edge_csv(events)
+    out["bytes_in"] = len(csv)
+
+    # arm the allocation counters for the WHOLE edge run: the zero-object
+    # claim is then an assertion over the measured path itself
+    counts = {"se": 0, "ev": 0}
+    se_init, ev_init = StreamEvent.__init__, Event.__init__
+
+    def _se(self, *a, **k):
+        counts["se"] += 1
+        se_init(self, *a, **k)
+
+    def _ev(self, *a, **k):
+        counts["ev"] += 1
+        ev_init(self, *a, **k)
+
+    try:
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(
+            _edge_rule_app("edge-warm", EDGE_BATCH), playback=True)
+        rt.start()
+        wih = rt.input_handler("S")
+        defn = rt.ctx.stream_junctions["S"].definition
+        wparser = CsvColumnParser(defn, ts_last=True, capacity=EDGE_BATCH)
+        out["ingress"] = wparser.ingress
+        # warm numpy kernels + dictionaries on a scratch runtime
+        _edge_feed(wparser, csv[:EDGE_CHUNK_BYTES], wih, rt.flush_host)
+        m.shutdown()
+
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(
+            _edge_rule_app("edge", EDGE_BATCH, topic="edge-out"),
+            playback=True)
+        sink_rows = [0]
+
+        def on_pub(payload):
+            sink_rows[0] += payload.count if isinstance(payload, RowsChunk) \
+                else 1
+
+        unsub = InMemoryBroker.subscribe("edge-out", on_pub)
+        rt.start()
+        parser = CsvColumnParser(defn, ts_last=True, capacity=EDGE_BATCH)
+        ih = rt.input_handler("S")
+        StreamEvent.__init__, Event.__init__ = _se, _ev
+        dt = _edge_feed(parser, csv, ih, rt.flush_host)
+        StreamEvent.__init__, Event.__init__ = se_init, ev_init
+        unsub()
+        m.shutdown()
+        out.update({
+            "rows_per_s": round(EDGE_EVENTS / dt),
+            "seconds": round(dt, 3),
+            "bytes_per_s": round(len(csv) / dt),
+            "parse_share": round(parser.parse_seconds / dt, 3),
+            "parse_rows_per_s": round(parser.rows_per_s),
+            "parse_errors": parser.parse_errors,
+            "out_rows": sink_rows[0],
+            "objects_per_row": (counts["se"] + counts["ev"]) / EDGE_EVENTS,
+            "objects": dict(counts),
+        })
+        print(f"# edge ({out['ingress']}): {EDGE_EVENTS} rows in {dt:.3f}s "
+              f"-> {out['rows_per_s']:,} rows/s (parse share "
+              f"{out['parse_share']:.2f}), {sink_rows[0]} sink rows, "
+              f"objects/row={out['objects_per_row']}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — parallel line still valuable
+        StreamEvent.__init__, Event.__init__ = se_init, ev_init
+        out["error"] = str(e)
+        print(f"# edge rule line failed: {e}", file=sys.stderr)
+
+    # ---- parallel columnar host tier: workers ∈ {1,2,4} ------------------
+    try:
+        par_csv = _edge_csv(gen_events(EDGE_PAR_EVENTS))
+        workers_out = {}
+        matches = {}
+        # interleaved best-of-3 (the X-Ray overhead pin's pattern): the
+        # shared container's cores are noisy, and back-to-back per-W
+        # sampling turns a quiet window into a fake speedup (or slowdown)
+        best: dict = {}
+        for rep in range(3):
+            for W in (1, 2, 4):
+                m = SiddhiManager()
+                rt = m.create_siddhi_app_runtime(
+                    _edge_pattern_app(f"edge-par-{W}-{rep}", W),
+                    playback=True)
+                rt.start()
+                defn = rt.ctx.stream_junctions["S"].definition
+                p = CsvColumnParser(defn, ts_last=True,
+                                    capacity=EDGE_PAR_BATCH)
+                dt = _edge_feed(p, par_csv, rt.input_handler("S"),
+                                rt.flush_host)
+                mcount = rt.host_bridges[0].runtime.prt.match_count
+                m.shutdown()
+                if W in matches and matches[W] != mcount:
+                    matches[W] = -1         # intra-W nondeterminism: loud
+                else:
+                    matches[W] = mcount
+                if rep:                     # rep 0 is the warm pass
+                    best[W] = min(best.get(W, dt), dt)
+        for W in (1, 2, 4):
+            workers_out[str(W)] = round(EDGE_PAR_EVENTS / best[W])
+            print(f"# edge parallel tier workers={W}: "
+                  f"{workers_out[str(W)]:,} ev/s, matches={matches[W]}",
+                  file=sys.stderr)
+        r1 = workers_out["1"]
+        out["workers"] = workers_out
+        out["workers_speedup_2"] = round(workers_out["2"] / r1, 3) if r1 \
+            else 0.0
+        out["workers_speedup_4"] = round(workers_out["4"] / r1, 3) if r1 \
+            else 0.0
+        out["workers_parity_ok"] = matches[1] == matches[2] == matches[4]
+        out["workers_matches"] = matches[1]
+        out["thread_ceiling_2"] = round(_thread_ceiling(), 3)
+        out["workers_events"] = EDGE_PAR_EVENTS
+        out["workers_note"] = (
+            "2-cpu container: the lane-sharded step only beats sequential "
+            "when per-lane grids are large (measured 1.5x at "
+            "batch=131072, where absolute rate is lower); at the optimal "
+            "batch the step is small-op/GIL-bound and threads wash out — "
+            "the >=2x target needs >=4 real cores")
+        print(f"# edge parallel: 2w={out['workers_speedup_2']}x "
+              f"4w={out['workers_speedup_4']}x (container 2-thread numpy "
+              f"ceiling {out['thread_ceiling_2']}x over {out['cpus']} "
+              f"cpus), parity_ok={out['workers_parity_ok']}",
+              file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — rule line already secured
+        out["workers_error"] = str(e)
+        print(f"# edge parallel tier failed: {e}", file=sys.stderr)
+    print(json.dumps(out))
+
+
 def _tenant_rule_app(i: int, ann: str) -> str:
     """Tenant i's alert rule: the multi-tenant serving template — same shape
     for every tenant, per-tenant constants (threshold / device / scale)."""
@@ -834,7 +1080,17 @@ end;
 
 def _run_tenant_fleet(make_tenant, ann, n_feed: int, chunk: int,
                       tenants: int):
-    """K tenant apps over the shared feed, per-tenant chunk deliveries.
+    """K tenant apps over the shared feed, per-tenant chunk deliveries
+    through the zero-wrap rows ingress (``send_rows`` → ``deliver_rows``
+    → fleet stagers: no per-event StreamEvent wrapping on the fleet/solo
+    columnar tiers; the scalar control run gets per-tenant row copies —
+    interpreter events alias row lists, so sharing would be unsafe there).
+    The columnar ``send_columns`` ingress also works here (pinned by
+    tests/test_edge_rows.py) but measures ~1.7x SLOWER at this chunk size:
+    16-row numpy chunks pay fixed per-chunk array overhead that plain list
+    staging doesn't — columns win from ~hundreds of rows per chunk, which
+    is the columnar SOURCE regime (see the edge line), not the
+    multiplexed-tenant regime this scenario models.
     Returns (aggregate ev/s, per-tenant match counts, compiles, steps)."""
     from siddhi_tpu import SiddhiManager, StreamCallback
 
@@ -1074,7 +1330,8 @@ def _run_child(mode: str, deadline_s: float, env=None, label=None,
     return None, f"{label}: no JSON in output"
 
 
-def run_device_phases(notes: list, smoke_ok: bool) -> tuple:
+def run_device_phases(notes: list, smoke_ok: bool,
+                      skip_reason_override: str = None) -> tuple:
     """Sequence the device phases, each in its own subprocess under its own
     deadline (clamped to the remaining budget). Returns (merged device dict
     or None, per-phase status dict). Guarantees:
@@ -1102,7 +1359,8 @@ def run_device_phases(notes: list, smoke_ok: bool) -> tuple:
             "JAX_COMPILATION_CACHE_DIR": cache_dir,
             "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
         }
-    skip_reason = None if smoke_ok else "smoke failed"
+    skip_reason = None if smoke_ok \
+        else (skip_reason_override or "smoke failed")
     for ph, deadline in PHASE_DEADLINES:
         if skip_reason is not None:
             phases[ph] = {"status": f"skipped ({skip_reason})"}
@@ -1153,6 +1411,38 @@ def main() -> None:
     #     the shared-compilation / cross-app-lane numbers before any device
     #     attempt can burn budget (BENCH_SKIP_FLEET=1 for device-focused
     #     runs and the bench-robustness tests)
+    # 1a) zero-object edge line: bytes-in → rows-out through the columnar
+    #     source/sink path + the parallel host tier (CPU-only, like the
+    #     host child; BENCH_SKIP_EDGE=1 for device-focused runs)
+    edge = None
+    if os.environ.get("BENCH_SKIP_EDGE", "") != "1":
+        edge, eerr = _run_child("--edge-child",
+                                min(EDGE_DEADLINE_S, _remaining() * 0.25),
+                                env={"JAX_PLATFORMS": "cpu",
+                                     "PALLAS_AXON_POOL_IPS": ""})
+        if edge is None:
+            notes.append(f"edge line failed: {eerr}")
+        else:
+            if edge.get("objects_per_row", 1) != 0:
+                notes.append(
+                    f"EDGE OBJECT LEAK: {edge.get('objects_per_row')} "
+                    f"Event/StreamEvent constructions per row on the rows "
+                    f"path (expected 0)")
+            if (edge.get("rows_per_s") or 0) < 1_000_000:
+                notes.append(
+                    f"edge rows/s {edge.get('rows_per_s'):,} below the "
+                    f"1M rows/s target on this container")
+            if not edge.get("workers_parity_ok", True):
+                notes.append("EDGE WORKERS PARITY MISMATCH: match counts "
+                             "diverged across worker counts")
+            if (edge.get("workers_speedup_4") or 0) < 2.0:
+                notes.append(
+                    f"edge workers=4 speedup "
+                    f"{edge.get('workers_speedup_4')}x below the 2x target "
+                    f"(container numpy 2-thread ceiling "
+                    f"{edge.get('thread_ceiling_2')}x on "
+                    f"{edge.get('cpus')} cpus)")
+
     fleet = None
     if os.environ.get("BENCH_SKIP_FLEET", "") != "1":
         fleet, ferr = _run_child("--fleet-child",
@@ -1181,7 +1471,23 @@ def main() -> None:
     #    budget), then compile → throughput → latency → oracle each run in
     #    their own subprocess under their own deadline. A wedge costs one
     #    phase (plus skipping the rest), never the parent's JSON line.
-    device, device_phases = run_device_phases(notes, smoke is not None)
+    #    A smoke that lands on the CPU backend means no accelerator exists
+    #    in this container: running the device phases there would burn the
+    #    budget producing platform=cpu numbers that read as device
+    #    evidence (and would feed the latency guard garbage) — skip, and
+    #    say so (BENCH_FORCE_DEVICE=1 overrides for debugging).
+    smoke_ok = smoke is not None
+    skip_reason = None
+    force = os.environ.get("BENCH_FORCE_DEVICE", "") == "1" \
+        or os.environ.get("BENCH_PHASE_KILL") \
+        or os.environ.get("BENCH_PHASE_WEDGE")   # phase-machinery test
+    # hooks exercise the sequencer itself — they must run on any backend
+    if smoke_ok and smoke.get("platform") == "cpu" and not force:
+        smoke_ok = False
+        skip_reason = "no accelerator (smoke platform=cpu)"
+        notes.append("device phases skipped: smoke landed on the CPU "
+                     "backend (no accelerator in this container)")
+    device, device_phases = run_device_phases(notes, smoke_ok, skip_reason)
 
     metric = f"{N_STATES}-state partitioned pattern throughput"
     smoke_field = smoke if smoke else {"ok": False, "error": serr}
@@ -1305,6 +1611,8 @@ def main() -> None:
             out["device_partial"] = device
     if fleet:
         out["fleet"] = fleet
+    if edge:
+        out["edge"] = edge
     out["device_phases"] = device_phases
     out["smoke"] = smoke_field
     if BENCH_METRICS and host and host.get("metrics"):
@@ -1323,5 +1631,7 @@ if __name__ == "__main__":
         child_host()
     elif len(sys.argv) > 1 and sys.argv[1] == "--fleet-child":
         child_fleet()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--edge-child":
+        child_edge()
     else:
         main()

@@ -1,0 +1,52 @@
+"""The zero-object edge: a file source parsing CSV straight into columns,
+a columnar query, and a rows-capable sink publishing whole chunks.
+
+No ``Event``/``StreamEvent`` objects exist anywhere on this path — raw
+bytes → numpy columns (native C++ parse when a toolchain exists) →
+SoA micro-batch → columnar step → chunk publish. Compare
+``simple_filter.py``, the per-event version of the same query."""
+
+import os
+import tempfile
+
+import _common  # noqa: F401
+
+from siddhi_tpu import InMemoryBroker, SiddhiManager
+
+# transport payload: CSV lines with a trailing event-time field
+csv_path = os.path.join(tempfile.mkdtemp(), "ticks.csv")
+with open(csv_path, "w") as f:
+    for i, (sym, price, vol) in enumerate([
+            ("WSO2", 55.6, 100), ("IBM", 40.0, 50), ("GOOG", 120.0, 30),
+            ("WSO2", 57.1, 20), ("IBM", 75.0, 10)]):
+        f.write(f"{sym},{price},{vol},{1000 + i * 100}\n")
+
+APP = f"""
+@app:host_batch(batch='4096')
+@source(type='file', file='{csv_path}', @map(type='csv', ts.last='true'))
+define stream StockStream (symbol string, price double, volume long);
+
+@sink(type='inMemory', topic='high-price', @map(type='passThrough'))
+define stream HighPriceStream (symbol string, price double);
+
+@info(name = 'filterQuery')
+from StockStream[price > 50.0]
+select symbol, price
+insert into HighPriceStream;
+"""
+
+
+def on_chunk(chunk):
+    # a RowsChunk: columns in, columns out — decode only at the very edge
+    for row in chunk.rows(["symbol", "price"]):
+        print(f"  high price: {row}")
+
+
+InMemoryBroker.subscribe("high-price", on_chunk)
+manager = SiddhiManager()
+runtime = manager.create_siddhi_app_runtime(APP, playback=True)
+runtime.start()
+runtime.sources[0].wait_drained(10.0)
+runtime.flush_host()
+manager.shutdown()
+InMemoryBroker.reset()

@@ -14,6 +14,10 @@ the columnar micro-batch engine) on a reduced corpus and fails when
    same-machine, so it is robust to container speed differences — absolute
    ev/s numbers are NOT comparable across machines and are only reported).
 
+An ``edge`` guard (``run_edge_guard``) pins the zero-object edge line of
+the newest BENCH_r*.json against ``edge_baseline`` (rows/s floor,
+objects-per-row == 0, worker parity + speedup floor).
+
 A ``device_latency`` guard (``run_device_latency_guard``) additionally pins
 the double-buffered pipeline's recorded evidence: when a bench report with a
 ``latency_mode`` line exists, its p99 must stay under
@@ -267,6 +271,14 @@ def run_device_latency_guard(tol: float) -> int:
         return 0
     skip = {"device_guard": "skipped", "report": os.path.basename(path),
             "phases": data.get("device_phases")}
+    platform = data.get("platform") or \
+        (data.get("device_partial") or {}).get("platform")
+    if platform == "cpu":
+        # a CPU-container round is not device evidence: its latencies say
+        # nothing about the pipeline the ceiling was recorded against
+        skip["reason"] = "report platform is cpu (no accelerator round)"
+        print(json.dumps(skip))
+        return 0
     lm = data.get("latency_mode") or (data.get("device_partial")
                                       or {}).get("latency_mode")
     if lm is None:
@@ -311,15 +323,88 @@ def run_device_latency_guard(tol: float) -> int:
     return 1 if failures else 0
 
 
+def run_edge_guard(tol: float) -> int:
+    """Zero-object edge guard vs BASELINE.json ``edge_baseline``: when the
+    newest bench report carries an ``edge`` line, enforce
+
+    1. ZERO Event/StreamEvent constructions per row on the rows path (the
+       zero-object invariant is binary — no tolerance band);
+    2. rows/s above the stored floor scaled by ``tol`` (absolute, like the
+       device p99 ceiling — same-machine across CI runs);
+    3. worker-count parity intact, and the workers speedup above the
+       stored floor (the STORED value reflects this container's measured
+       thread ceiling, recorded alongside in the report — not the 2x
+       aspiration, which needs ≥4 real cores).
+
+    Reports without an edge line (device-focused runs, pre-PR 11 rounds)
+    are tolerated with a note."""
+    with open(os.path.join(REPO, "BASELINE.json")) as f:
+        baseline = json.load(f).get("edge_baseline") or {}
+    if not baseline:
+        print(json.dumps({"edge_guard": "skipped",
+                          "reason": "no edge_baseline in BASELINE.json"}))
+        return 0
+    rows_floor = tol * float(baseline.get("rows_per_s_min", 1_000_000))
+    speed_floor = tol * float(baseline.get("workers_speedup_min", 1.0))
+
+    path, data, note = _latest_device_report()
+    if data is None:
+        print(json.dumps({"edge_guard": "skipped", "reason": note}))
+        return 0
+    edge = data.get("edge")
+    if edge is None:
+        print(json.dumps({"edge_guard": "skipped",
+                          "report": os.path.basename(path),
+                          "reason": "no edge line in the report"}))
+        return 0
+
+    failures = []
+    if edge.get("objects_per_row", 1) != 0:
+        failures.append(
+            f"rows path leaked objects: {edge.get('objects_per_row')} "
+            f"Event/StreamEvent constructions per row (expected 0)")
+    rows = edge.get("rows_per_s") or 0
+    if rows < rows_floor:
+        failures.append(
+            f"edge rows/s {rows:,} below the floor {rows_floor:,.0f} "
+            f"({tol} x stored {baseline.get('rows_per_s_min'):,})")
+    if not edge.get("workers_parity_ok", True):
+        failures.append("parallel host tier parity broke: match counts "
+                        "diverged across worker counts")
+    speed = max(edge.get("workers_speedup_2") or 0.0,
+                edge.get("workers_speedup_4") or 0.0)
+    if speed < speed_floor:
+        failures.append(
+            f"parallel tier speedup {speed:.2f}x below the floor "
+            f"{speed_floor:.2f}x ({tol} x stored "
+            f"{baseline.get('workers_speedup_min')})")
+
+    print(json.dumps({
+        "report": os.path.basename(path),
+        "rows_per_s": rows,
+        "rows_floor": rows_floor,
+        "objects_per_row": edge.get("objects_per_row"),
+        "workers_speedup": speed,
+        "speedup_floor": speed_floor,
+        "workers_parity_ok": edge.get("workers_parity_ok"),
+        "ingress": edge.get("ingress"),
+        "ok": not failures,
+    }))
+    for f_ in failures:
+        print(f"GUARD REGRESSION (edge): {f_}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def main() -> int:
     events = int(os.environ.get("BENCH_GUARD_EVENTS", 60000))
     tol = float(os.environ.get("BENCH_GUARD_TOL", 0.5))
     rc = run_guard(events, tol)
     drc = run_device_latency_guard(tol)
+    erc = run_edge_guard(tol)
     if os.environ.get("BENCH_GUARD_SKIP_FLEET", "") == "1":
-        return rc or drc
+        return rc or drc or erc
     frc = run_fleet_guard(tol)
-    return rc or frc or drc
+    return rc or frc or drc or erc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Rows-path lint: no Event/StreamEvent construction on the zero-object edge.
+
+The columnar edge contract (ISSUE 11): a rows-capable source → junction →
+sink pipeline moves whole numpy chunks and must never materialize per-event
+Python objects on its HOT path — ``Event``/``StreamEvent`` constructions
+are allowed only in the explicit fallback/fault helpers. Modeled on
+``check_span_coverage.py``: structural source checks per hop plus one
+end-to-end run that counts actual constructions.
+
+Checked hops (static, ``inspect.getsource`` + construction regex):
+
+1. **bulk ingress** — ``InputHandler.send_columns``/``_send_columns`` and
+   ``StreamJunction.deliver_columns`` (fallbacks live in
+   ``_send_columns_fallback`` / ``_columns_fault_events``);
+2. **parse** — ``CsvColumnParser.parse`` paths and ``LineSource.feed``;
+3. **staging** — ``HostRowStager.append_columns`` / ``_emit_columns`` and
+   the host-bridge ``receive_columns`` receivers;
+4. **egress** — ``HostQueryBridge._deliver_columns_out``,
+   ``Sink.on_columns``, the rows sink mappers/receivers, and the
+   ``ResilientSink`` chunk pipeline's happy path (``_publish_columns`` —
+   per-event replay lives in ``_replay_rows``);
+5. **transport** — ``unpack_columns`` (DCN SoA wire → columns) and the
+   in-memory broker publish.
+
+End-to-end: an armed run (instrumented constructors) pushes a CSV corpus
+through parse → send_columns → columnar query → rows sink and asserts ZERO
+constructions. Exits non-zero on any gap; run from tier-1
+(tests/test_edge_rows.py).
+"""
+
+import inspect
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+failures = []
+_CONSTRUCT = re.compile(r"\b(StreamEvent|Event|PatternEvent|JoinedEvent)\(")
+
+
+def check(name, cond, detail=""):
+    if cond:
+        print(f"OK   {name}")
+    else:
+        failures.append(name)
+        print(f"FAIL {name} {detail}")
+
+
+def clean(obj) -> bool:
+    """True when the function/class source constructs no engine events."""
+    return not _CONSTRUCT.search(inspect.getsource(obj))
+
+
+def main() -> int:
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core import columns as C
+    from siddhi_tpu.core.host_bridge import HostQueryBridge
+    from siddhi_tpu.core.io import (
+        CsvSourceMapper,
+        InMemoryBroker,
+        InMemorySink,
+        LineSource,
+        PassThroughSinkMapper,
+        RowsSinkReceiver,
+        Sink,
+    )
+    from siddhi_tpu.core.stream import InputHandler, StreamJunction
+    from siddhi_tpu.resilience.sink_pipeline import ResilientSink
+    from siddhi_tpu.tpu.host_exec import HostRowStager
+
+    # 1) bulk ingress
+    check("send_columns hot path builds no events",
+          clean(InputHandler.send_columns)
+          and clean(InputHandler._send_columns))
+    check("deliver_columns hot path builds no events",
+          clean(StreamJunction.deliver_columns))
+    check("ingress fallbacks are explicit separate helpers",
+          hasattr(InputHandler, "_send_columns_fallback")
+          and hasattr(StreamJunction, "_columns_fault_events"))
+
+    # 2) parse
+    check("CSV column parser builds no events",
+          clean(C.CsvColumnParser) and clean(CsvSourceMapper.map_rows))
+    check("line source framing builds no events",
+          clean(LineSource.feed) and clean(LineSource._dispatch))
+
+    # 3) staging
+    check("stager columnar staging/emit builds no events",
+          clean(HostRowStager.append_columns)
+          and clean(HostRowStager._emit_columns)
+          and clean(HostRowStager._convert_column))
+    check("host bridge receivers build no events",
+          clean(HostQueryBridge.receiver_for))
+
+    # 4) egress
+    check("columnar query egress builds no events",
+          clean(HostQueryBridge._deliver_columns_out)
+          and clean(C.ColumnsOut.decoded))
+    check("rows sink surface builds no events",
+          clean(Sink.on_columns) and clean(InMemorySink.publish_rows)
+          and clean(PassThroughSinkMapper.map_rows)
+          and clean(RowsSinkReceiver.receive_columns))
+    check("resilient sink chunk pipeline happy path builds no events",
+          clean(ResilientSink._publish_columns)
+          and clean(ResilientSink._attempt_columns))
+    check("resilient sink per-event replay is the explicit fallback",
+          hasattr(ResilientSink, "_replay_rows"))
+
+    # 5) transport
+    check("DCN SoA wire decode builds no events", clean(C.unpack_columns))
+    check("in-memory broker publish builds no events",
+          clean(InMemoryBroker.publish))
+
+    # end-to-end: armed constructors over a real edge pipeline
+    from siddhi_tpu.core.event import Event, StreamEvent
+    counts = {"n": 0}
+    se_init, ev_init = StreamEvent.__init__, Event.__init__
+
+    def _se(self, *a, **k):
+        counts["n"] += 1
+        se_init(self, *a, **k)
+
+    def _ev(self, *a, **k):
+        counts["n"] += 1
+        ev_init(self, *a, **k)
+
+    m = SiddhiManager()
+    got = {"rows": 0}
+    try:
+        rt = m.create_siddhi_app_runtime(
+            "@app(name='lint-rows')\n"
+            "@app:host_batch(batch='4096')\n"
+            "define stream S (dev string, v double);\n"
+            "@sink(type='inMemory', topic='lint-rows-out', "
+            "@map(type='passThrough'))\n"
+            "define stream Alerts (dev string, v double);\n"
+            "from S[v > 50.0] select dev, v insert into Alerts;",
+            playback=True)
+
+        def on_pub(payload):
+            got["rows"] += getattr(payload, "count", 1)
+
+        unsub = InMemoryBroker.subscribe("lint-rows-out", on_pub)
+        rt.start()
+        defn = rt.ctx.stream_junctions["S"].definition
+        parser = C.CsvColumnParser(defn, ts_last=True)
+        payload = "".join(
+            f"d{i % 7},{float(i % 100)},{1000 + i}\n"
+            for i in range(2000)).encode()
+        ih = rt.input_handler("S")
+        StreamEvent.__init__, Event.__init__ = _se, _ev
+        try:
+            for ch in parser.parse(payload):
+                ih.send_columns(ch.cols, ch.ts, ch.count)
+            rt.flush_host()
+        finally:
+            StreamEvent.__init__, Event.__init__ = se_init, ev_init
+        unsub()
+        check("end-to-end edge run built ZERO events",
+              counts["n"] == 0, f"(saw {counts['n']} constructions)")
+        check("end-to-end edge run produced sink rows",
+              got["rows"] > 0, f"(rows={got['rows']})")
+    finally:
+        StreamEvent.__init__, Event.__init__ = se_init, ev_init
+        m.shutdown()
+
+    if failures:
+        print(f"\n{len(failures)} rows-path gap(s)", file=sys.stderr)
+        return 1
+    print("\nrows path OK: parse, ingress, staging, egress and transport "
+          "hops build zero per-event objects")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

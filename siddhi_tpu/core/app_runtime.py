@@ -9,6 +9,7 @@ partitions).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Optional
 
 from ..compiler import parse_on_demand_query
@@ -411,6 +412,23 @@ class SiddhiAppRuntime:
         # resilience gauges: per-receiver fault counts, sink circuits, device
         # quarantine state (sink_retries / sink_dropped register themselves
         # as counters at wrap time)
+        # edge-path gauges: transport bytes and parsed rows per source (the
+        # rows/s reading is the zero-object ingress evidence surface)
+        for src in self.sources:
+            sid = getattr(getattr(src, "definition", None), "id", None)
+            if sid is None:     # exotic Source subclass skipping init()
+                continue
+            if hasattr(src, "bytes_in"):
+                sm.gauge_tracker(f"stream.{sid}.source_bytes_in",
+                                 lambda s=src: s.bytes_in)
+            mp = getattr(src, "mapper", None)
+            if mp is not None and hasattr(mp, "rows_out"):
+                sm.gauge_tracker(f"stream.{sid}.source_rows_out",
+                                 lambda m=mp: m.rows_out)
+                sm.gauge_tracker(f"stream.{sid}.source_rows_per_s",
+                                 lambda m=mp: m.rows_per_s)
+                sm.gauge_tracker(f"stream.{sid}.source_parse_errors",
+                                 lambda m=mp: m.parse_errors)
         for sid, j in self.ctx.stream_junctions.items():
             sm.gauge_tracker(f"stream.{sid}.receiver_errors",
                              lambda jj=j: jj.receiver_errors)
@@ -489,7 +507,7 @@ class SiddhiAppRuntime:
                     raise SiddhiAppCreationError(
                         f"unknown source mapper type '{s['map']}'")
                 mapper = self._with_config(mapper_cls(), "sourceMapper", s["map"])
-                mapper.init(sd, s["options"])
+                mapper.init(sd, {**s["options"], **s.get("map_options", {})})
                 src = self._with_config(cls(), "source", s["type"])
                 handler = self._make_source_handler(sd.id, mapper, s["type"])
                 src.init(sd, s["options"], mapper, handler)
@@ -526,7 +544,7 @@ class SiddhiAppRuntime:
                     for dest_opts in dist["destinations"]:
                         mapper = self._with_config(
                             mapper_cls(), "sinkMapper", s["map"])
-                        mapper.init(sd, s["options"])
+                        mapper.init(sd, {**s["options"], **s.get("map_options", {})})
                         sub = self._with_config(cls(), "sink", s["type"])
                         merged = {**s["options"], **dest_opts}
                         sub.init(sd, merged, mapper)
@@ -550,7 +568,7 @@ class SiddhiAppRuntime:
                 else:
                     mapper = self._with_config(
                         mapper_cls(), "sinkMapper", s["map"])
-                    mapper.init(sd, s["options"])
+                    mapper.init(sd, {**s["options"], **s.get("map_options", {})})
                     sink = self._with_config(cls(), "sink", s["type"])
                     sink.init(sd, s["options"], mapper)
                     # the publish pipeline (on.error policy + circuit
@@ -567,10 +585,17 @@ class SiddhiAppRuntime:
                     self._io_handlers.append(("sink", sh.id))
                     cb = StreamCallback(lambda events, h=sh: [
                         h.handle(e) for e in events])
+                    self.add_callback(sd.id, cb)
                 else:
-                    cb = StreamCallback(lambda events, sk=sink: [
-                        sk.on_event(e) for e in events])
-                self.add_callback(sd.id, cb)
+                    # direct sink subscription: rows-capable sinks (mapper
+                    # map_rows + sink publish_rows) accept whole columnar
+                    # chunks — the zero-object egress; everything else
+                    # keeps the per-event Event path
+                    from .io import RowsSinkReceiver, SinkReceiver
+                    recv = RowsSinkReceiver(sink) \
+                        if getattr(sink, "rows_capable", False) \
+                        else SinkReceiver(sink)
+                    self._get_junction(sd.id).subscribe(recv)
 
     def _make_source_handler(self, stream_id: str, mapper, source_type: str):
         mgr = self.ctx.siddhi_context.source_handler_manager
@@ -583,8 +608,44 @@ class SiddhiAppRuntime:
             mgr.register_source_handler(sh.id, sh)
             self._io_handlers.append(("source", sh.id))
 
+        sm = self.ctx.statistics_manager
+        parse_tracker = sm.latency_tracker(
+            f"source.{stream_id}.ingress_parse") if sm is not None else None
+        map_rows = getattr(mapper, "map_rows", None)
+
         def handler(payload):
+            from .columns import RowsChunk
             ih = self.input_handler(stream_id)
+            if isinstance(payload, RowsChunk):
+                if sh is None:
+                    # a columnar chunk forwards whole through the bulk
+                    # ingress instead of exploding into per-event sends
+                    # (in-memory broker rows path, socket rows frames)
+                    ih.send_columns(payload.cols, payload.ts, payload.count)
+                    return
+                # interception installed: the SourceHandler contract is
+                # per event — degrade the chunk to rows so a RowsChunk
+                # payload still flows instead of crashing the mapper
+                names = self.app.stream_definitions[stream_id] \
+                    .attribute_names
+                tss = payload.ts
+                for i, row in enumerate(payload.rows(names)):
+                    sh.send_event(
+                        Event(int(tss[i]), row) if tss is not None
+                        else row, ih)
+                return
+            if sh is None:
+                if callable(map_rows) and isinstance(
+                        payload, (bytes, bytearray, memoryview)):
+                    t0 = time.perf_counter()
+                    chunks = map_rows(payload)
+                    dt = time.perf_counter() - t0
+                    for ch in chunks:
+                        if parse_tracker is not None and ch.count:
+                            parse_tracker.record_seconds(
+                                dt / max(len(chunks), 1), ch.count)
+                        ih.send_columns(ch.cols, ch.ts, ch.count)
+                    return
             for row in mapper.map(payload):
                 if sh is not None:
                     sh.send_event(row, ih)
@@ -613,6 +674,18 @@ class SiddhiAppRuntime:
             raise KeyError(f"stream '{stream_id}' is not defined")
         self.ctx.stream_junctions[stream_id].subscribe(
             _StreamCallbackReceiver(callback))
+
+    def add_rows_callback(self, stream_id: str, fn) -> None:
+        """Columns-capable subscription: ``fn(cols, ts, n)`` receives whole
+        columnar chunks (zero per-event objects end to end when every other
+        subscriber of the stream is also columns-capable)."""
+        from .stream import RowsCallback
+        j = self.ctx.stream_junctions.get(stream_id)
+        if j is None:
+            raise KeyError(f"stream '{stream_id}' is not defined")
+        cb = RowsCallback(fn)
+        cb.names = j.definition.attribute_names
+        j.subscribe(cb)
 
     def remove_callback(self, callback: StreamCallback) -> None:
         """Detach a previously added stream callback (reference

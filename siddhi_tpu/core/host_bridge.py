@@ -37,6 +37,8 @@ import os
 import time
 from typing import Optional
 
+import numpy as np
+
 from ..query_api import (
     InsertIntoStream,
     OutputEventsFor,
@@ -60,7 +62,8 @@ def host_batch_config(app_annotations) -> Optional[dict]:
     ann = find_annotation(app_annotations, "host_batch")
     if ann is None and os.environ.get("SIDDHI_HOST_BATCH", "") != "1":
         return None
-    cfg = {"batch": _DEF_BATCH, "lanes": _DEF_LANES}
+    cfg = {"batch": _DEF_BATCH, "lanes": _DEF_LANES,
+           "workers": int(os.environ.get("SIDDHI_HOST_WORKERS", "1"))}
     if ann is not None:
         if ann.get("enable") and ann.get("enable").lower() == "false":
             return None
@@ -68,6 +71,10 @@ def host_batch_config(app_annotations) -> Optional[dict]:
             cfg["batch"] = int(ann.get("batch"))
         if ann.get("lanes"):
             cfg["lanes"] = int(ann.get("lanes"))
+        if ann.get("workers"):
+            # parallel columnar host tier: shard the partitioned-NFA lane
+            # space across N worker threads (exact per-lane parity kept)
+            cfg["workers"] = int(ann.get("workers"))
     return cfg
 
 
@@ -85,7 +92,7 @@ class _HostRTBase(AdaptiveFlushMixin):
 
     def deliver(self, out):
         fn = self.callback
-        if fn is not None and out and out[1]:
+        if fn is not None and out is not None and getattr(out, "n", 0):
             fn(out)
 
     def flush(self):
@@ -168,6 +175,13 @@ class HostQueryBridge:
                 rt.builder.append_rows(stream_id, rows, timestamps)
                 rt.flush()
 
+            def receive_columns(self, cols: dict, ts, n: int) -> None:
+                # zero-object delivery (StreamJunction.deliver_columns):
+                # the whole columnar chunk stages as-is — no per-row
+                # Python anywhere between transport bytes and the step
+                rt.builder.append_columns(stream_id, cols, ts)
+                rt.flush()
+
         return _R()
 
     def flush(self, cause: str = "drain") -> None:
@@ -181,7 +195,29 @@ class HostQueryBridge:
 
     # -- output ---------------------------------------------------------------
     def _on_out(self, out) -> None:
-        ts_list, rows = out
+        """``out`` is a :class:`~siddhi_tpu.core.columns.ColumnsOut`: the
+        zero-object egress hands decoded columns straight to a
+        columns-capable output junction (rows-capable sinks); everything
+        else falls back to per-event materialization."""
+        if out is None or not out.n:
+            return
+        oj = self.output_junction
+        if not self.query_callbacks:
+            if oj is None:
+                return
+            if oj.columns_capable():
+                self._deliver_columns_out(out, oj)
+                return
+        self._deliver_events_out(out, oj)
+
+    def _deliver_columns_out(self, out, oj) -> None:
+        # zero-object egress: dictionary codes decode to value columns (one
+        # vectorized take per string column), no Event/StreamEvent builds
+        oj.deliver_columns(out.decoded(), np.asarray(out.ts, dtype=np.int64),
+                           out.n)
+
+    def _deliver_events_out(self, out, oj) -> None:
+        ts_list, rows = out.ts_list(), out.rows()
         events = [StreamEvent(ts, row, EventType.CURRENT)
                   for ts, row in zip(ts_list, rows)]
         if not events:
@@ -190,8 +226,8 @@ class HostQueryBridge:
             evs = [Event(e.timestamp, e.data) for e in events]
             for cb in self.query_callbacks:
                 cb.receive(events[-1].timestamp, evs, None)
-        if self.output_junction is not None:
-            self.output_junction.send_events(events)
+        if oj is not None:
+            oj.send_events(events)
 
     def report(self) -> dict:
         return {"query": self.query_name, "engine": "columnar",
@@ -261,8 +297,10 @@ class _HostStreamRT(_HostRTBase):
         self.state = hq.init_state()
 
     def process(self, b):
+        from .columns import ColumnsOut
         self.state, res = self.hq.step(self.state, b["cols"], b["ts"])
-        return self.hq.decode(res)
+        return ColumnsOut(res["ts"], res["out"], int(res["ts"].shape[0]),
+                          self.hq.out_specs, self.compiled.schema.dictionaries)
 
     @staticmethod
     def _copy_state(v):
@@ -292,14 +330,14 @@ class _HostNFART(_HostRTBase):
         self.state = engine.init_state()
 
     def process(self, b):
-        from ..tpu.host_exec import decode_columns
+        from .columns import ColumnsOut
         self.state, outs = self.engine.step(
             self.state, b["cols"], b["tag"], b["ts"])
         if not outs or outs["j"].size == 0:
-            return [], []
-        rows = decode_columns(self.engine.out_specs, outs,
-                              self.compiler.merged.dictionaries)
-        return outs["ts"].tolist(), rows
+            return None
+        return ColumnsOut(outs["ts"], outs, int(outs["j"].size),
+                          self.engine.out_specs,
+                          self.compiler.merged.dictionaries)
 
     def snapshot_state(self):
         return self.engine.snapshot_state(self.state)
@@ -317,10 +355,17 @@ class _HostPartitionRT(_HostRTBase):
                                      used_cols=prt.compiler.used_cols)
 
     def process(self, b):
+        from .columns import ColumnsOut
         j, outs = self.prt.process(b)
         if not outs:
-            return [], []
-        return outs["ts"].tolist(), self.prt.decode(outs)
+            return None
+        return ColumnsOut(outs["ts"], outs, int(j.size),
+                          self.prt.engine.out_specs,
+                          self.prt.compiler.merged.dictionaries)
+
+    def finalize(self):
+        self.flush()
+        self.prt.close()            # release the workers thread pool
 
     def snapshot_state(self):
         return self.prt.snapshot_state()
@@ -484,7 +529,8 @@ def try_build_host_partition(partition_ast, app_context, stream_defs: dict,
                     "interpreter")
             prt = HostPartitionedNFA(q, stream_defs, key_attr,
                                      num_partitions=cfg.get(
-                                         "lanes", _DEF_LANES))
+                                         "lanes", _DEF_LANES),
+                                     workers=cfg.get("workers", 1))
             rt = _HostPartitionRT(prt, stream_defs,
                                   cfg.get("batch", _DEF_BATCH))
             bridge = HostQueryBridge(

@@ -13,6 +13,8 @@ import logging
 import time
 from typing import Callable, Optional
 
+import numpy as np
+
 from ..query_api.definition import AbstractDefinition
 from .event import Event, EventType, StreamEvent
 
@@ -161,6 +163,38 @@ class StreamJunction:
                 self.handle_error(
                     [StreamEvent(ts, list(row), EventType.CURRENT)
                      for row, ts in zip(rows, timestamps)], e)
+
+    def columns_capable(self) -> bool:
+        """True when every subscriber accepts whole columnar chunks — the
+        zero-object edge then hands numpy columns end to end (source →
+        junction → sink) with no per-event Python objects at all. Unlike
+        ``rows_capable`` an empty receiver list IS capable: the chunk is
+        counted and dropped, same as ``send_events`` to a bare junction."""
+        return self.dispatcher is None and self.flow is None and \
+            all(hasattr(r, "receive_columns") for r in self.receivers)
+
+    def deliver_columns(self, cols: dict, ts: np.ndarray, n: int) -> None:
+        """Zero-object chunk delivery to columns-capable receivers (see
+        ``columns_capable``). ``cols`` maps attribute name → numpy column;
+        receivers must not mutate them."""
+        self.throughput += n
+        newest = int(ts.max()) if n else 0
+        self.last_event_ts = newest if self.last_event_ts is None \
+            else max(self.last_event_ts, newest)
+        for r in self.receivers:
+            try:
+                r.receive_columns(cols, ts, n)
+            except Exception as e:  # noqa: BLE001 — per-receiver isolation,
+                # same contract as deliver_rows; fault routing sees the
+                # chunk as StreamEvents (failure path, built on demand)
+                self._record_receiver_error(r, e)
+                self.handle_error(self._columns_fault_events(cols, ts, n), e)
+
+    def _columns_fault_events(self, cols: dict, ts, n: int) -> list:
+        from .columns import columns_to_rows
+        rows = columns_to_rows(cols, self.definition.attribute_names, n)
+        return [StreamEvent(int(t), row, EventType.CURRENT)
+                for row, t in zip(rows, np.asarray(ts).tolist())]
 
     def deliver_events(self, events: list[StreamEvent]) -> None:
         self.throughput += len(events)
@@ -439,6 +473,79 @@ class InputHandler:
             self.app_context.advance_time(
                 max(ev.timestamp for ev in events))
 
+    def send_columns(self, cols: dict, timestamps=None,
+                     count: Optional[int] = None) -> None:
+        """Zero-object bulk ingress: one columnar chunk ({attribute name:
+        numpy array | DictColumn}, optional int64 per-row timestamps).
+
+        The preferred edge entry (columnar sources, the in-memory broker's
+        rows chunks): when every subscriber is columns-capable the chunk
+        reaches the SoA stagers with NO per-event Python objects at all;
+        otherwise it degrades to the ``send_rows`` semantics. ``timestamps``
+        None stamps the app's current time on every row."""
+        from .columns import column_length
+        n = count
+        if n is None:
+            n = int(len(timestamps)) if timestamps is not None else (
+                column_length(next(iter(cols.values()))) if cols else 0)
+        if n == 0:
+            return
+        names = self.junction.definition.attribute_names
+        missing = [a for a in names if a not in cols]
+        if missing:
+            from .errors import SiddhiAppRuntimeError
+            raise SiddhiAppRuntimeError(
+                f"stream '{self.stream_id}': send_columns missing "
+                f"column(s) {missing}")
+        for name in names:
+            if column_length(cols[name]) != n:
+                raise ValueError(
+                    f"send_columns: column '{name}' has "
+                    f"{column_length(cols[name])} values but the chunk has "
+                    f"{n} rows")
+        if timestamps is None:
+            ts = np.full(n, self.app_context.current_time(), dtype=np.int64)
+        else:
+            ts = np.asarray(timestamps, dtype=np.int64)
+            if ts.shape[0] != n:
+                raise ValueError(
+                    f"send_columns: {n} rows but {ts.shape[0]} timestamps")
+        tracer = self.app_context.tracer
+        if tracer is not None:
+            # chunk-level sampling, same policy as send_rows
+            tr = tracer.maybe_trace(self.stream_id)
+            if tr is not None:
+                t0 = time.perf_counter_ns()
+                tracer.push(tr)
+                try:
+                    self._send_columns(cols, ts, n)
+                finally:
+                    tracer.pop()
+                    tr.add_span("ingress", self.stream_id,
+                                time.perf_counter_ns() - t0, n)
+                return
+        self._send_columns(cols, ts, n)
+
+    def _send_columns(self, cols: dict, ts: np.ndarray, n: int) -> None:
+        j = self.junction
+        if self.flow is None and j.dispatcher is None and \
+                j.columns_capable():
+            with self.app_context.root_lock:
+                self.app_context.advance_time(int(ts.min()))
+                j.deliver_columns(cols, ts, n)
+                self.app_context.advance_time(int(ts.max()))
+            return
+        self._send_columns_fallback(cols, ts, n)
+
+    def _send_columns_fallback(self, cols: dict, ts: np.ndarray,
+                               n: int) -> None:
+        """Non-columnar subscribers (or WAL/@async ingress): materialize
+        rows once and take the ``send_rows`` path."""
+        from .columns import columns_to_rows
+        rows = columns_to_rows(cols, self.junction.definition.attribute_names,
+                               n)
+        self._send_rows(rows, ts.tolist())
+
     def _check_arity(self, data) -> None:
         defn = self.junction.definition
         if len(data) != len(defn.attributes):
@@ -480,6 +587,28 @@ class _StreamCallbackReceiver:
     def receive(self, event: StreamEvent) -> None:
         if event.type in (EventType.CURRENT, EventType.EXPIRED):
             self.callback.receive_stream_event(event)
+
+
+class RowsCallback:
+    """Columns-capable stream subscription: ``fn(cols, ts, n)`` receives
+    whole columnar chunks (zero per-event objects); per-event deliveries
+    degrade to one synthesized chunk call. Subscribe via
+    ``SiddhiAppRuntime.add_rows_callback``."""
+
+    def __init__(self, fn: Callable):
+        self._fn = fn
+
+    def receive_columns(self, cols: dict, ts, n: int) -> None:
+        self._fn(cols, ts, n)
+
+    def receive(self, event: StreamEvent) -> None:
+        if event.type is not EventType.CURRENT:
+            return
+        names = getattr(self, "names", None) or [
+            f"c{i}" for i in range(len(event.data))]
+        cols = {nm: np.asarray([v], dtype=object)
+                for nm, v in zip(names, event.data)}
+        self._fn(cols, np.asarray([event.timestamp], np.int64), 1)
 
 
 class QueryCallback:

@@ -131,6 +131,13 @@ class FleetStager(HostRowStager):
         self.append_rows(sid, rows, timestamps)
         self._mid.extend([mid] * len(rows))
 
+    def stage_columns(self, mid: int, sid: str, cols: dict, ts) -> None:
+        # _mid tracks arrival order for BOTH representations (ensure_rows
+        # preserves order), so the member-id column stays aligned
+        n = int(np.asarray(ts).shape[0])
+        self.append_columns(sid, cols, ts)
+        self._mid.extend([mid] * n)
+
     def emit(self) -> dict:
         b = super().emit()
         b["mid"] = np.asarray(self._mid, dtype=np.int64)
@@ -225,6 +232,9 @@ class FleetQueryBridge:
 
             def receive_rows(self, rows: list, timestamps) -> None:
                 group.stage_rows(member, gsid, rows, timestamps)
+
+            def receive_columns(self, cols: dict, ts, n: int) -> None:
+                group.stage_columns(member, gsid, cols, ts, n)
 
         return _R()
 
@@ -514,6 +524,39 @@ class FleetGroup:
                         timestamps = timestamps[:k]
                 self._register_trace(m)
                 self.stager.stage_rows(m.mid, gsid, rows, timestamps)
+                self._post_stage(m)
+        finally:
+            self._drain_guard(m)
+
+    def stage_columns(self, m: FleetMember, gsid: str, cols: dict, ts,
+                      n: int) -> None:
+        """Zero-object staging of one columnar chunk: quota/dict-cap
+        admission runs on the columns (``FleetGuard.admit_columns``), the
+        shared stager keeps the chunk whole. Only an ejected member's
+        chunks materialize rows (the solo tier replays per row), and the
+        guard's pre-step shadow materializes once per window."""
+        ts = np.asarray(ts, dtype=np.int64)
+        try:
+            with self._lock:
+                g = self.guard
+                if g is not None:
+                    if m.ejected:
+                        self._register_trace(m)
+                        from ..core.columns import columns_to_rows
+                        d = self.stream_defs_for(gsid)
+                        g.solo_stage(m, gsid,
+                                     columns_to_rows(
+                                         cols, d.attribute_names, n),
+                                     ts.tolist())
+                        return
+                    k = g.admit_columns(m, gsid, cols, n)
+                    if k == 0:
+                        return
+                    if k < n:
+                        cols = {kk: v[:k] for kk, v in cols.items()}
+                        ts = ts[:k]
+                self._register_trace(m)
+                self.stager.stage_columns(m.mid, gsid, cols, ts)
                 self._post_stage(m)
         finally:
             self._drain_guard(m)

@@ -94,17 +94,27 @@ def _load():
             ctypes.c_void_p, ctypes.c_int32,
             ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p]
+        try:
+            # wide emit ('d' columns stay float64 — the host tier's f64
+            # policy); absent only on a stale pre-wide .so
+            lib.sp_emit_lane_wide.restype = ctypes.c_int64
+            lib.sp_emit_lane_wide.argtypes = lib.sp_emit_lane.argtypes
+        except AttributeError:          # pragma: no cover
+            pass
         _lib = lib
         NATIVE_AVAILABLE = True
         return lib
 
 
 # 'd' emits as float32: parse keeps full double precision in the staging
-# cells, but emit narrows to the device policy float (tpu/dtypes.py)
+# cells, but emit narrows to the device policy float (tpu/dtypes.py).
+# The WIDE emit (emit_lane(wide=True)) keeps 'd' as float64 for the
+# host/columnar edge, where the policy is interpreter-exact f64.
 _TYPE_NP = {
     "f": np.float32, "d": np.float32, "i": np.int32, "l": np.int64,
     "b": np.uint8, "s": np.int32,
 }
+_TYPE_NP_WIDE = dict(_TYPE_NP, d=np.float64)
 
 
 class NativeIngress:
@@ -190,19 +200,23 @@ class NativeIngress:
         return self._lib.sp_parse_errors(self._h)
 
     # -- emit --------------------------------------------------------------
-    def emit_lane(self, lane: int) -> dict:
+    def emit_lane(self, lane: int, wide: bool = False) -> dict:
         """Drains one lane into fresh numpy arrays padded to capacity.
 
         Returns {'cols': [np array per payload column], 'ts', 'tag', 'valid',
-        'count'} — same contract as tpu/batch.py builders."""
+        'count'} — same contract as tpu/batch.py builders. ``wide=True``
+        keeps 'd' columns as float64 (host/columnar edge policy) via
+        ``sp_emit_lane_wide``."""
         cap = self.capacity
-        cols = [np.zeros(cap, dtype=_TYPE_NP[t]) for t in self.types]
+        fn = self._lib.sp_emit_lane_wide if wide else self._lib.sp_emit_lane
+        dts = _TYPE_NP_WIDE if wide else _TYPE_NP
+        cols = [np.zeros(cap, dtype=dts[t]) for t in self.types]
         ts = np.zeros(cap, dtype=np.int64)
         tag = np.zeros(cap, dtype=np.int32)
         valid = np.zeros(cap, dtype=np.uint8)
         ptrs = (ctypes.c_void_p * len(cols))(
             *[c.ctypes.data_as(ctypes.c_void_p).value for c in cols])
-        n = self._lib.sp_emit_lane(
+        n = fn(
             self._h, lane, ptrs,
             ts.ctypes.data_as(ctypes.c_void_p),
             tag.ctypes.data_as(ctypes.c_void_p),

@@ -259,9 +259,13 @@ int64_t sp_ingest_csv(void* h, const char* buf, int64_t len, int64_t base_ts,
 // Copy lane `lane` into caller-provided buffers (numpy arrays of the schema
 // dtypes, each of length >= capacity), padded; resets the lane. Returns row
 // count. col_ptrs[c] points at the destination array for payload column c.
-int64_t sp_emit_lane(void* h, int32_t lane_idx, void** col_ptrs, int64_t* ts_out,
-                     int32_t* tag_out, uint8_t* valid_out) {
-    Ingress* g = (Ingress*)h;
+// `wide` != 0 emits 'd' columns as full float64 (the host/columnar tier's
+// f64 policy — interpreter-exact edge parity); wide == 0 narrows 'd' to
+// float32 (the device dtype policy, tpu/dtypes.py — packing f64 for the
+// device would only add a second conversion copy on the Python side).
+static int64_t emit_lane_impl(Ingress* g, int32_t lane_idx, void** col_ptrs,
+                              int64_t* ts_out, int32_t* tag_out,
+                              uint8_t* valid_out, int wide) {
     Lane& lane = g->lanes[lane_idx];
     const int64_t n = lane.n;
     const int ncols = (int)g->types.size();
@@ -269,11 +273,12 @@ int64_t sp_emit_lane(void* h, int32_t lane_idx, void** col_ptrs, int64_t* ts_out
         char t = g->types[c];
         const std::vector<Cell>& src = lane.cols[c];
         switch (t) {
-            // 'd' narrows to float32 at emit: the device dtype policy
-            // (tpu/dtypes.py) carries DOUBLE as f32, so packing f64 here
-            // would only add a second conversion copy on the Python side
-            case 'd': { float* p = (float*)col_ptrs[c];
-                for (int64_t i = 0; i < n; i++) p[i] = (float)src[i].d; break; }
+            case 'd':
+                if (wide) { double* p = (double*)col_ptrs[c];
+                    for (int64_t i = 0; i < n; i++) p[i] = src[i].d; }
+                else { float* p = (float*)col_ptrs[c];
+                    for (int64_t i = 0; i < n; i++) p[i] = (float)src[i].d; }
+                break;
             case 'f': { float* p = (float*)col_ptrs[c];
                 for (int64_t i = 0; i < n; i++) p[i] = src[i].f; break; }
             case 'l': { int64_t* p = (int64_t*)col_ptrs[c];
@@ -295,6 +300,20 @@ int64_t sp_emit_lane(void* h, int32_t lane_idx, void** col_ptrs, int64_t* ts_out
     lane.tag.clear();
     lane.n = 0;
     return n;
+}
+
+int64_t sp_emit_lane(void* h, int32_t lane_idx, void** col_ptrs, int64_t* ts_out,
+                     int32_t* tag_out, uint8_t* valid_out) {
+    return emit_lane_impl((Ingress*)h, lane_idx, col_ptrs, ts_out, tag_out,
+                          valid_out, 0);
+}
+
+// Wide emit for the host/columnar edge: 'd' columns keep float64.
+int64_t sp_emit_lane_wide(void* h, int32_t lane_idx, void** col_ptrs,
+                          int64_t* ts_out, int32_t* tag_out,
+                          uint8_t* valid_out) {
+    return emit_lane_impl((Ingress*)h, lane_idx, col_ptrs, ts_out, tag_out,
+                          valid_out, 1);
 }
 
 }  // extern "C"

@@ -13,6 +13,8 @@ where a tail came from.
 Phases (one vocabulary for span classification, the ``phase.*`` latency
 trackers, and the bench ``latency_breakdown`` line):
 
+- ``ingress_parse`` — transport bytes → columns at the edge (CSV/SoA
+  parse + dictionary encode in a columnar source, PR 11);
 - ``ingress_queue`` — waiting in an @async junction buffer or the device
   driver's staged/in-flight ring;
 - ``fill_wait``     — waiting for a micro-batch window to fill (recorded
@@ -31,12 +33,14 @@ from __future__ import annotations
 
 from typing import Optional
 
-PHASES = ("ingress_queue", "fill_wait", "pack", "device_step",
-          "egress_fence", "host_exec", "sink_publish", "dcn_transit")
+PHASES = ("ingress_parse", "ingress_queue", "fill_wait", "pack",
+          "device_step", "egress_fence", "host_exec", "sink_publish",
+          "dcn_transit")
 
 # span stage → phase (unknown stages are host work by default: every
 # host-side processor span nests inside the query chain)
 _STAGE_PHASE = {
+    "parse": "ingress_parse",
     "queue": "ingress_queue",
     "fill-wait": "fill_wait",
     "pack": "pack",
@@ -80,12 +84,14 @@ class PhaseBreakdown:
                      pack_s: float = 0.0, queue_s: float = 0.0,
                      step_s: float = 0.0, fence_s: float = 0.0,
                      publish_s: float = 0.0, host_s: float = 0.0,
+                     parse_s: float = 0.0,
                      cause: Optional[str] = None,
                      exemplar=None) -> None:
         if n <= 0:
             return
         fill_avg = max(0.0, fill_span_s) / 2.0
-        segs = (("fill_wait", fill_avg), ("pack", pack_s),
+        segs = (("ingress_parse", parse_s), ("fill_wait", fill_avg),
+                ("pack", pack_s),
                 ("ingress_queue", queue_s), ("device_step", step_s),
                 ("egress_fence", fence_s), ("sink_publish", publish_s),
                 ("host_exec", host_s))

@@ -205,6 +205,7 @@ class FleetGuard:
         self.bisect_runs = 0        # subset replays during containment
         self._site = f"fleet:{group.shape_key}"
         self._shadow = None         # raw (si,row),ts,mid of the emitted batch
+        self._shadow_lazy = None    # columnar capture (chunks, mid, stager)
         self._faulted: set[int] = set()   # chaos-faulted mids, current step
         # scalar replays DEFERRED out of the group lock: executing them
         # inline would acquire the culprit app's root_lock while holding
@@ -254,7 +255,36 @@ class FleetGuard:
         lane = self.lanes.get(member.mid)
         if lane is None:
             return len(rows)
-        n = k = len(rows)
+        k = self._admit_quota(member, lane, len(rows))
+        if k == 0:
+            return 0
+        if self.harden and not self._admit_dictionary(lane, gsid, rows[:k]):
+            lane.poisoned += k
+            return 0
+        lane.staged_window += k
+        return k
+
+    def admit_columns(self, member, gsid: str, cols: dict, n: int) -> int:
+        """Columnar twin of :meth:`admit`: quota on counts, dictionary
+        growth metered over the chunk's string columns (distinct values via
+        one vectorized pass per column) — the zero-object staging path
+        keeps the full fair-share/dict-cap semantics."""
+        lane = self.lanes.get(member.mid)
+        if lane is None:
+            return n
+        k = self._admit_quota(member, lane, n)
+        if k == 0:
+            return 0
+        if self.harden and \
+                not self._admit_dictionary_columns(lane, gsid, cols, k):
+            lane.poisoned += k
+            return 0
+        lane.staged_window += k
+        return k
+
+    def _admit_quota(self, member, lane, n: int) -> int:
+        """max_lag fair-share quota: how many LEADING rows may stage."""
+        k = n
         lane.observe_arrival(n)
         if member.max_lag:
             fl = self._flight(member)
@@ -280,10 +310,6 @@ class FleetGuard:
                 # second shed onset after recovery would dedupe away
                 fl.record_transition("fleet", "flowing",
                                      site=f"fleet:{member.query_name}")
-        if self.harden and not self._admit_dictionary(lane, gsid, rows[:k]):
-            lane.poisoned += k
-            return 0
-        lane.staged_window += k
         return k
 
     def _admit_dictionary(self, lane: TenantLane, gsid: str,
@@ -295,8 +321,7 @@ class FleetGuard:
         if not scols:
             return True
         fresh = 0
-        for pos, dic in scols:
-            known = dic._codes
+        for pos, _name, dic in scols:
             # per-chunk distinct set first: a chunk re-sending the same few
             # symbols costs len(distinct) lookups, not len(rows). Malformed
             # rows (short, non-string in a string column) pass HERE — the
@@ -304,16 +329,62 @@ class FleetGuard:
             # only meters genuine new strings
             distinct = {r[pos] for r in rows
                         if pos < len(r) and isinstance(r[pos], str)}
-            for v in distinct:
-                if v in known or v in lane.billed_strings:
-                    continue
-                if lane.dict_capped:
-                    # past the cap: divert, but stop billing — the billed
-                    # set stays bounded by cap + one chunk, it must not
-                    # absorb the blow-up tenant's endless fresh strings
-                    return False
-                lane.billed_strings.add(v)
-                fresh += 1
+            billed = self._bill_distinct(lane, dic, distinct)
+            if billed is None:
+                return False
+            fresh += billed
+        return self._close_billing(lane, fresh)
+
+    def _admit_dictionary_columns(self, lane: TenantLane, gsid: str,
+                                  cols: dict, k: int) -> bool:
+        """Columnar twin of :meth:`_admit_dictionary`: distinct NEW strings
+        metered per string column via one vectorized unique pass (codes for
+        DictColumns — no per-row Python on the admit path)."""
+        scols = self._string_cols(gsid)
+        if not scols:
+            return True
+        from ..core.columns import DictColumn
+        fresh = 0
+        for _pos, name, dic in scols:
+            col = cols.get(name)
+            if col is None:
+                continue
+            if isinstance(col, DictColumn):
+                codes = np.unique(col.codes[:k]).tolist()
+                distinct = {col.values[c] for c in codes
+                            if 0 <= c < len(col.values)}
+            else:
+                arr = col[:k] if isinstance(col, np.ndarray) \
+                    else np.asarray(col[:k], dtype=object)
+                vals = arr.tolist() if arr.dtype == object \
+                    else np.unique(arr).tolist()
+                distinct = set(vals)
+            distinct = {v for v in distinct if isinstance(v, str)}
+            billed = self._bill_distinct(lane, dic, distinct)
+            if billed is None:
+                return False
+            fresh += billed
+        return self._close_billing(lane, fresh)
+
+    def _bill_distinct(self, lane: TenantLane, dic, distinct) -> \
+            Optional[int]:
+        """Bill a chunk's distinct strings against one shared table;
+        None → the tenant is past its cap (divert the chunk)."""
+        known = dic._codes
+        fresh = 0
+        for v in distinct:
+            if v in known or v in lane.billed_strings:
+                continue
+            if lane.dict_capped:
+                # past the cap: divert, but stop billing — the billed
+                # set stays bounded by cap + one chunk, it must not
+                # absorb the blow-up tenant's endless fresh strings
+                return None
+            lane.billed_strings.add(v)
+            fresh += 1
+        return fresh
+
+    def _close_billing(self, lane: TenantLane, fresh: int) -> bool:
         if fresh == 0:
             return True
         lane.new_strings += fresh
@@ -328,7 +399,8 @@ class FleetGuard:
         return True
 
     def _string_cols(self, gsid: str):
-        """[(row position, shared dictionary)] for ``gsid``'s string attrs."""
+        """[(row position, attribute name, shared dictionary)] for
+        ``gsid``'s string attrs."""
         group = self.group
         cache = getattr(self, "_scols_cache", None)
         if cache is None:
@@ -347,7 +419,7 @@ class FleetGuard:
                 key = f"s{si}_{a.name}" if merged else a.name
                 dic = schema.dictionaries.get(key)
                 if dic is not None:
-                    got.append((pos, dic))
+                    got.append((pos, a.name, dic))
             cache[gsid] = got
         return got
 
@@ -383,9 +455,32 @@ class FleetGuard:
         """Stash the raw rows of the batch about to emit (the analog of
         DeviceGuard's _ShadowBuilder): a contained fault replays exactly
         these rows — culprit rows through the solo tier, innocents through
-        the shared program."""
+        the shared program. Columnar-staged chunks are captured as LAZY
+        pointer copies (:meth:`_shadow_tuple` materializes rows only when
+        a fault / non-finite sweep actually consumes the shadow — the
+        happy path stays zero-object)."""
+        if stager._col_chunks:
+            self._shadow = None
+            self._shadow_lazy = (list(stager._col_chunks),
+                                 list(stager._mid), stager)
+            return
+        self._shadow_lazy = None
         self._shadow = (list(stager._rows), list(stager._ts),
                         list(stager._mid))
+
+    def _shadow_tuple(self):
+        """(rows, ts, mid) of the captured shadow, materializing a lazy
+        columnar capture on first use; None when nothing is captured."""
+        if self._shadow is None and self._shadow_lazy is not None:
+            chunks, mids, stager = self._shadow_lazy
+            rows, tss = stager.shadow_rows({"chunks": chunks})
+            self._shadow = (rows, tss, mids)
+            self._shadow_lazy = None
+        return self._shadow
+
+    def _clear_shadow(self) -> None:
+        self._shadow = None
+        self._shadow_lazy = None
 
     def emit(self, stager) -> dict:
         """``stager.emit()`` with dtype-mismatch diagnosis: a batch that
@@ -413,6 +508,7 @@ class FleetGuard:
         count) the rest. The stager is ALWAYS left empty — an encode
         failure must never leave poison staged, or every later flush
         re-raises and the whole group wedges."""
+        stager.ensure_rows()    # a failed columnar emit left chunks staged
         rows = list(stager._rows)
         tss = list(stager._ts)
         mids = list(stager._mid)
@@ -444,6 +540,7 @@ class FleetGuard:
 
     def _diagnose_encode(self, stager) -> None:
         from ..query_api.definition import DataType
+        stager.ensure_rows()    # a failed columnar emit left chunks staged
         group = self.group
         schema = group.schema
         merged = getattr(schema, "stream_index", None) is not None
@@ -508,8 +605,9 @@ class FleetGuard:
               "tag": b["tag"][keep], "ts": b["ts"][keep],
               "count": int(np.sum(keep)),
               "last_ts": b["last_ts"]}
-        if self._shadow is not None:
-            rows, ts, smid = self._shadow
+        sh = self._shadow_tuple()
+        if sh is not None:
+            rows, ts, smid = sh
             kl = keep.tolist()
             self._shadow = (
                 [r for r, k in zip(rows, kl) if k],
@@ -556,7 +654,7 @@ class FleetGuard:
                 self._note_success(np.unique(mids))
                 group._deliver_batched(deliveries)
         finally:
-            self._shadow = None
+            self._clear_shadow()
             self._faulted = set()
 
     def _contain_batched(self, b: dict, mids: np.ndarray,
@@ -637,7 +735,7 @@ class FleetGuard:
         self.on_window_reset()
 
     def end_sliced_step(self) -> None:
-        self._shadow = None
+        self._clear_shadow()
         self._faulted = set()
 
     def _note_success(self, mids) -> None:
@@ -681,9 +779,10 @@ class FleetGuard:
             fl.on_fault("fleet_ejection", site=f"fleet:{m.query_name}")
 
     def _replay_shadow(self, m, lane: TenantLane) -> None:
-        if self._shadow is None:
+        sh = self._shadow_tuple()
+        if sh is None:
             return
-        rows, tss, smid = self._shadow
+        rows, tss, smid = sh
         mine = [(si_row, ts) for si_row, ts, mid in zip(rows, tss, smid)
                 if mid == m.mid]
         if not mine:
@@ -897,18 +996,17 @@ class HostStepGuard:
             builder = rt.builder
             if len(builder) == 0:
                 return inner_flush()
-            # shallow shadow: pointer copies only — emit() reads the row
-            # lists without mutating them, so the deep `snapshot()` copy
-            # would just tax the hot path
+            # shallow shadow: pointer copies only (row lists OR whole
+            # column chunks — builder.shadow() keeps the columnar staging
+            # zero-object; rows materialize only on the failure path)
             if not guard.breaker.allow():
                 # columnar path quarantined: drain straight to the scalar
                 # interpreter without touching the failing engine
-                shadow = {"rows": list(builder._rows),
-                          "ts": list(builder._ts)}
-                builder._rows, builder._ts = [], []
+                shadow = builder.shadow()
+                builder.clear()
                 guard._fallback(shadow, quarantined=True)
                 return None
-            shadow = {"rows": list(builder._rows), "ts": list(builder._ts)}
+            shadow = builder.shadow()
             try:
                 out = inner_flush()
             except Exception as e:  # noqa: BLE001 — quarantine boundary:
@@ -935,7 +1033,7 @@ class HostStepGuard:
                 # rows staged (the stager resets only on success) — clear
                 # them, or every later flush would fail again and re-replay
                 # the same shadow, duplicating outputs
-                builder._rows, builder._ts = [], []
+                builder.clear()
                 guard._fallback(shadow)
                 return None
             guard.breaker.record_success()
@@ -944,7 +1042,7 @@ class HostStepGuard:
         rt.flush = flush
 
     def _fallback(self, shadow: dict, quarantined: bool = False) -> None:
-        rows, tss = shadow.get("rows", []), shadow.get("ts", [])
+        rows, tss = self.bridge.runtime.builder.shadow_rows(shadow)
         if not rows:
             return
         rt = self._fallback_runtime()

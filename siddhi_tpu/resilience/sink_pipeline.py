@@ -170,6 +170,131 @@ class ResilientSink:
             if tr is not None:
                 tr.add_span("sink", self._site, dt, 1, outcome)
 
+    # -- columnar chunk pipeline ---------------------------------------------
+    @property
+    def rows_capable(self) -> bool:
+        return bool(getattr(self.inner, "rows_capable", False))
+
+    def on_columns(self, cols: dict, ts, n: int) -> str:
+        """Chunk-level publish: the whole columnar chunk goes through the
+        retry/circuit pipeline intact (ONE policy decision per chunk, zero
+        per-event objects on the happy path); only a partial or exhausted
+        failure falls back to per-event replay, which re-applies the full
+        per-event on.error semantics to exactly the unpublished tail."""
+        tr = self.tracer.active if self.tracer is not None else None
+        track = self._latency is not None and \
+            self._stats.level is not Level.OFF
+        if tr is None and not track:
+            return self._publish_columns(cols, ts, n)
+        t0 = time.perf_counter_ns()
+        outcome = "error"
+        try:
+            outcome = self._publish_columns(cols, ts, n)
+            return outcome
+        finally:
+            dt = time.perf_counter_ns() - t0
+            if track:
+                self._latency.record_seconds(
+                    dt / 1e9, n,
+                    exemplar=tr.trace_id if tr is not None else None)
+            if tr is not None:
+                tr.add_span("sink", self._site, dt, n, outcome)
+
+    def _attempt_columns(self, cols, ts, n, start: int) -> None:
+        if self.chaos is not None:
+            self.chaos.on_sink(self._site)
+        if start:
+            self.inner.on_columns({k: v[start:] for k, v in cols.items()},
+                                  ts[start:], n - start)
+        else:
+            self.inner.on_columns(cols, ts, n)
+
+    def _publish_columns(self, cols, ts, n: int) -> str:
+        from ..core.io import ConnectionUnavailableError, PartialPublishError
+        start = 0
+        wait = self.policy == OnErrorPolicy.WAIT
+        attempts = self.cfg["retry_count"] \
+            if self.policy == OnErrorPolicy.RETRY else 1
+        attempt = 0
+        while True:
+            if not self.breaker.allow():
+                if not wait or self._shutdown.is_set():
+                    # circuit fail-fast: the remaining rows take the
+                    # per-event pipeline (store/fault/drop accounting)
+                    return self._replay_rows(cols, ts, n, start)
+                self._sleep(min(self.breaker.remaining_cooldown()
+                                or self.cfg["wait_base_s"],
+                                self.cfg["wait_cap_s"]))
+                continue
+            try:
+                self._attempt_columns(cols, ts, n, start)
+            except PartialPublishError as e:
+                # partial failure: the published prefix must NOT replay —
+                # only the tail falls back to the per-event pipeline
+                self.breaker.record_failure()
+                done = max(0, min(int(e.published), n - start))
+                self.published += done
+                start += done
+                self._retry_counter.inc()
+                return self._replay_rows(cols, ts, n, start,
+                                         e.cause or e)
+            except ConnectionUnavailableError as e:
+                self.breaker.record_failure()
+                self._retry_counter.inc()
+                attempt += 1
+                if wait:
+                    if self._shutdown.is_set():
+                        return self._replay_rows(cols, ts, n, start, e)
+                    delay = min(self.cfg["wait_cap_s"],
+                                self.cfg["wait_base_s"]
+                                * (2 ** (attempt - 1)))
+                    delay *= 0.5 + random.random() * 0.5
+                    self._sleep(delay)
+                    continue
+                if attempt < attempts:
+                    if self._shutdown.wait(self.cfg["retry_delay_s"]):
+                        return self._replay_rows(cols, ts, n, start, e)
+                    continue
+                return self._replay_rows(cols, ts, n, start, e)
+            except Exception as e:  # noqa: BLE001 — policy dispatch point
+                self.breaker.record_failure()
+                if self.policy == OnErrorPolicy.RETRY \
+                        and attempt + 1 < attempts:
+                    attempt += 1
+                    self._retry_counter.inc()
+                    if self._shutdown.wait(self.cfg["retry_delay_s"]):
+                        return self._replay_rows(cols, ts, n, start, e)
+                    continue
+                return self._replay_rows(cols, ts, n, start, e)
+            self.breaker.record_success()
+            self.published += n - start
+            return "published"
+
+    def _replay_rows(self, cols, ts, n: int, start: int,
+                     err: Optional[Exception] = None) -> str:
+        """Per-event fallback for the unpublished tail of a chunk: each row
+        re-enters ``on_event`` so the configured per-event policy (retry /
+        store / fault-stream / drop) applies individually — chunk-exactly-
+        once: the published prefix never replays."""
+        from ..core.columns import columns_to_rows
+        from ..core.event import Event
+        import numpy as np
+        if start >= n:
+            return "published"
+        names = [a.name for a in self.inner.definition.attributes]
+        tail = {k: v[start:] for k, v in cols.items()}
+        rows = columns_to_rows(tail, names, n - start)
+        tss = np.asarray(ts[start:]).tolist()
+        log.warning("%s: chunk publish degraded to per-event replay for "
+                    "%d of %d row(s)%s", self._site, n - start, n,
+                    f" ({err})" if err else "")
+        worst = "published"
+        for row, t in zip(rows, tss):
+            outcome = self.on_event(Event(int(t), row))
+            if outcome != "published":
+                worst = outcome
+        return worst
+
     def _publish(self, event) -> str:
         if self.policy == OnErrorPolicy.WAIT:
             # WAIT means wait: an open circuit is slept out inside the loop,

@@ -31,6 +31,10 @@ class StringDictionary:
         self._cache_len = 0
         self._sorted_vals = None
         self._sorted_codes = None
+        # bumped on every in-place restore(): external translation caches
+        # (columns.encode_dict_column) key on it — append-only growth keeps
+        # cached prefixes valid, a restore invalidates them wholesale
+        self.generation = 0
 
     def encode(self, s: Optional[str]) -> int:
         if s is None:
@@ -112,6 +116,8 @@ class StringDictionary:
     def restore(self, values: list) -> None:
         self._values = [None] + list(values)
         self._codes = {v: i + 1 for i, v in enumerate(values)}
+        self._cache_len = 0          # sorted lookup rebuilt on next encode
+        self.generation += 1         # external translation caches drop
 
 
 def snapshot_dictionaries(dictionaries: dict) -> dict:
@@ -255,6 +261,54 @@ class BatchBuilder:
     def append_rows(self, rows: list[list], ts_list) -> None:
         for row, ts in zip(rows, ts_list):
             self.append(row, ts)
+
+    def append_columns(self, cols: dict, ts, start: int = 0) -> int:
+        """Bulk slice-copy of a columnar chunk (``{name: numpy array |
+        DictColumn}``) into the staging buffers, starting at row ``start``
+        of the chunk; returns how many rows fit (the caller emits and
+        resumes past them). The device-tier twin of
+        ``HostRowStager.append_columns`` — no per-row Python.
+
+        GROUNDWORK (pinned by tests, not yet wired): the device bridge's
+        junction receiver is still per-event, because its probe/trace FIFO
+        and ``_out_ts`` bookkeeping are stamped per event — wiring a
+        ``receive_columns`` there belongs to the device evidence round
+        (ROADMAP item 1, pack-behind-step), which should batch those too."""
+        ts = np.asarray(ts, dtype=np.int64)
+        n = int(ts.shape[0]) - start
+        if n <= 0:
+            return 0
+        take = min(n, self.capacity - self._n)
+        if take <= 0:
+            return 0
+        if self._pack_t0 is None:
+            import time
+            self._pack_t0 = time.perf_counter()
+        i = self._n
+        from ..core.columns import DictColumn, encode_dict_column
+        for name in self.schema.names:
+            col = cols[name]
+            dst = self._cols[name]
+            if isinstance(col, DictColumn):
+                dic = self.schema.dictionaries.get(name)
+                part = col[start:start + take]
+                dst[i:i + take] = encode_dict_column(part, dic) \
+                    if dic is not None else part.codes
+            else:
+                arr = col[start:start + take]
+                if not isinstance(arr, np.ndarray) or arr.dtype == object:
+                    enc = self.schema.dictionaries.get(name)
+                    if enc is not None:
+                        dst[i:i + take] = enc.encode_array(
+                            np.asarray(arr, dtype=object))
+                    else:
+                        dst[i:i + take] = [
+                            self.schema.encode_value(name, v) for v in arr]
+                else:
+                    dst[i:i + take] = arr
+        self._ts[i:i + take] = ts[start:start + take]
+        self._n += take
+        return take
 
     def emit(self) -> dict:
         """Returns {'cols': {name: np[capacity]}, 'ts', 'valid', 'count'} and

@@ -100,32 +100,117 @@ class HostRowStager:
         self.merged = hasattr(schema, "stream_index")
         self._rows: list = []          # (stream_idx, row)
         self._ts: list = []
+        # zero-object staging: whole column chunks (si, cols, ts, n) — the
+        # stager holds EITHER row entries OR column chunks, never both
+        # (mixing materializes in arrival order, see append_columns /
+        # ensure_rows), so guards that walk _rows stay correct
+        self._col_chunks: list = []
+        self._cn = 0
         if self.merged:
             self._sids = list(schema.stream_index)
 
     def __len__(self) -> int:
-        return len(self._ts)
+        return len(self._ts) + self._cn
 
     @property
     def full(self) -> bool:
-        return len(self._ts) >= self.capacity
+        return len(self) >= self.capacity
 
     def append(self, stream_id: str, row: list, ts: int) -> None:
+        if self._col_chunks:
+            self.ensure_rows()
         si = self.schema.stream_index[stream_id] if self.merged else 0
         self._rows.append((si, row))
         self._ts.append(ts)
 
     def append_events(self, stream_id: str, events: list) -> None:
         """Bulk-append StreamEvents (chunked junction delivery)."""
+        if self._col_chunks:
+            self.ensure_rows()
         si = self.schema.stream_index[stream_id] if self.merged else 0
         self._rows.extend((si, ev.data) for ev in events)
         self._ts.extend(ev.timestamp for ev in events)
 
     def append_rows(self, stream_id: str, rows: list, timestamps) -> None:
         """Bulk-append raw rows (zero-wrap ``deliver_rows`` path)."""
+        if self._col_chunks:
+            self.ensure_rows()
         si = self.schema.stream_index[stream_id] if self.merged else 0
         self._rows.extend((si, r) for r in rows)
         self._ts.extend(timestamps)
+
+    def append_columns(self, stream_id: str, cols: dict, ts) -> None:
+        """Zero-object staging: one columnar chunk ({attr: numpy array |
+        DictColumn}, int64 ts) goes in whole — no per-row Python objects.
+        A chunk arriving while per-row entries are staged materializes
+        immediately so arrival order is preserved."""
+        ts = np.asarray(ts, dtype=np.int64)
+        n = int(ts.shape[0])
+        if n == 0:
+            return
+        si = self.schema.stream_index[stream_id] if self.merged else 0
+        if self._rows:
+            from ..core.columns import columns_to_rows
+            d = self.stream_defs[stream_id] if self.merged \
+                else self.schema.definition
+            self._rows.extend(
+                (si, r) for r in columns_to_rows(
+                    cols, d.attribute_names, n))
+            self._ts.extend(ts.tolist())
+            return
+        self._col_chunks.append((si, cols, ts, n))
+        self._cn += n
+
+    def ensure_rows(self) -> None:
+        """Materialize pending column chunks into per-row entries (guards /
+        snapshots / mixed staging need the row view; NOT the hot path)."""
+        if not self._col_chunks:
+            return
+        from ..core.columns import columns_to_rows
+        chunks, self._col_chunks = self._col_chunks, []
+        self._cn = 0
+        sids = self._sids if self.merged else [self.schema.definition.id]
+        pre_rows: list = []
+        pre_ts: list = []
+        for si, cols, ts, n in chunks:
+            d = self.stream_defs[sids[si]] if self.merged \
+                else self.schema.definition
+            pre_rows.extend(
+                (si, r) for r in columns_to_rows(cols, d.attribute_names, n))
+            pre_ts.extend(ts.tolist())
+        # chunks only accumulate while no row entries are staged, so they
+        # strictly precede whatever _rows currently holds
+        self._rows = pre_rows + self._rows
+        self._ts = pre_ts + self._ts
+
+    def shadow(self) -> dict:
+        """Cheap pre-emit capture for guards (pointer copies only); feed to
+        :meth:`shadow_rows` to materialize on the failure path."""
+        if self._col_chunks:
+            return {"chunks": list(self._col_chunks)}
+        return {"rows": list(self._rows), "ts": list(self._ts)}
+
+    def shadow_rows(self, shadow: dict) -> tuple[list, list]:
+        """(rows as (si, row), ts) of a :meth:`shadow` capture."""
+        if "chunks" not in shadow:
+            return shadow.get("rows", []), shadow.get("ts", [])
+        from ..core.columns import columns_to_rows
+        sids = self._sids if self.merged else [self.schema.definition.id]
+        rows: list = []
+        tss: list = []
+        for si, cols, ts, n in shadow["chunks"]:
+            d = self.stream_defs[sids[si]] if self.merged \
+                else self.schema.definition
+            rows.extend(
+                (si, r) for r in columns_to_rows(cols, d.attribute_names, n))
+            tss.extend(ts.tolist())
+        return rows, tss
+
+    def clear(self) -> None:
+        self._rows = []
+        self._ts = []
+        self._col_chunks = []
+        self._cn = 0
 
     def _col_key(self, si: int, attr: str) -> str:
         return f"s{si}_{attr}" if self.merged else attr
@@ -133,9 +218,134 @@ class HostRowStager:
     def _dictionary(self, si: int, attr: str):
         return self.schema.dictionaries.get(self._col_key(si, attr))
 
+    def _convert_column(self, col, si: int, attr, n: int) -> np.ndarray:
+        """One staged chunk column → the engine's host dtype (strings
+        dictionary-encode: cached code translation for DictColumns, one
+        vectorized encode for value arrays)."""
+        from ..core.columns import DictColumn, encode_dict_column
+        if attr.type == DataType.STRING:
+            dic = self._dictionary(si, attr.name)
+            if isinstance(col, DictColumn):
+                enc = encode_dict_column(col, dic)
+            else:
+                arr = col if isinstance(col, np.ndarray) \
+                    else np.asarray(col, dtype=object)
+                enc = dic.encode_array(arr)
+            out = enc.astype(np.int32, copy=False)
+        else:
+            arr = np.asarray(col)
+            if arr.dtype == object:
+                dt = NP_HOST[attr.type]
+                arr = np.asarray([0 if v is None else v for v in arr],
+                                 dtype=dt)
+            out = arr.astype(NP_HOST[attr.type], copy=False)
+        if out.shape[0] != n:
+            raise ValueError(
+                f"column '{attr.name}': {out.shape[0]} values in a chunk "
+                f"of {n} rows")
+        return out
+
+    def _emit_columns(self) -> dict:
+        """Columnar fast-path emit: staged chunks concatenate straight into
+        the SoA micro-batch — zero per-row Python, and ONE dtype/dictionary
+        conversion per column however many (fine-grained) chunks staged
+        (fleet multiplexed ingress stages hundreds of 16-row chunks per
+        window — per-chunk conversion there was the measured cost). Chunks
+        reset only on success (guards re-drive a failed emit)."""
+        from ..core.columns import DictColumn
+        chunks = self._col_chunks
+        n = self._cn
+        sids = self._sids if self.merged else [self.schema.definition.id]
+        ts = np.empty(n, dtype=np.int64)
+        tag = np.zeros(n, dtype=np.int8)
+        # pass 1: gather per-key raw pieces (+ offsets) and stamp ts/tag
+        pieces: dict[str, list] = {}
+        attr_of: dict[str, tuple] = {}
+        off = 0
+        for si, ccols, cts, cn in chunks:
+            ts[off:off + cn] = cts
+            if si:
+                tag[off:off + cn] = si
+            d = self.stream_defs[sids[si]] if self.merged \
+                else self.schema.definition
+            for a in d.attributes:
+                key = self._col_key(si, a.name)
+                if self.used_cols is not None and key not in self.used_cols:
+                    continue
+                col = ccols[a.name]
+                cl = len(col) if isinstance(col, DictColumn) \
+                    else np.shape(col)[0] if isinstance(col, np.ndarray) \
+                    else len(col)
+                if cl != cn:
+                    raise ValueError(
+                        f"column '{a.name}': {cl} values in a chunk of "
+                        f"{cn} rows")
+                pieces.setdefault(key, []).append((off, cn, col))
+                attr_of[key] = (si, a)
+            off += cn
+        # pass 2: one conversion per key — concat raw pieces first when
+        # they share a representation, then encode/astype once
+        cols: dict[str, np.ndarray] = {}
+        for key, parts in pieces.items():
+            si, a = attr_of[key]
+            covered = sum(cn for _o, cn, _c in parts)
+            raw = [c for _o, _cn, c in parts]
+            if covered == n:
+                conv = self._convert_pieces(raw, si, a, n)
+                if conv is not None:
+                    cols[key] = conv
+                    continue
+            # sparse (multi-stream: this stream absent from some chunks)
+            # or mixed representations: piecewise into a zeroed column
+            full = None
+            for o, cn, c in parts:
+                conv = self._convert_column(c, si, a, cn)
+                if full is None:
+                    full = cols[key] = np.zeros(n, conv.dtype)
+                full[o:o + cn] = conv
+        # streams absent from every chunk still get zero-filled columns
+        # (same contract as the row path: predicates read every used column)
+        for si, sid in enumerate(sids):
+            d = self.stream_defs[sid] if self.merged \
+                else self.schema.definition
+            for a in d.attributes:
+                key = self._col_key(si, a.name)
+                if self.used_cols is not None and key not in self.used_cols:
+                    continue
+                if key not in cols:
+                    cols[key] = np.zeros(n, NP_HOST[a.type])
+        out = {"cols": cols, "tag": tag, "ts": ts, "count": n,
+               "last_ts": int(ts[-1]) if n else 0}
+        self._col_chunks = []
+        self._cn = 0
+        return out
+
+    def _convert_pieces(self, raw: list, si: int, attr,
+                        n: int) -> Optional[np.ndarray]:
+        """Contiguous same-representation pieces → ONE converted column;
+        None when representations mix (caller converts piecewise)."""
+        from ..core.columns import DictColumn
+        first = raw[0]
+        if isinstance(first, DictColumn):
+            if not all(isinstance(c, DictColumn)
+                       and c.values is first.values for c in raw):
+                return None
+            joined = DictColumn(
+                first.codes if len(raw) == 1
+                else np.concatenate([c.codes for c in raw]),
+                first.values, source=first.source)
+            return self._convert_column(joined, si, attr, n)
+        if not all(isinstance(c, np.ndarray) and not isinstance(
+                c, DictColumn) for c in raw):
+            return None
+        joined = first if len(raw) == 1 else np.concatenate(raw)
+        return self._convert_column(joined, si, attr, n)
+
     def emit(self) -> dict:
         """→ {"cols": {key: np[n] host-dtype}, "tag": int8[n], "ts": int64[n],
         "count": n, "last_ts": int}. Resets the stager."""
+        if self._col_chunks:
+            return self._emit_columns()
         n = len(self._ts)
         ts = np.asarray(self._ts, dtype=np.int64)
         tag = np.zeros(n, dtype=np.int8)
@@ -184,12 +394,15 @@ class HostRowStager:
         return out
 
     def snapshot(self) -> dict:
+        self.ensure_rows()      # snapshots carry the row view
         return {"rows": [(s, list(r)) for s, r in self._rows],
                 "ts": list(self._ts)}
 
     def restore(self, snap: dict) -> None:
         self._rows = [(s, list(r)) for s, r in snap["rows"]]
         self._ts = list(snap["ts"])
+        self._col_chunks = []
+        self._cn = 0
 
 
 # ---------------------------------------------------------------------------
@@ -547,11 +760,19 @@ class HostPartitionedNFA:
     per-KEY pattern semantics via the same ``_inject_key_equality`` rewrite,
     keys spread over P lanes (block-diagonal grids — an event only meets
     partials of keys sharing its lane), one dynamic-table state per lane.
+
+    ``workers > 1`` shards the LANE SPACE across a persistent thread pool
+    (``@app:host_batch(workers=N)``): each worker steps a contiguous lane
+    shard against the shared read-only sorted batch view, per-lane states
+    stay exclusively owned, and the emit merges shard outputs in lane order
+    before the stable by-event sort — byte-identical to the sequential
+    loop, so interpreter parity is preserved per lane. NumPy releases the
+    GIL inside its ufunc/sort loops, which is where the step time goes.
     """
 
     def __init__(self, query, stream_defs: dict, key_attr: str,
                  num_partitions: int = 32, query_index: int = 0,
-                 compiler=None, engine=None):
+                 compiler=None, engine=None, workers: int = 1):
         # a prebuilt (compiler, engine) pair shares ONE compiled plan across
         # runtimes (fleet shared compilation) — the caller already injected
         # the key-equality rewrite; otherwise compile from the query AST
@@ -575,10 +796,31 @@ class HostPartitionedNFA:
         d = stream_defs[sid]
         self.key_is_string = d.attribute_type(key_attr) == DataType.STRING
         self.lane_states = [self.engine.init_state() for _ in range(self.P)]
+        self.workers = max(1, int(workers))
+        self._pool = None
+        if self.workers > 1:
+            import os
+            from concurrent.futures import ThreadPoolExecutor
+            # pool capped at the machine's cores: numpy threads beyond the
+            # core count only contend (measured 0.56x at 4 threads on a
+            # 2-cpu container) — shard count stays `workers`, so the
+            # OUTPUT is identical whatever the pool size
+            self._pool = ThreadPoolExecutor(
+                max_workers=min(self.workers, os.cpu_count() or 1),
+                thread_name_prefix="host-nfa")
 
     @property
     def match_count(self) -> int:
         return sum(st["matches"] for st in self.lane_states)
+
+    def close(self) -> None:
+        """Shut the worker pool down (bridge finalize / app shutdown):
+        pool threads are non-daemon and would otherwise outlive the
+        runtime. Late flushes after close() fall back to the sequential
+        loop — identical outputs either way."""
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     def lanes_of(self, key_codes: np.ndarray) -> np.ndarray:
         if self.key_is_string:
@@ -587,22 +829,13 @@ class HostPartitionedNFA:
         return (avalanche(key_codes.astype(np.int64), np) % self.P) \
             .astype(np.int32)
 
-    def process(self, batch: dict) -> tuple[np.ndarray, dict]:
-        """One SoA batch (HostRowStager.emit shape) through every lane.
-        Returns (global_j, outs) with outs columns ordered by match event."""
-        cols, ts = batch["cols"], batch["ts"]
-        n = batch["count"]
-        outs: list[tuple[np.ndarray, dict]] = []
-        if n == 0:
-            return np.zeros(0, np.int64), {}
-        key_codes = cols[self.key_col]
-        lanes = self.lanes_of(key_codes)
-        order = np.argsort(lanes, kind="stable")
-        lanes_sorted = lanes[order]
-        bounds = np.searchsorted(lanes_sorted, np.arange(self.P + 1))
-        cols_sorted = {k: v[order] for k, v in cols.items()}
-        ts_sorted = ts[order]
-        for lane in range(self.P):
+    def _run_lanes(self, lane_lo: int, lane_hi: int, bounds, cols_sorted,
+                   ts_sorted, order) -> list:
+        """Step one contiguous lane shard (per-shard stager view: slices of
+        the shared sorted batch). Lane states are exclusively owned by
+        their shard, so this is thread-safe without locks."""
+        outs = []
+        for lane in range(lane_lo, lane_hi):
             lo, hi = int(bounds[lane]), int(bounds[lane + 1])
             if lo == hi:
                 continue
@@ -614,6 +847,35 @@ class HostPartitionedNFA:
                 m = dict(m)
                 m["j"] = order[lo + m["j"]]
                 outs.append(m)
+        return outs
+
+    def process(self, batch: dict) -> tuple[np.ndarray, dict]:
+        """One SoA batch (HostRowStager.emit shape) through every lane.
+        Returns (global_j, outs) with outs columns ordered by match event."""
+        cols, ts = batch["cols"], batch["ts"]
+        n = batch["count"]
+        if n == 0:
+            return np.zeros(0, np.int64), {}
+        key_codes = cols[self.key_col]
+        lanes = self.lanes_of(key_codes)
+        order = np.argsort(lanes, kind="stable")
+        lanes_sorted = lanes[order]
+        bounds = np.searchsorted(lanes_sorted, np.arange(self.P + 1))
+        cols_sorted = {k: v[order] for k, v in cols.items()}
+        ts_sorted = ts[order]
+        if self._pool is not None and self.P >= 2:
+            # lane-space sharding: W contiguous shards step concurrently;
+            # merge keeps lane order so the by-event sort below is
+            # byte-identical to the sequential loop
+            W = min(self.workers, self.P)
+            cuts = [self.P * w // W for w in range(W + 1)]
+            futs = [self._pool.submit(self._run_lanes, cuts[w], cuts[w + 1],
+                                      bounds, cols_sorted, ts_sorted, order)
+                    for w in range(W)]
+            outs = [m for f in futs for m in f.result()]
+        else:
+            outs = self._run_lanes(0, self.P, bounds, cols_sorted,
+                                   ts_sorted, order)
         if not outs:
             return np.zeros(0, np.int64), {}
         j = np.concatenate([m["j"] for m in outs])

@@ -1,0 +1,857 @@
+"""Zero-object edge tests (ISSUE 11): columnar sources/sinks end to end +
+the parallel columnar host tier.
+
+Pins the tentpole contracts:
+
+- chunk-boundary parity fuzz: raw CSV bytes through the line-source framing
+  (chunks 1..256, torn lines across reads, dict-encoded string columns,
+  empty chunks, null fields) land byte-identical to the per-event mapper
+  path;
+- the socket source (both wire formats: newline text and DCN ``pack_rows``
+  SoA frames) and the file source;
+- rows-chunk payloads crossing the in-memory broker WITHOUT losing batch
+  shape (columnar sink → broker → columnar source → engine);
+- columnar sinks: ``publish_rows`` through the resilience pipeline —
+  chunk retries, circuit fail-fast, and partial failure falling back to
+  per-event replay of exactly the unpublished tail;
+- parallel columnar host tier: byte-identical outputs for workers ∈
+  {1, 2, 4} including snapshot/restore mid-stream;
+- the zero-object invariant itself (instrumented Event/StreamEvent
+  constructors + the ``check_rows_path.py`` lint from tier-1).
+"""
+
+import os
+import random
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import InMemoryBroker, SiddhiManager, StreamCallback
+from siddhi_tpu.core.columns import (
+    CsvColumnParser,
+    DictColumn,
+    RowsChunk,
+    columns_to_rows,
+    encode_dict_column,
+    unpack_columns,
+)
+from siddhi_tpu.core.event import Event, StreamEvent
+from siddhi_tpu.core.io import PartialPublishError, Sink
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+    InMemoryBroker.reset()
+
+
+def _corpus(n: int, seed: int = 7):
+    """(dev string, v double, k long) rows with nulls sprinkled in."""
+    rng = random.Random(seed)
+    rows = []
+    for i in range(n):
+        dev = None if rng.random() < 0.05 else f"dev{rng.randrange(12)}"
+        v = None if rng.random() < 0.05 else round(rng.uniform(0, 100), 3)
+        k = rng.randrange(1000)
+        rows.append((dev, v, k, 1_000 + i))
+    return rows
+
+
+def _csv(rows) -> bytes:
+    return "".join(
+        f"{'' if d is None else d},{'' if v is None else v},{k},{ts}\n"
+        for d, v, k, ts in rows).encode()
+
+
+_EDGE_APP = """
+@app(name='%s')
+@app:host_batch(batch='4096')
+define stream S (dev string, v double, k long);
+define stream Out (dev string, v double, k long);
+from S[v > 50.0] select dev, v, k insert into Out;
+"""
+
+_SRC_APP = """
+@app(name='%s')
+@app:host_batch(batch='4096')
+@source(type='file', file='%s', @map(type='csv', ts.last='true'))
+define stream S (dev string, v double, k long);
+define stream Out (dev string, v double, k long);
+from S[v > 50.0] select dev, v, k insert into Out;
+"""
+
+
+def _collect(rt, stream="Out"):
+    got = []
+    rt.add_callback(stream, StreamCallback(
+        lambda evs: got.extend((e.timestamp, tuple(e.data)) for e in evs)))
+    return got
+
+
+def _per_event_reference(manager, rows, name="edge-ref"):
+    """The per-event CSV mapper path: the parity oracle."""
+    from siddhi_tpu.core.io import CsvSourceMapper
+    rt = manager.create_siddhi_app_runtime(_EDGE_APP % name, playback=True)
+    got = _collect(rt)
+    rt.start()
+    mapper = CsvSourceMapper()
+    mapper.init(rt.ctx.stream_junctions["S"].definition, {"ts.last": "true"})
+    ih = rt.input_handler("S")
+    for ev in mapper.map(_csv(rows)):
+        ih.send(ev)
+    rt.flush_host()
+    return got
+
+
+# ---------------------------------------------------------------------------
+# chunk-boundary parity fuzz
+# ---------------------------------------------------------------------------
+
+def test_source_chunk_boundary_parity_fuzz(manager):
+    """Torn lines across arbitrary transport reads: every chunking of the
+    same byte stream produces byte-identical outputs to the per-event
+    mapper path (chunks 1..256, empty reads interleaved)."""
+    from siddhi_tpu.core.io import FileLineSource
+    rows = _corpus(600)
+    payload = _csv(rows)
+    ref = _per_event_reference(manager, rows)
+    assert ref, "corpus produced no output — fuzz would be vacuous"
+
+    rng = random.Random(3)
+    sizes = [1, 2, 3, 255, 256] + [rng.randrange(1, 257) for _ in range(4)]
+    for trial, size in enumerate(sizes):
+        m = SiddhiManager()
+        try:
+            rt = m.create_siddhi_app_runtime(
+                _SRC_APP % (f"edge-fuzz-{trial}", "/dev/null"),
+                playback=True)
+            got = _collect(rt)
+            rt.start_without_sources()
+            src = rt.sources[0]
+            assert isinstance(src, FileLineSource)
+            pos = 0
+            while pos < len(payload):
+                step = size if trial % 2 == 0 \
+                    else rng.randrange(1, size + 1)
+                src.feed(payload[pos:pos + step])
+                if rng.random() < 0.1:
+                    src.feed(b"")          # empty transport read
+                pos += step
+            src.finish()
+            rt.flush_host()
+            assert got == ref, f"chunk size {size} diverged"
+        finally:
+            m.shutdown()
+
+
+def test_csv_parser_python_fallback_parity():
+    """The pure-Python parser emits the same columns as the native one."""
+    rows = _corpus(300, seed=11)
+    payload = _csv(rows)
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            _EDGE_APP % "edge-pyparse", playback=True)
+        defn = rt.ctx.stream_junctions["S"].definition
+        names = defn.attribute_names
+        native = CsvColumnParser(defn, ts_last=True)
+        python = CsvColumnParser(defn, ts_last=True)
+        python._ning = None         # force the fallback path
+        python.ingress = "python"
+        a = native.parse(payload)
+        b = python.parse(payload)
+        ra = [r for ch in a for r in columns_to_rows(ch.cols, names,
+                                                     ch.count)]
+        rb = [r for ch in b for r in columns_to_rows(ch.cols, names,
+                                                     ch.count)]
+        ta = [t for ch in a for t in ch.ts.tolist()]
+        tb = [t for ch in b for t in ch.ts.tolist()]
+        assert ta == tb
+        assert len(ra) == len(rb) == len(rows)
+        for x, y in zip(ra, rb):
+            assert x == y
+    finally:
+        m.shutdown()
+
+
+def test_csv_parser_malformed_lines_counted():
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            _EDGE_APP % "edge-badlines", playback=True)
+        defn = rt.ctx.stream_junctions["S"].definition
+        p = CsvColumnParser(defn, ts_last=True)
+        payload = b"devA,1.5,3,100\nnot-enough-fields\ndevB,bad,4,101\n" \
+                  b"devC,2.5,5,102\n"
+        chunks = p.parse(payload)
+        total = sum(ch.count for ch in chunks)
+        assert total == 2
+        assert p.parse_errors == 2
+    finally:
+        m.shutdown()
+
+
+def test_parser_capacity_overflow_multi_chunk():
+    """A payload bigger than one staging buffer emits several chunks, in
+    order, with nothing lost."""
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            _EDGE_APP % "edge-cap", playback=True)
+        defn = rt.ctx.stream_junctions["S"].definition
+        p = CsvColumnParser(defn, ts_last=True, capacity=64)
+        rows = _corpus(300, seed=5)
+        chunks = p.parse(_csv(rows))
+        assert len(chunks) >= 4
+        ts = [t for ch in chunks for t in ch.ts.tolist()]
+        assert ts == [r[3] for r in rows]
+    finally:
+        m.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# file & socket sources
+# ---------------------------------------------------------------------------
+
+def test_file_source_end_to_end(manager, tmp_path):
+    rows = _corpus(400, seed=23)
+    path = tmp_path / "feed.csv"
+    path.write_bytes(_csv(rows))
+    ref = _per_event_reference(manager, rows, name="edge-fileref")
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            _SRC_APP % ("edge-file", path), playback=True)
+        got = _collect(rt)
+        rt.start()
+        assert rt.sources[0].wait_drained(20.0)
+        rt.flush_host()
+        assert got == ref
+    finally:
+        m.shutdown()
+
+
+_SOCK_APP = """
+@app(name='%s')
+@app:host_batch(batch='4096')
+@source(type='socket', port='0', format='%s', %s
+        @map(type='csv', ts.last='true'))
+define stream S (dev string, v double, k long);
+define stream Out (dev string, v double, k long);
+from S[v > 50.0] select dev, v, k insert into Out;
+"""
+
+
+def _wait(fn, timeout=10.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if fn():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_socket_source_lines(manager):
+    rows = _corpus(300, seed=31)
+    payload = _csv(rows)
+    ref = _per_event_reference(manager, rows, name="edge-sockref")
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            _SOCK_APP % ("edge-sock", "lines", ""), playback=True)
+        got = _collect(rt)
+        rt.start()
+        src = rt.sources[0]
+        with socket.create_connection(("127.0.0.1", src.port),
+                                      timeout=5.0) as c:
+            rng = random.Random(9)
+            pos = 0
+            while pos < len(payload):       # odd-sized torn writes
+                step = rng.randrange(1, 97)
+                c.sendall(payload[pos:pos + step])
+                pos += step
+        assert _wait(lambda: (rt.flush_host() or len(got) >= len(ref)))
+        assert got == ref
+    finally:
+        m.shutdown()
+
+
+def test_socket_source_rows_frames(manager):
+    """format='rows': the DCN pack_rows SoA wire format goes straight into
+    columns — no text parse at all."""
+    from siddhi_tpu.tpu.dcn import pack_rows
+    rows = _corpus(200, seed=37)
+    ref = _per_event_reference(manager, rows, name="edge-rowsref")
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            _SOCK_APP % ("edge-rowsock", "rows", ""), playback=True)
+        got = _collect(rt)
+        rt.start()
+        src = rt.sources[0]
+        with socket.create_connection(("127.0.0.1", src.port),
+                                      timeout=5.0) as c:
+            for s in range(0, len(rows), 64):
+                part = rows[s:s + 64]
+                payload = pack_rows(
+                    "sdl", [[d, v, k] for d, v, k, _ in part],
+                    [t for _, _, _, t in part])
+                frame = struct.pack(">I", len(payload)) + payload
+                # torn frame: first half, pause, second half
+                c.sendall(frame[:len(frame) // 2])
+                time.sleep(0.01)
+                c.sendall(frame[len(frame) // 2:])
+        assert _wait(lambda: (rt.flush_host() or len(got) >= len(ref)))
+        assert got == ref
+    finally:
+        m.shutdown()
+
+
+def test_unpack_columns_round_trip():
+    from siddhi_tpu.tpu.dcn import pack_rows, unpack_rows
+    rows = [[None, 1.5, 7], ["a", None, -3], ["bb", 2.25, 9]]
+    ts = [10, 11, 12]
+    payload = pack_rows("sdl", rows, ts)
+    cols, uts, n, types = unpack_columns(payload)
+    assert n == 3 and types == "sdl"
+    assert uts.tolist() == ts
+    r2, t2 = unpack_rows(payload)
+    got = columns_to_rows({i: cols[i] for i in range(3)}, [0, 1, 2], n)
+    # nulls decode as None (string) / 0 (numeric) on the columnar side
+    assert got[0][0] is None and got[1][0] == "a"
+    assert [r[2] for r in got] == [r[2] for r in r2]
+    assert [r[1] for r in got] == [0.0 if r[1] is None else r[1]
+                                  for r in r2]
+
+
+# ---------------------------------------------------------------------------
+# broker rows chunks + columnar sinks
+# ---------------------------------------------------------------------------
+
+def test_rows_chunk_crosses_broker_intact(manager):
+    """app1's columnar sink → broker → app2's source: the chunk keeps its
+    batch shape (ONE publish per chunk) and app2 processes it columnar."""
+    app1 = """
+@app(name='edge-prod')
+@app:host_batch(batch='4096')
+define stream S (dev string, v double, k long);
+@sink(type='inMemory', topic='edge-hop', @map(type='passThrough'))
+define stream Out (dev string, v double, k long);
+from S[v > 50.0] select dev, v, k insert into Out;
+"""
+    app2 = """
+@app(name='edge-cons')
+@app:host_batch(batch='4096')
+@source(type='inMemory', topic='edge-hop', @map(type='passThrough'))
+define stream Out (dev string, v double, k long);
+define stream Final (dev string, v double, k long);
+from Out[k > 10] select dev, v, k insert into Final;
+"""
+    publishes = []
+    InMemoryBroker.subscribe("edge-hop", lambda p: publishes.append(p))
+    rt1 = manager.create_siddhi_app_runtime(app1, playback=True)
+    rt2 = manager.create_siddhi_app_runtime(app2, playback=True)
+    got = _collect(rt2, "Final")
+    rt1.start()
+    rt2.start()
+    rows = _corpus(500, seed=41)
+    defn = rt1.ctx.stream_junctions["S"].definition
+    p = CsvColumnParser(defn, ts_last=True)
+    ih = rt1.input_handler("S")
+    for ch in p.parse(_csv(rows)):
+        ih.send_columns(ch.cols, ch.ts, ch.count)
+    rt1.flush_host()
+    rt2.flush_host()
+    expect = [(t, (d, v, k)) for d, v, k, t in rows
+              if v is not None and v > 50.0 and k > 10]
+    assert [g for g in got] == expect
+    assert publishes and all(isinstance(p_, RowsChunk) for p_ in publishes)
+    assert sum(p_.count for p_ in publishes) >= len(expect)
+
+
+class ChunkFlakySink(Sink):
+    """Rows-capable sink: fails the first ``fail.n`` chunk publishes (the
+    per-event path always succeeds) — exercises chunk retry + the
+    per-event replay fallback."""
+
+    chunks: list = []
+    events: list = []
+    fails = {"n": 0}
+
+    def publish(self, payload):
+        ChunkFlakySink.events.append(payload)
+
+    def publish_rows(self, payload, n):
+        if ChunkFlakySink.fails["n"] > 0:
+            ChunkFlakySink.fails["n"] -= 1
+            raise RuntimeError("chunk transport glitch")
+        ChunkFlakySink.chunks.append((payload, n))
+
+
+class PartialSink(Sink):
+    """Publishes the first half of the FIRST chunk then reports a partial
+    failure; later publishes succeed."""
+
+    rows: list = []
+    tripped = {"done": False}
+
+    def publish(self, payload):
+        PartialSink.rows.append(payload)
+
+    def publish_rows(self, payload, n):
+        if not PartialSink.tripped["done"]:
+            PartialSink.tripped["done"] = True
+            half = n // 2
+            PartialSink.rows.extend(payload.rows(
+                [a.name for a in self.definition.attributes])[:half])
+            raise PartialPublishError(half)
+        PartialSink.rows.extend(payload.rows(
+            [a.name for a in self.definition.attributes]))
+
+
+_SINK_APP = """
+@app(name='%s')
+@app:host_batch(batch='4096')
+define stream S (dev string, v double, k long);
+@sink(type='%s', on.error='retry(3)', retry.delay.ms='1',
+      @map(type='passThrough'))
+define stream Out (dev string, v double, k long);
+from S[v > 50.0] select dev, v, k insert into Out;
+"""
+
+
+def _feed_columns(rt, rows):
+    defn = rt.ctx.stream_junctions["S"].definition
+    p = CsvColumnParser(defn, ts_last=True)
+    ih = rt.input_handler("S")
+    for ch in p.parse(_csv(rows)):
+        ih.send_columns(ch.cols, ch.ts, ch.count)
+    rt.flush_host()
+
+
+def test_resilient_sink_chunk_retry(manager):
+    ChunkFlakySink.chunks = []
+    ChunkFlakySink.events = []
+    ChunkFlakySink.fails = {"n": 2}
+    manager.set_extension("sink:chunkflaky", ChunkFlakySink)
+    rt = manager.create_siddhi_app_runtime(
+        _SINK_APP % ("edge-sink-retry", "chunkflaky"), playback=True)
+    rt.start()
+    rows = _corpus(200, seed=43)
+    _feed_columns(rt, rows)
+    expect = sum(1 for d, v, k, t in rows if v is not None and v > 50.0)
+    # the chunk retried through and published ONCE, whole (no per-event
+    # degradation, no duplicates)
+    assert sum(n for _, n in ChunkFlakySink.chunks) == expect
+    assert ChunkFlakySink.events == []
+    rs = rt.resilience.sinks[0]
+    assert rs.retries == 2 and rs.published == expect
+
+
+def test_resilient_sink_partial_falls_back_per_event(manager):
+    PartialSink.rows = []
+    PartialSink.tripped = {"done": False}
+    manager.set_extension("sink:partial", PartialSink)
+    rt = manager.create_siddhi_app_runtime(
+        _SINK_APP % ("edge-sink-partial", "partial"), playback=True)
+    rt.start()
+    rows = _corpus(400, seed=47)
+    _feed_columns(rt, rows)
+    expect = [[d, v, k] for d, v, k, t in rows
+              if v is not None and v > 50.0]
+    got = [list(getattr(r, "data", r)) for r in PartialSink.rows]
+    # exactly once, in order: the published prefix never replays, the tail
+    # re-enters per event
+    assert got == expect
+
+
+# ---------------------------------------------------------------------------
+# parallel columnar host tier
+# ---------------------------------------------------------------------------
+
+_PAR_APP = """
+@app(name='%s')
+@app:host_batch(batch='2048', lanes='%d', workers='%d')
+define stream S (dev string, v double);
+partition with (dev of S)
+begin
+from every e1=S[v > 70.0] -> e2=S[v > e1.v] -> e3=S[v > e2.v] within 400
+select e1.v as v1, e2.v as v2, e3.v as v3 insert into Alerts;
+end;
+"""
+
+
+def _pattern_feed(n=4000, seed=13):
+    rng = random.Random(seed)
+    return [(f"dev{rng.randrange(8)}", round(rng.uniform(0, 100), 3),
+             1_000 + i) for i in range(n)]
+
+
+def _run_pattern(manager_cls, workers, lanes, feed, snapshot_at=None,
+                 restore_blob=None, name=None):
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            _PAR_APP % (name or f"par-{workers}-{lanes}", lanes, workers),
+            playback=True)
+        got = _collect(rt, "Alerts")
+        rt.start()
+        if restore_blob is not None:
+            rt.restore(restore_blob)
+        ih = rt.input_handler("S")
+        devs = np.empty(len(feed), dtype=object)
+        devs[:] = [d for d, _, _ in feed]
+        vals = np.asarray([v for _, v, _ in feed])
+        tss = np.asarray([t for _, _, t in feed], np.int64)
+        blob = None
+        for s in range(0, len(feed), 512):
+            ih.send_columns({"dev": devs[s:s + 512], "v": vals[s:s + 512]},
+                            tss[s:s + 512])
+            if snapshot_at is not None and s + 512 >= snapshot_at \
+                    and blob is None:
+                blob = rt.snapshot()
+        rt.flush_host()
+        matches = rt.host_bridges[0].runtime.prt.match_count
+        return got, matches, blob
+    finally:
+        m.shutdown()
+
+
+def test_parallel_tier_worker_parity():
+    feed = _pattern_feed()
+    results = {}
+    for w in (1, 2, 4):
+        got, matches, _ = _run_pattern(SiddhiManager, w, 8, feed)
+        results[w] = (got, matches)
+    assert results[1][1] > 0, "corpus produced no matches"
+    assert results[1] == results[2] == results[4]
+
+
+def test_parallel_tier_snapshot_restore_mid_stream():
+    """A snapshot cut mid-stream under workers=2 restores into a fresh
+    workers=4 app; the continuation is byte-identical to the uninterrupted
+    workers=1 run."""
+    feed = _pattern_feed(n=3000, seed=29)
+    ref, ref_matches, _ = _run_pattern(SiddhiManager, 1, 8, feed)
+    cut = 1536
+    got_a, _m, blob = _run_pattern(SiddhiManager, 2, 8, feed[:cut],
+                                   snapshot_at=cut, name="par-snap-a")
+    assert blob is not None
+    got_b, _mb, _ = _run_pattern(SiddhiManager, 4, 8, feed[cut:],
+                                 restore_blob=blob, name="par-snap-b")
+    assert got_a + got_b == ref
+    assert ref_matches > 0
+
+
+# ---------------------------------------------------------------------------
+# fleet columnar staging
+# ---------------------------------------------------------------------------
+
+def test_fleet_stage_columns_parity(manager):
+    """Two fleet tenants fed via send_columns match the send_rows feed."""
+    def apps(tag):
+        return [f"""
+@app(name='fl-{tag}-{i}')
+@app:fleet(batch='1024')
+define stream S (dev string, v double);
+from S[v > {50.0 + i}] select dev, v insert into Alerts;
+""" for i in range(2)]
+
+    feed = _pattern_feed(n=1500, seed=17)
+    outs = {}
+    for mode in ("rows", "columns"):
+        m = SiddhiManager()
+        try:
+            rts, gots = [], []
+            for text in apps(mode):
+                rt = m.create_siddhi_app_runtime(text, playback=True)
+                gots.append(_collect(rt, "Alerts"))
+                rt.start()
+                rts.append(rt)
+            for s in range(0, len(feed), 128):
+                part = feed[s:s + 128]
+                if mode == "rows":
+                    for rt in rts:
+                        rt.input_handler("S").send_rows(
+                            [[d, v] for d, v, _ in part],
+                            [t for _, _, t in part])
+                else:
+                    devs = np.empty(len(part), dtype=object)
+                    devs[:] = [d for d, _, _ in part]
+                    cols = {"dev": devs,
+                            "v": np.asarray([v for _, v, _ in part])}
+                    tss = np.asarray([t for _, _, t in part], np.int64)
+                    for rt in rts:
+                        rt.input_handler("S").send_columns(cols, tss)
+            for rt in rts:
+                rt.flush_host()
+            outs[mode] = [list(g) for g in gots]
+            assert any(outs[mode]), "no fleet output"
+        finally:
+            m.shutdown()
+    assert outs["rows"] == outs["columns"]
+
+
+# ---------------------------------------------------------------------------
+# zero-object invariant + lint + building blocks
+# ---------------------------------------------------------------------------
+
+def test_zero_objects_on_rows_path(manager):
+    rt = manager.create_siddhi_app_runtime(
+        _EDGE_APP % "edge-zeroobj", playback=True)
+    n_out = [0]
+    rt.add_rows_callback("Out", lambda c, t, n: n_out.__setitem__(
+        0, n_out[0] + n))
+    rt.start()
+    rows = _corpus(800, seed=53)
+    defn = rt.ctx.stream_junctions["S"].definition
+    p = CsvColumnParser(defn, ts_last=True)
+    ih = rt.input_handler("S")
+    chunks = p.parse(_csv(rows))
+
+    counts = {"n": 0}
+    se_init, ev_init = StreamEvent.__init__, Event.__init__
+
+    def _se(self, *a, **k):
+        counts["n"] += 1
+        se_init(self, *a, **k)
+
+    def _ev(self, *a, **k):
+        counts["n"] += 1
+        ev_init(self, *a, **k)
+
+    StreamEvent.__init__, Event.__init__ = _se, _ev
+    try:
+        for ch in chunks:
+            ih.send_columns(ch.cols, ch.ts, ch.count)
+        rt.flush_host()
+    finally:
+        StreamEvent.__init__, Event.__init__ = se_init, ev_init
+    assert n_out[0] > 0
+    assert counts["n"] == 0
+
+
+def test_rows_path_lint():
+    """scripts/check_rows_path.py from tier-1 (the check_span_coverage
+    pattern)."""
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_rows_path.py")],
+        capture_output=True, text=True, timeout=180,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_dict_column_translation():
+    values = [None, "a", "b", "c"]
+    col = DictColumn(np.asarray([1, 2, 1, 3, 0], np.int32), values)
+    from siddhi_tpu.tpu.batch import StringDictionary
+    dic = StringDictionary()
+    dic.encode("b")                     # pre-existing entry
+    out = encode_dict_column(col, dic)
+    assert out.tolist() == [dic.encode("a"), dic.encode("b"),
+                            dic.encode("a"), dic.encode("c"), 0]
+    # table growth extends the cached translation
+    values.append("d")
+    col2 = DictColumn(np.asarray([4], np.int32), values, source=col.source)
+    assert encode_dict_column(col2, dic).tolist() == [dic.encode("d")]
+    assert col.tolist() == ["a", "b", "a", "c", None]
+    assert col[1:3].tolist() == ["b", "a"]
+
+
+def test_dict_column_translation_survives_restore():
+    """An in-place dictionary restore() remaps values→codes; the cached
+    translation must drop (generation bump), not keep emitting old
+    codes."""
+    from siddhi_tpu.tpu.batch import StringDictionary
+    values = [None, "x", "y"]
+    col = DictColumn(np.asarray([1, 2], np.int32), values)
+    dic = StringDictionary()
+    dic.encode("x")
+    dic.encode("y")
+    assert encode_dict_column(col, dic).tolist() == [1, 2]
+    dic.restore(["y", "x"])             # swapped: y=1, x=2
+    out = encode_dict_column(col, dic).tolist()
+    assert out == [dic.encode("x"), dic.encode("y")] == [2, 1]
+    # the sorted encode_array cache must drop too (same staleness class)
+    arr = np.empty(2, dtype=object)
+    arr[:] = ["x", "y"]
+    assert dic.encode_array(arr).tolist() == [2, 1]
+
+
+def test_rows_chunk_with_source_handler_manager(manager):
+    """A RowsChunk payload degrades to per-event interception when a
+    SourceHandlerManager is installed (instead of crashing the mapper)."""
+    from siddhi_tpu.core.io import SourceHandler, SourceHandlerManager
+
+    class Mgr(SourceHandlerManager):
+        def generate_source_handler(self, source_type):
+            return SourceHandler()
+
+    manager.set_source_handler_manager(Mgr())
+    app = """
+@app(name='edge-shm')
+@source(type='inMemory', topic='edge-shm-in', @map(type='passThrough'))
+define stream S (dev string, v double);
+define stream Out (dev string, v double);
+from S[v > 10.0] select dev, v insert into Out;
+"""
+    rt = manager.create_siddhi_app_runtime(app, playback=True)
+    got = _collect(rt)
+    rt.start()
+    devs = np.empty(3, dtype=object)
+    devs[:] = ["a", "b", "c"]
+    InMemoryBroker.publish("edge-shm-in", RowsChunk(
+        {"dev": devs, "v": np.asarray([5.0, 20.0, 30.0])},
+        np.asarray([1, 2, 3], np.int64), 3))
+    rt.flush_host()
+    assert got == [(2, ("b", 20.0)), (3, ("c", 30.0))]
+
+
+def test_line_source_tail_cap():
+    """A newline-free byte flood drops past max.line.bytes instead of
+    growing without bound."""
+    from siddhi_tpu.core.io import LineSource
+    from siddhi_tpu.query_api.definition import StreamDefinition
+    src = LineSource()
+    d = StreamDefinition("S").attribute("a", "string")
+    src.init(d, {"max.line.bytes": "64"}, PassThroughSourceMapperStub(),
+             lambda p: None)
+    src.feed(b"x" * 100)
+    assert src._tail == b"" and src.dropped_bytes == 100
+    src.feed(b"ok\n")
+    assert src._tail == b""
+
+
+class PassThroughSourceMapperStub:
+    map_rows = None
+
+    def map(self, payload):
+        return []
+
+
+def test_device_batch_builder_append_columns():
+    from siddhi_tpu.query_api.definition import StreamDefinition
+    from siddhi_tpu.tpu.batch import BatchBuilder, BatchSchema
+    d = StreamDefinition("S").attribute("dev", "string") \
+        .attribute("v", "double")
+    schema = BatchSchema(d)
+    ref = BatchBuilder(schema, 8)
+    bulk = BatchBuilder(schema, 8)
+    rows = [["a", 1.0], ["b", 2.0], [None, 3.0], ["a", 4.0]]
+    ts = [10, 11, 12, 13]
+    ref.append_rows(rows, ts)
+    devs = np.empty(4, dtype=object)
+    devs[:] = [r[0] for r in rows]
+    took = bulk.append_columns(
+        {"dev": devs, "v": np.asarray([r[1] for r in rows])}, ts)
+    assert took == 4
+    a, b = ref.emit(), bulk.emit()
+    for k in a["cols"]:
+        assert np.array_equal(a["cols"][k], b["cols"][k]), k
+    assert np.array_equal(a["ts"], b["ts"])
+
+
+def test_json_lines_mapper_rows(manager):
+    app = """
+@app(name='edge-jsonl')
+@app:host_batch(batch='4096')
+define stream S (dev string, v double, k long);
+define stream Out (dev string, v double, k long);
+from S[v > 50.0] select dev, v, k insert into Out;
+"""
+    import json as _json
+    rt = manager.create_siddhi_app_runtime(app, playback=True)
+    got = _collect(rt)
+    rt.start()
+    from siddhi_tpu.core.io import JsonLinesSourceMapper
+    mp = JsonLinesSourceMapper()
+    mp.init(rt.ctx.stream_junctions["S"].definition, {})
+    rows = _corpus(100, seed=59)
+    payload = "\n".join(
+        _json.dumps({"event": {"dev": d, "v": v, "k": k}})
+        for d, v, k, _ in rows).encode()
+    ih = rt.input_handler("S")
+    for ch in mp.map_rows(payload):
+        ih.send_columns(ch.cols, ch.ts, ch.count)
+    rt.flush_host()
+    expect = sum(1 for d, v, k, _ in rows if v is not None and v > 50.0)
+    assert len(got) == expect
+    assert mp.rows_out == len(rows)
+
+
+def test_send_columns_fallback_paths(manager):
+    """Non-columnar subscribers (scalar interpreter) still see identical
+    events through the fallback materialization."""
+    scalar = """
+@app(name='edge-scalar')
+define stream S (dev string, v double, k long);
+define stream Out (dev string, v double, k long);
+from S[v > 50.0] select dev, v, k insert into Out;
+"""
+    rows = _corpus(200, seed=61)
+    ref = _per_event_reference(manager, rows, name="edge-scalarref")
+    rt = manager.create_siddhi_app_runtime(scalar, playback=True)
+    got = _collect(rt)
+    rt.start()
+    defn = rt.ctx.stream_junctions["S"].definition
+    p = CsvColumnParser(defn, ts_last=True)
+    ih = rt.input_handler("S")
+    for ch in p.parse(_csv(rows)):
+        ih.send_columns(ch.cols, ch.ts, ch.count)
+    assert got == ref
+
+
+def test_send_columns_validation(manager):
+    rt = manager.create_siddhi_app_runtime(
+        _EDGE_APP % "edge-valid", playback=True)
+    rt.start()
+    ih = rt.input_handler("S")
+    with pytest.raises(Exception, match="missing"):
+        ih.send_columns({"dev": np.asarray(["a"], object)},
+                        np.asarray([1], np.int64))
+    devs = np.empty(2, dtype=object)
+    devs[:] = ["a", "b"]
+    with pytest.raises(ValueError, match="timestamps"):
+        ih.send_columns(
+            {"dev": devs, "v": np.asarray([1.0, 2.0]),
+             "k": np.asarray([1, 2])},
+            np.asarray([1], np.int64), count=2)
+    with pytest.raises(ValueError, match="values"):
+        ih.send_columns(
+            {"dev": devs, "v": np.asarray([1.0]),
+             "k": np.asarray([1, 2])},
+            np.asarray([1, 2], np.int64))
+
+
+def test_stager_mixed_rows_and_columns_order(manager):
+    """Interleaved per-event and columnar staging keeps arrival order (the
+    spill-to-rows invariant)."""
+    from siddhi_tpu.tpu.batch import BatchSchema
+    from siddhi_tpu.tpu.host_exec import HostRowStager
+    from siddhi_tpu.query_api.definition import StreamDefinition
+    d = StreamDefinition("S").attribute("dev", "string") \
+        .attribute("v", "double")
+    stager = HostRowStager(BatchSchema(d), None, 1024)
+    devs = np.empty(2, dtype=object)
+    devs[:] = ["x", "y"]
+    stager.append_columns("S", {"dev": devs, "v": np.asarray([1.0, 2.0])},
+                          np.asarray([10, 11], np.int64))
+    stager.append("S", ["z", 3.0], 12)
+    devs2 = np.empty(1, dtype=object)
+    devs2[:] = ["w"]
+    stager.append_columns("S", {"dev": devs2, "v": np.asarray([4.0])},
+                          np.asarray([13], np.int64))
+    assert len(stager) == 4
+    b = stager.emit()
+    assert b["ts"].tolist() == [10, 11, 12, 13]
+    assert b["cols"]["v"].tolist() == [1.0, 2.0, 3.0, 4.0]
