@@ -108,8 +108,26 @@ EDGE_BATCH = int(os.environ.get("BENCH_EDGE_BATCH", 65536))
 EDGE_PAR_EVENTS = int(os.environ.get("BENCH_EDGE_PAR_EVENTS", 200_000))
 EDGE_PAR_BATCH = int(os.environ.get("BENCH_EDGE_PAR_BATCH", 32768))
 EDGE_PAR_LANES = int(os.environ.get("BENCH_EDGE_PAR_LANES", 16))
+# SLO-autopilot chaos storm (--slo-child): K fleet tenants with declared
+# SLO classes, one best-effort tenant bursting at SLO_BURST× its share —
+# the closed loop must keep premium p99 inside BENCH_SLO_BUDGET_MS while
+# the burster's overflow sheds (premium sheds must be ZERO)
+SLO_TENANTS = int(os.environ.get("BENCH_SLO_TENANTS", 16))
+SLO_FEED = int(os.environ.get("BENCH_SLO_FEED", 24_000))
+SLO_CHUNK = int(os.environ.get("BENCH_SLO_CHUNK", 32))
+SLO_BURST = int(os.environ.get("BENCH_SLO_BURST", 10))
+# the declared premium budget: the ROADMAP's p99<100ms detection bar —
+# tight enough that the oversized opening window violates it, loose
+# enough that a single container scheduler stall (~50-90ms observed on
+# the 2-cpu CI box) cannot fail a converged run
+SLO_BUDGET_MS = float(os.environ.get("BENCH_SLO_BUDGET_MS", 100.0))
+# initial window deliberately oversized for the offered rate: the storm
+# must OPEN in violation (fill-wait past the budget) so the report shows
+# the loop closing it, not a scenario that was never stressed
+SLO_BATCH = int(os.environ.get("BENCH_SLO_BATCH", 65536))
 HOST_DEADLINE_S = int(os.environ.get("BENCH_HOST_DEADLINE_S", 300))
 FLEET_DEADLINE_S = int(os.environ.get("BENCH_FLEET_DEADLINE_S", 300))
+SLO_DEADLINE_S = int(os.environ.get("BENCH_SLO_DEADLINE_S", 240))
 EDGE_DEADLINE_S = int(os.environ.get("BENCH_EDGE_DEADLINE_S", 300))
 SMOKE_DEADLINE_S = int(os.environ.get("BENCH_SMOKE_DEADLINE_S", 60))
 # (the r1-r4 escalating probe ladder is gone: it is what starved r4's
@@ -1263,6 +1281,141 @@ def child_fleet() -> None:
     print(json.dumps(out))
 
 
+def child_slo() -> None:
+    """SLO-autopilot noisy-neighbour storm: K fleet tenants of the rule
+    shape with declared SLO classes (premium / standard / besteffort), the
+    last best-effort tenant bursting at SLO_BURST× its share over a
+    CPU-bound multiplexed feed. Phase 1 lets the closed loop converge
+    (shed the neighbour, shrink the window); phase 2 measures the settled
+    per-event p99 against the declared premium budget. Evidence out:
+    premium p99 vs budget, decisions taken (with the flight-recorder
+    trail), premium sheds (must be 0) vs best-effort sheds (absorb)."""
+    from siddhi_tpu import SiddhiManager, StreamCallback
+
+    def klass(i: int) -> str:
+        if i < SLO_TENANTS // 4:
+            return "premium"
+        if i >= SLO_TENANTS - max(2, SLO_TENANTS // 4):
+            return "besteffort"
+        return "standard"
+
+    def ann(i: int) -> str:
+        k = klass(i)
+        budget = f", slo.p99.ms='{SLO_BUDGET_MS}'" if k == "premium" else ""
+        return (f"@app:fleet(batch='{SLO_BATCH}', lanes='{HOST_LANES}', "
+                f"slo.class='{k}'{budget}, slo.interval.ms='2', "
+                f"slo.cooldown.ms='100', slo.window.min='256')\n")
+
+    feed = gen_events(SLO_FEED)
+    rows = [[dev, v] for dev, v, _ in feed]
+    tss = [ts for _, _, ts in feed]
+    m = SiddhiManager()
+    apps, counts = [], [0] * SLO_TENANTS
+    for i in range(SLO_TENANTS):
+        rt = m.create_siddhi_app_runtime(
+            _tenant_rule_app(i, ann(i)), playback=True)
+        rt.add_callback("Alerts", StreamCallback(
+            lambda evs, i=i: counts.__setitem__(i, counts[i] + len(evs))))
+        rt.start()
+        apps.append(rt)
+    ihs = [rt.input_handler("S") for rt in apps]
+    burster = SLO_TENANTS - 1           # a best-effort lane by klass()
+    group = apps[0].fleet_bridges[0].member.group
+    ctrl = group.slo
+    window_initial = group.effective_window()
+
+    def storm(lo: int, hi: int) -> None:
+        for s in range(lo, hi, SLO_CHUNK):
+            c = rows[s:s + SLO_CHUNK]
+            t = tss[s:s + SLO_CHUNK]
+            for j, ih in enumerate(ihs):
+                reps = SLO_BURST if j == burster else 1
+                for _ in range(reps):
+                    ih.send_rows([list(r) for r in c], list(t))
+
+    t0 = time.perf_counter()
+    split = int(SLO_FEED * 0.4)
+    storm(0, split)                     # phase 1: the loop converges
+    # convergence wait: keep the storm blowing (cycling phase-1 rows)
+    # until the controller has been quiet for a stretch — the settled
+    # measurement must judge the FINAL operating point, not the ladder's
+    # descent. Bounded: at most one extra SLO_FEED of replayed traffic.
+    last_d, t_stable = ctrl.decisions, time.perf_counter()
+    extra = 0
+    while time.perf_counter() - t_stable < 0.4 and extra < SLO_FEED:
+        lo = extra % max(split - SLO_CHUNK, 1)
+        storm(lo, lo + SLO_CHUNK)
+        extra += SLO_CHUNK
+        if ctrl.decisions != last_d:
+            last_d, t_stable = ctrl.decisions, time.perf_counter()
+    settled_chk = {p: h.checkpoint()
+                   for p, h in ctrl.evidence.hist.items()}
+    storm(split, SLO_FEED)              # phase 2: settled measurement
+    for rt in apps:
+        rt.flush_host()
+    # the converged line: evidence since the controller's LAST
+    # intervention (advance() runs at each decision, so the un-consumed
+    # window IS the quiet stretch at the final operating point). A shared
+    # CI box can stall the offered load mid-phase and transiently violate
+    # — the controller reacts, and what counts is where the loop SETTLES.
+    quiet = ctrl.evidence.window()
+    ctrl.maybe_evaluate(force=True)
+    wall = time.perf_counter() - t0
+
+    settled = {p: ctrl.evidence.hist[p].since(settled_chk[p])
+               for p in ctrl.evidence.hist}
+    # too-thin quiet window (a decision fired near the very end): judge
+    # the whole settled phase instead of a handful of events
+    e2e = quiet["end_to_end"] \
+        if quiet["end_to_end"]["count"] >= 4096 else settled["end_to_end"]
+    # offered includes the convergence-wait replays — `wall` timed them,
+    # so leaving them out would understate evps
+    offered = (SLO_FEED + extra) * (SLO_TENANTS - 1 + SLO_BURST)
+    lanes = {rt.fleet_bridges[0].member.tenant:
+             rt.fleet_bridges[0].member.lane for rt in apps}
+    prem = [f"tenant-{i}" for i in range(SLO_TENANTS)
+            if klass(i) == "premium"]
+    beff = [f"tenant-{i}" for i in range(SLO_TENANTS)
+            if klass(i) == "besteffort"]
+    premium_sheds = sum(lanes[t].shed for t in prem if lanes[t])
+    besteffort_sheds = sum(lanes[t].shed for t in beff if lanes[t])
+    trail = apps[0].ctx.flight.export(category="slo")
+    decision_kinds = [e["kind"][len("decision:"):] for e in trail
+                     if e["kind"].startswith("decision:")]
+    out = {
+        "tenants": SLO_TENANTS,
+        "premium": len(prem),
+        "besteffort": len(beff),
+        "burst_factor": SLO_BURST,
+        "budget_ms": SLO_BUDGET_MS,
+        "offered_events": offered,
+        "processed_events": group.events_in,
+        "evps": round(offered / wall) if wall else 0,
+        "premium_p99_ms": round(e2e["p99"] * 1e3, 3),
+        "premium_p50_ms": round(settled["end_to_end"]["p50"] * 1e3, 3),
+        "phase2_p99_ms": round(settled["end_to_end"]["p99"] * 1e3, 3),
+        "quiet_window_events": quiet["end_to_end"]["count"],
+        "settled_fill_wait_p99_ms":
+            round(settled["fill_wait"]["p99"] * 1e3, 3),
+        "settled_step_p99_ms": round(settled["step"]["p99"] * 1e3, 3),
+        "in_budget": e2e["p99"] * 1e3 <= SLO_BUDGET_MS,
+        "decisions": ctrl.decisions,
+        "decision_kinds": decision_kinds,
+        "premium_sheds": premium_sheds,
+        "besteffort_sheds": besteffort_sheds,
+        "window_initial": window_initial,
+        "window_final": group.effective_window(),
+        "matches_total": sum(counts),
+    }
+    print(f"# slo storm: premium p99 {out['premium_p99_ms']}ms vs budget "
+          f"{SLO_BUDGET_MS}ms (in_budget={out['in_budget']}); decisions="
+          f"{out['decisions']} {decision_kinds[:8]}; sheds premium="
+          f"{premium_sheds} besteffort={besteffort_sheds:,}; window "
+          f"{window_initial}->{out['window_final']}", file=sys.stderr)
+    m.shutdown()
+    print(json.dumps(out))
+
+
 # ---------------------------------------------------------------------------
 # parent: orchestration (no jax import — immune to backend-init hangs)
 # ---------------------------------------------------------------------------
@@ -1460,6 +1613,32 @@ def main() -> None:
                     f"fleet_vs_solo {fleet.get('fleet_vs_solo'):.2f}x below "
                     f"the 3x bar at K={fleet.get('tenants')}")
 
+    # 1c) SLO-autopilot storm: CPU-only like the fleet child — premium
+    #     p99 vs budget under a 10x noisy neighbour, decisions taken,
+    #     sheds landing on best-effort only (BENCH_SKIP_FLEET covers it:
+    #     the scenario is a fleet-tier story)
+    slo = None
+    if os.environ.get("BENCH_SKIP_FLEET", "") != "1":
+        slo, slerr = _run_child("--slo-child",
+                                min(SLO_DEADLINE_S, _remaining() * 0.25),
+                                env={"JAX_PLATFORMS": "cpu",
+                                     "PALLAS_AXON_POOL_IPS": ""})
+        if slo is None:
+            notes.append(f"slo storm failed: {slerr}")
+        else:
+            if not slo.get("in_budget"):
+                notes.append(
+                    f"SLO BUDGET MISS: premium p99 "
+                    f"{slo.get('premium_p99_ms')}ms over the "
+                    f"{slo.get('budget_ms')}ms budget after control")
+            if slo.get("premium_sheds"):
+                notes.append(
+                    f"SLO PREMIUM SHEDS: {slo.get('premium_sheds')} "
+                    f"premium rows shed (must be 0 — best-effort absorbs)")
+            if not slo.get("decisions"):
+                notes.append("slo storm took zero decisions (controller "
+                             "never engaged?)")
+
     # 2) smoke: backend init + one tiny op under a short deadline — records
     #    whether the tunnel is alive at all, independent of the full bench
     smoke, serr = _run_child("--smoke-child",
@@ -1611,6 +1790,8 @@ def main() -> None:
             out["device_partial"] = device
     if fleet:
         out["fleet"] = fleet
+    if slo:
+        out["slo"] = slo
     if edge:
         out["edge"] = edge
     out["device_phases"] = device_phases
@@ -1631,6 +1812,8 @@ if __name__ == "__main__":
         child_host()
     elif len(sys.argv) > 1 and sys.argv[1] == "--fleet-child":
         child_fleet()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--slo-child":
+        child_slo()
     elif len(sys.argv) > 1 and sys.argv[1] == "--edge-child":
         child_edge()
     else:

@@ -18,6 +18,12 @@ An ``edge`` guard (``run_edge_guard``) pins the zero-object edge line of
 the newest BENCH_r*.json against ``edge_baseline`` (rows/s floor,
 objects-per-row == 0, worker parity + speedup floor).
 
+An ``slo`` guard (``run_slo_guard``) runs a fresh ``bench.py --slo-child``
+noisy-neighbour storm (reduced feed) and pins the autopilot's contract
+vs BASELINE.json ``slo_baseline``: premium p99 within the declared budget
+(ceiling scaled by 1/tol), ZERO premium sheds, best-effort absorbing the
+shedding, and at least one controller decision taken.
+
 A ``device_latency`` guard (``run_device_latency_guard``) additionally pins
 the double-buffered pipeline's recorded evidence: when a bench report with a
 ``latency_mode`` line exists, its p99 must stay under
@@ -219,6 +225,100 @@ def run_fleet_guard(tol: float, deadline_s: int = 600) -> int:
     return 1 if failures else 0
 
 
+def run_slo_guard(tol: float, deadline_s: int = 420) -> int:
+    """SLO-autopilot storm vs BASELINE.json ``slo_baseline``: a fresh
+    ``bench.py --slo-child`` (16 tenants, one 10×-burst best-effort noisy
+    neighbour) must keep
+
+    1. the closed loop ENGAGED (≥1 controller decision on the flight
+       trail — a storm that provokes no decision means the controller is
+       unwired, the real regression this guard exists to catch);
+    2. premium sheds at ZERO (best-effort absorbs, binary — no band);
+    3. best-effort shedding actually absorbing the burst (> 0 rows);
+    4. the converged premium p99 under the stored ceiling scaled by
+       1/tol (wall-clock on a shared container, hence the slack —
+       ``premium_p99_ms`` is the quiet window at the final operating
+       point, re-measured after any mid-run stall the controller fixed).
+    """
+    with open(os.path.join(REPO, "BASELINE.json")) as f:
+        baseline = json.load(f).get("slo_baseline") or {}
+    if not baseline:
+        print(json.dumps({"slo_guard": "skipped",
+                          "reason": "no slo_baseline in BASELINE.json"}))
+        return 0
+    ceiling = float(baseline.get("premium_p99_ceiling_ms", 100.0)) \
+        / max(tol, 1e-9)
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "BENCH_SLO_FEED": os.environ.get("BENCH_GUARD_SLO_FEED", "12000"),
+    }
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--slo-child"],
+            capture_output=True, text=True, timeout=deadline_s, env=env,
+            cwd=REPO)
+    except subprocess.TimeoutExpired:
+        print(f"GUARD: slo bench exceeded {deadline_s}s", file=sys.stderr)
+        return 2
+    if p.returncode != 0:
+        tail = (p.stderr or "").strip().splitlines()[-6:]
+        print("GUARD: slo bench failed: " + " | ".join(tail),
+              file=sys.stderr)
+        return 2
+    data = None
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            data = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    if data is None:
+        print("GUARD: no JSON in slo bench output", file=sys.stderr)
+        return 2
+
+    failures = []
+    if not data.get("decisions"):
+        failures.append("controller took zero decisions under a "
+                        f"{data.get('burst_factor')}x noisy-neighbour "
+                        "storm (autopilot unwired?)")
+    if data.get("premium_sheds", 1) != 0:
+        failures.append(
+            f"{data.get('premium_sheds')} premium rows shed — premium "
+            f"lanes must never absorb a best-effort burst")
+    if not data.get("besteffort_sheds"):
+        failures.append("best-effort shed nothing — the burst was "
+                        "absorbed by the shared window instead")
+    p99 = data.get("premium_p99_ms")
+    if p99 is None:
+        failures.append("missing premium_p99_ms in slo bench output")
+    elif p99 > ceiling:
+        failures.append(
+            f"converged premium p99 {p99:.1f}ms above the ceiling "
+            f"{ceiling:.1f}ms "
+            f"({baseline.get('premium_p99_ceiling_ms')}ms / {tol})")
+
+    print(json.dumps({
+        "tenants": data.get("tenants"),
+        "burst_factor": data.get("burst_factor"),
+        "premium_p99_ms": p99,
+        "p99_ceiling_ms": ceiling,
+        "budget_ms": data.get("budget_ms"),
+        "decisions": data.get("decisions"),
+        "decision_kinds": data.get("decision_kinds"),
+        "premium_sheds": data.get("premium_sheds"),
+        "besteffort_sheds": data.get("besteffort_sheds"),
+        "window": [data.get("window_initial"), data.get("window_final")],
+        "ok": not failures,
+    }))
+    for f_ in failures:
+        print(f"GUARD REGRESSION (slo): {f_}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def _latest_device_report():
     """The report the device_latency guard judges: the file named by
     ``BENCH_GUARD_DEVICE_REPORT``, else the highest-numbered BENCH_r*.json
@@ -404,7 +504,8 @@ def main() -> int:
     if os.environ.get("BENCH_GUARD_SKIP_FLEET", "") == "1":
         return rc or drc or erc
     frc = run_fleet_guard(tol)
-    return rc or frc or drc or erc
+    src = run_slo_guard(tol)
+    return rc or frc or src or drc or erc
 
 
 if __name__ == "__main__":

@@ -15,13 +15,22 @@ tier and asserts the resilience wrap is actually installed:
    ResilienceSubsystem holds the guard);
 3. **host_batch step** — columnar host bridges carry the HostStepGuard
    flush wrap (``rt.flush`` is an instance attribute and the subsystem
-   holds the guard).
+   holds the guard);
+4. **SLO controller decision paths** — every actuator the autopilot can
+   move is reachable ONLY through ``SLOController._actuate``, which
+   records the decision (guilty phase, measured p99 vs budget, chosen
+   actuator) to the flight recorder BEFORE dispatching — a knob that
+   moves without a timeline entry is an unaccountable control plane.
+   Checked structurally (no direct ``_act_*`` call sites, record precedes
+   dispatch in ``_actuate``) and live (a synthetic actuation lands on the
+   member app's ring).
 
 Run from tier-1 (tests/test_fleet_guard.py); exits non-zero on any gap.
 """
 
 import inspect
 import os
+import re
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -104,6 +113,47 @@ def main() -> int:
         check("host partition bridges guarded",
               len(prt.host_bridges) >= 1 and
               len(prt.resilience.host_guards) == len(prt.host_bridges))
+
+        # 4) SLO controller decision paths (record-before-actuate)
+        from siddhi_tpu.observability import slo as slo_mod
+        act_src = inspect.getsource(slo_mod.SLOController._actuate)
+        rec_at = act_src.find("self._record_decision(")
+        disp_at = act_src.find("getattr(self, f\"_act_")
+        check("SLOController._actuate records the decision before "
+              "dispatching", 0 <= rec_at < disp_at,
+              f"(record at {rec_at}, dispatch at {disp_at})")
+        mod_src = inspect.getsource(slo_mod)
+        direct = [ln for ln in mod_src.splitlines()
+                  if re.search(r"\._act_\w+\(", ln)]
+        check("no actuator has a call site outside _actuate",
+              not direct, f"(direct calls: {direct})")
+        actuators = set(re.findall(r"def _act_(\w+)\(", mod_src))
+        decided = set(re.findall(r'{"actuator": "(\w+)"', mod_src))
+        check("every decided actuator has an _act_ implementation",
+              decided - {"exhausted"} <= actuators,
+              f"(decided {sorted(decided)} vs impl {sorted(actuators)})")
+        # live: a synthetic actuation must land on the member app's ring
+        # before the knob moves (ring order is append order)
+        srt = m.create_siddhi_app_runtime(
+            "@app(name='lint-slo')\n"
+            "@app:fleet(batch='64', slo.p99.ms='50', "
+            "slo.class='premium')\n" + STREAM +
+            "from S[v > 1.0] select v insert into Out;", playback=True)
+        srt.start()
+        group = srt.fleet_bridges[0].member.group
+        check("slo-declared fleet group carries a controller",
+              group.slo is not None)
+        if group.slo is not None:
+            group.slo._actuate({"actuator": "shrink_window",
+                                "guilty_phase": "fill_wait",
+                                "p99_ms": 99.0, "budget_ms": 50.0,
+                                "from": 64, "to": 32})
+            entries = srt.ctx.flight.export(category="slo")
+            check("synthetic actuation recorded on the flight ring",
+                  any(e["kind"] == "decision:shrink_window"
+                      for e in entries), f"(entries: {entries})")
+            check("actuation moved the knob it recorded",
+                  group.slo_window == 32)
     finally:
         m.shutdown()
 
@@ -111,7 +161,7 @@ def main() -> int:
         print(f"\n{len(failures)} guard-coverage gap(s)", file=sys.stderr)
         return 1
     print("\nguard coverage OK: fleet group step, device dispatch/collect, "
-          "host_batch step")
+          "host_batch step, slo decision paths")
     return 0
 
 

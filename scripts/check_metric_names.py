@@ -19,7 +19,12 @@ histograms), renders the exposition, and enforces:
   ``MAX_LABEL_VALUES`` distinct values, and unbounded-identity label
   names (``tenant``/``user``/``trace_id``/...) never appear as labels —
   per-tenant families must aggregate or exemplar-link, not explode the
-  time-series space.
+  time-series space;
+- the SLO-autopilot families (``siddhi_tpu_slo_*``, exercised by a fleet
+  tenant with declared ``slo.*`` keys in the lint deployment) carry ONLY
+  the ``app``/``query`` label set — compliance is per tenant query, and a
+  tenant query is already app-scoped, so any further label would be an
+  identity in disguise.
 
 Usage: ``python scripts/check_metric_names.py``. Exit code 1 on findings.
 Run by ``tests/test_observability.py`` so it gates CI (the
@@ -55,6 +60,8 @@ MAX_LABEL_VALUES = 64
 # OpenMetrics: exemplar label set must stay under 128 runes
 MAX_EXEMPLAR_RUNES = 128
 EXEMPLAR_LABELS = {"trace_id"}
+# slo.* compliance families: per tenant query, nothing finer
+SLO_LABELS = {"app", "query"}
 
 APP = """
 @app(name='LintApp', statistics='detail')
@@ -68,6 +75,16 @@ define stream O (t double);
 from S#window.length(16) select sum(v) as t insert into O;
 """
 
+# a fleet tenant with declared SLO keys: the siddhi_tpu_slo_* compliance
+# families render, so their naming/label discipline is linted on every run
+SLO_APP = """
+@app(name='LintSlo', statistics='true')
+@app:fleet(batch='64', slo.p99.ms='50', slo.class='premium')
+define stream F (sym string, v double);
+@info(name='fq')
+from F[v > 1.0] select sym, v insert into FO;
+"""
+
 
 def build_exposition() -> str:
     from siddhi_tpu import SiddhiManager
@@ -76,14 +93,21 @@ def build_exposition() -> str:
     m = SiddhiManager()
     rt = m.create_siddhi_app_runtime(APP, playback=True)
     rt.start()
+    srt = m.create_siddhi_app_runtime(SLO_APP, playback=True)
+    srt.start()
     ih = rt.input_handler("S")
     for i in range(40):
         ih.send([float(i)], timestamp=1000 + i)
+    fh = srt.input_handler("F")
+    for i in range(20):
+        fh.send([f"s{i % 3}", float(i)], timestamp=1000 + i)
     rt.drain_async()
     rt.flush_device()
+    srt.flush_host()
     # the OpenMetrics-flavored exposition: exemplars present, so their
     # syntax/placement/bounds are exercised by every lint run
-    text = render([rt.ctx.statistics_manager], with_exemplars=True)
+    text = render([rt.ctx.statistics_manager,
+                   srt.ctx.statistics_manager], with_exemplars=True)
     m.shutdown()
     return text
 
@@ -196,6 +220,13 @@ def check(text: str) -> list[str]:
             labels[k] = v
             if k != "le":
                 label_values.setdefault((family, k), set()).add(v)
+        if family.startswith("siddhi_tpu_slo_"):
+            extra = set(labels) - SLO_LABELS - {"le"}
+            if extra:
+                problems.append(
+                    f"line {lineno}: slo family '{family}' carries labels "
+                    f"{sorted(extra)} — compliance families allow only "
+                    f"{sorted(SLO_LABELS)}")
         if m.group("exemplar"):
             _check_exemplar(lineno, name, family, typed, labels,
                             m.group("exemplar"), problems)
@@ -250,6 +281,10 @@ def check(text: str) -> list[str]:
 def main() -> int:
     text = build_exposition()
     problems = check(text)
+    if "siddhi_tpu_slo_" not in text:
+        problems.append(
+            "lint deployment rendered no siddhi_tpu_slo_* family — the "
+            "SLO compliance surface is unwired or unregistered")
     for p in problems:
         print(p)
     if problems:

@@ -244,7 +244,10 @@ class SiddhiAppRuntime:
         # shape, cross-app lane batching); non-normalizing queries fall
         # through to the solo tiers below, per query
         from ..fleet import fleet_config
-        fleet_cfg = fleet_config(app.annotations)
+        try:
+            fleet_cfg = fleet_config(app.annotations)
+        except ValueError as e:     # malformed slo.class / numeric knob
+            raise SiddhiAppCreationError(str(e)) from None
         fleet_mgr = ctx.siddhi_context.fleet() if fleet_cfg is not None \
             else None
         q_count = 0
@@ -791,6 +794,8 @@ class SiddhiAppRuntime:
         if self.fleet_bridges:
             self.ctx.siddhi_context.fleet().release_app(self.name)
             sm.unregister("fleet.")
+            sm.unregister("slo.")   # the autopilot's compliance gauges ride
+            # the tenant's lifecycle exactly like the fleet.* families
             self.fleet_bridges = []
         for b in self.host_bridges:
             sm.unregister(f"host_batch.{b.query_name}")

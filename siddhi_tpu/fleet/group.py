@@ -161,6 +161,10 @@ class FleetMember:
         self.params = params
         self.overrides = overrides
         self.local_sids = local_sids
+        self.group = None              # owning FleetGroup (re-pointed by
+        # FleetGroup.split — receivers route through it, so a moved member
+        # stages into its NEW group without re-subscribing junctions)
+        self.slo = None                # TenantSLO when @app:fleet(slo.*)
         self.state: Any = None
         self.prt = None                # partition kind runtime
         self.bridge: Optional["FleetQueryBridge"] = None
@@ -212,15 +216,18 @@ class FleetQueryBridge:
 
     # -- junction receivers ----------------------------------------------
     def receiver_for(self, stream_id: str):
-        group = self.group
         member = self.member
-        gsid = group.sids[member.local_sids.index(stream_id)]
+        # gsid is the group-canonical id at this position — identical in
+        # any split sibling (siblings are built from the same canonical
+        # args), so routing through member.group stays valid after a split
+        gsid = self.group.sids[member.local_sids.index(stream_id)]
 
         class _R:
             def receive(self, event: StreamEvent) -> None:
                 if event.type is not EventType.CURRENT:
                     return
-                group.stage_event(member, gsid, event.data, event.timestamp)
+                member.group.stage_event(member, gsid, event.data,
+                                         event.timestamp)
 
             def receive_chunk(self, events: list) -> None:
                 if any(e.type is not EventType.CURRENT for e in events):
@@ -228,13 +235,13 @@ class FleetQueryBridge:
                               if e.type is EventType.CURRENT]
                     if not events:
                         return
-                group.stage_events(member, gsid, events)
+                member.group.stage_events(member, gsid, events)
 
             def receive_rows(self, rows: list, timestamps) -> None:
-                group.stage_rows(member, gsid, rows, timestamps)
+                member.group.stage_rows(member, gsid, rows, timestamps)
 
             def receive_columns(self, cols: dict, ts, n: int) -> None:
-                group.stage_columns(member, gsid, cols, ts, n)
+                member.group.stage_columns(member, gsid, cols, ts, n)
 
         return _R()
 
@@ -277,9 +284,14 @@ class FleetMemberState:
     the state walk), then snapshots only this member's state plus the shared
     dictionary tables its codes decode through."""
 
-    def __init__(self, group: "FleetGroup", member: FleetMember):
-        self.group = group
+    def __init__(self, member: FleetMember):
         self.member = member
+
+    @property
+    def group(self) -> "FleetGroup":
+        # resolved through the member so a split-moved tenant snapshots
+        # against its CURRENT group
+        return self.member.group
 
     def snapshot_state(self):
         self.group.flush("snapshot")
@@ -309,8 +321,18 @@ class GroupFlight:
         self.group = group
 
     def _recorders(self):
+        # callers (AIMD observe, SLO evaluation) run lock-free while
+        # enroll/split mutate the members dict under the group lock — a
+        # torn read costs one retry, never a dropped timeline entry
+        members = []
+        for _ in range(4):
+            try:
+                members = list(self.group.members.values())
+                break
+            except RuntimeError:
+                continue
         seen = set()
-        for m in self.group.members.values():
+        for m in members:
             fl = getattr(m.app_context, "flight", None)
             if fl is not None and id(fl) not in seen:
                 seen.add(id(fl))
@@ -351,6 +373,9 @@ class FleetGroup:
         self._stream_defs = dict(stream_defs or {})
         self.guard = None             # FleetGuard (resilience/fleet_guard.py)
         self.batch_controller = None  # @app:adaptive AIMD window sizing
+        self.slo = None               # SLOController (@app:fleet slo.* keys)
+        self.slo_window = None        # autopilot's flush-window cap
+        self._window_t0 = None        # first-stage wall clock (fill span)
         if kind == "stream":
             self.schema = plan.compiled.schema
             self.stager = FleetStager(self.schema, None, self.capacity)
@@ -388,6 +413,7 @@ class FleetGroup:
                                        self.dictionaries)
             m = FleetMember(mid, tenant, query_name, app_context,
                             output_junction, params, overrides, local_sids)
+            m.group = self
             m.state = self._init_member_state(m)
             self.members[mid] = m
             self._luts = None
@@ -402,8 +428,54 @@ class FleetGroup:
             self.members.pop(member.mid, None)
             if self.guard is not None:
                 self.guard.detach(member)
+            if self.slo is not None:
+                self.slo.detach(member)
             self._luts = None
             return len(self.members)
+
+    def split(self, move: list) -> "FleetGroup":
+        """Halve the blast radius of one shared step: the ``move`` members
+        leave for a sibling group stepping the SAME cached plan (no
+        recompile, same shared dictionaries — codes stay comparable), with
+        their state, guard lanes (breaker/counters intact) and fair-share
+        knobs carried over. The SLO autopilot's split actuator calls this
+        via :meth:`FleetManager.split_group` when the step phase owns a
+        violated budget; caller holds ``self._lock``."""
+        self.flush("split")
+        sibling = FleetGroup(self.shape_key, self.kind, self.plan, self.cfg,
+                             self.sids, self._stream_defs, self.param_specs)
+        if self.guard is not None:
+            from ..resilience.fleet_guard import FleetGuard
+            sibling.guard = FleetGuard(sibling, self.cfg)
+        c = self.batch_controller
+        if c is not None:
+            from ..flow.adaptive_batch import AdaptiveBatchController
+            sibling.batch_controller = AdaptiveBatchController(
+                min_batch=c.min_batch, max_batch=c.max_batch,
+                target_ms=c.target_ms, initial=c.current,
+                latency_target_ms=c.latency_target_ms)
+            sibling.batch_controller.flight = GroupFlight(sibling)
+            sibling.batch_controller.site = f"{c.site}#split"
+        sibling.slo_window = self.slo_window
+        for m in move:
+            if self.members.pop(m.mid, None) is None:
+                continue
+            lane = None
+            if self.guard is not None:
+                lane = self.guard.lanes.pop(m.mid, None)
+            m.mid = sibling._next_mid
+            sibling._next_mid += 1
+            sibling.members[m.mid] = m
+            m.group = sibling
+            if m.bridge is not None:
+                m.bridge.group = sibling
+            if sibling.guard is not None:
+                if lane is not None:
+                    sibling.guard.adopt(m, lane)
+                else:
+                    sibling.guard.attach(m)
+        self._luts = None
+        return sibling
 
     def _init_member_state(self, m: FleetMember):
         ov = m.overrides
@@ -464,69 +536,110 @@ class FleetGroup:
     # each staging entry drains the guard's deferred scalar replays AFTER
     # releasing the group lock (they acquire the member app's root_lock —
     # taking it under the group lock would invert the snapshot walk's
-    # root_lock → group._lock order)
+    # root_lock → group._lock order), and gives the SLO autopilot its
+    # (rate-limited) evaluation slot at the same lock-free point
+
+    def _note_window_t0(self) -> None:
+        """First stage into an empty window stamps the fill-span clock —
+        the evidence the autopilot's fill_wait attribution reads. Only
+        armed groups pay the perf_counter call."""
+        if self.slo is not None and self._window_t0 is None:
+            self._window_t0 = time.perf_counter()
+
+    # NOTE on the per-method `m.group is not self` checks: the unlocked one
+    # is the fast path; the SECOND check inside the lock closes the race
+    # with split() — a stager that lost it would otherwise use the
+    # member's NEW sibling mid against THIS group's stager, aliasing a
+    # remaining tenant's lane (params, quota, output junction). The moved
+    # flag re-dispatches after the lock drops (old→sibling lock nesting is
+    # avoided entirely).
 
     def stage_event(self, m: FleetMember, gsid: str, data, ts: int) -> None:
+        if m.group is not self:      # split moved the member mid-flight
+            return m.group.stage_event(m, gsid, data, ts)
+        moved = False
         try:
             with self._lock:
-                g = self.guard
-                if g is not None:
-                    if m.ejected:
-                        self._register_trace(m)
-                        g.solo_stage(m, gsid, [data], [ts])
-                        return
-                    if g.admit(m, gsid, [data]) == 0:
-                        # shed/diverted BEFORE staging: no trace handoff —
-                        # the event never reaches the shared step
-                        return
-                self._register_trace(m)
-                self.stager.stage_event(m.mid, gsid, data, ts)
-                self._post_stage(m)
+                if m.group is not self:
+                    moved = True     # split won the lock first: re-route
+                elif self.guard is not None and m.ejected:
+                    self._register_trace(m)
+                    self.guard.solo_stage(m, gsid, [data], [ts])
+                elif self.guard is not None and \
+                        self.guard.admit(m, gsid, [data]) == 0:
+                    # shed/diverted BEFORE staging: no trace handoff —
+                    # the event never reaches the shared step
+                    pass
+                else:
+                    self._register_trace(m)
+                    self._note_window_t0()
+                    self.stager.stage_event(m.mid, gsid, data, ts)
+                    self._post_stage(m)
         finally:
             self._drain_guard(m)
+            self._drain_slo()
+        if moved:
+            m.group.stage_event(m, gsid, data, ts)
 
     def stage_events(self, m: FleetMember, gsid: str, events: list) -> None:
+        if m.group is not self:
+            return m.group.stage_events(m, gsid, events)
+        moved = False
         try:
             with self._lock:
                 g = self.guard
-                if g is not None:
-                    if m.ejected:
+                if m.group is not self:
+                    moved = True
+                elif g is not None and m.ejected:
+                    self._register_trace(m)
+                    g.solo_stage(m, gsid, [e.data for e in events],
+                                 [e.timestamp for e in events])
+                else:
+                    k = g.admit(m, gsid, [e.data for e in events]) \
+                        if g is not None else len(events)
+                    if k > 0:
+                        if k < len(events):
+                            events = events[:k]
                         self._register_trace(m)
-                        g.solo_stage(m, gsid, [e.data for e in events],
-                                     [e.timestamp for e in events])
-                        return
-                    k = g.admit(m, gsid, [e.data for e in events])
-                    if k == 0:
-                        return
-                    if k < len(events):
-                        events = events[:k]
-                self._register_trace(m)
-                self.stager.stage_events(m.mid, gsid, events)
-                self._post_stage(m)
+                        self._note_window_t0()
+                        self.stager.stage_events(m.mid, gsid, events)
+                        self._post_stage(m)
         finally:
             self._drain_guard(m)
+            self._drain_slo()
+        if moved:
+            m.group.stage_events(m, gsid, events)
 
     def stage_rows(self, m: FleetMember, gsid: str, rows,
                    timestamps) -> None:
+        if m.group is not self:
+            return m.group.stage_rows(m, gsid, rows, timestamps)
+        moved = False
         try:
             with self._lock:
                 g = self.guard
-                if g is not None:
-                    if m.ejected:
+                if m.group is not self:
+                    moved = True
+                elif g is not None and m.ejected:
+                    self._register_trace(m)
+                    g.solo_stage(m, gsid, rows, timestamps)
+                else:
+                    k = g.admit(m, gsid, rows) if g is not None \
+                        else len(rows)
+                    if k > 0:
+                        if k < len(rows):
+                            rows = rows[:k]
+                            timestamps = timestamps[:k]
                         self._register_trace(m)
-                        g.solo_stage(m, gsid, rows, timestamps)
-                        return
-                    k = g.admit(m, gsid, rows)
-                    if k == 0:
-                        return
-                    if k < len(rows):
-                        rows = rows[:k]
-                        timestamps = timestamps[:k]
-                self._register_trace(m)
-                self.stager.stage_rows(m.mid, gsid, rows, timestamps)
-                self._post_stage(m)
+                        self._note_window_t0()
+                        self.stager.stage_rows(m.mid, gsid, rows,
+                                               timestamps)
+                        self._post_stage(m)
         finally:
             self._drain_guard(m)
+            self._drain_slo()
+        if moved:
+            m.group.stage_rows(m, gsid, rows, timestamps)
 
     def stage_columns(self, m: FleetMember, gsid: str, cols: dict, ts,
                       n: int) -> None:
@@ -535,36 +648,52 @@ class FleetGroup:
         shared stager keeps the chunk whole. Only an ejected member's
         chunks materialize rows (the solo tier replays per row), and the
         guard's pre-step shadow materializes once per window."""
+        if m.group is not self:
+            return m.group.stage_columns(m, gsid, cols, ts, n)
         ts = np.asarray(ts, dtype=np.int64)
+        moved = False
         try:
             with self._lock:
                 g = self.guard
-                if g is not None:
-                    if m.ejected:
+                if m.group is not self:
+                    moved = True
+                elif g is not None and m.ejected:
+                    self._register_trace(m)
+                    from ..core.columns import columns_to_rows
+                    d = self.stream_defs_for(gsid)
+                    g.solo_stage(m, gsid,
+                                 columns_to_rows(
+                                     cols, d.attribute_names, n),
+                                 ts.tolist())
+                else:
+                    k = g.admit_columns(m, gsid, cols, n) \
+                        if g is not None else n
+                    if k > 0:
+                        if k < n:
+                            cols = {kk: v[:k] for kk, v in cols.items()}
+                            ts = ts[:k]
                         self._register_trace(m)
-                        from ..core.columns import columns_to_rows
-                        d = self.stream_defs_for(gsid)
-                        g.solo_stage(m, gsid,
-                                     columns_to_rows(
-                                         cols, d.attribute_names, n),
-                                     ts.tolist())
-                        return
-                    k = g.admit_columns(m, gsid, cols, n)
-                    if k == 0:
-                        return
-                    if k < n:
-                        cols = {kk: v[:k] for kk, v in cols.items()}
-                        ts = ts[:k]
-                self._register_trace(m)
-                self.stager.stage_columns(m.mid, gsid, cols, ts)
-                self._post_stage(m)
+                        self._note_window_t0()
+                        self.stager.stage_columns(m.mid, gsid, cols, ts)
+                        self._post_stage(m)
         finally:
             self._drain_guard(m)
+            self._drain_slo()
+        if moved:
+            m.group.stage_columns(m, gsid, cols, ts, n)
 
     def _drain_guard(self, m: FleetMember) -> None:
         g = self.guard
         if g is not None:
             g.drain_deferred(m.app_context)
+
+    def _drain_slo(self) -> None:
+        """The autopilot's evaluation slot: runs with NO lock held (same
+        contract as the deferred scalar replays), so an actuation may take
+        ``manager._lock → group._lock`` in the enrollment order."""
+        s = self.slo
+        if s is not None:
+            s.maybe_evaluate()
 
     # -- trace handoff across the shared-lane hop --------------------------
     def _register_trace(self, m: FleetMember) -> None:
@@ -604,17 +733,25 @@ class FleetGroup:
         if c is not None and len(self.stager) >= c.current:
             self._step("adaptive")
             return
+        sw = self.slo_window
+        if sw is not None and len(self.stager) >= sw:
+            self._step("slo")
+            return
         g = self.guard
         if g is not None and g.fair_share_flush_due(m):
             self._step("fair_share")
 
     def effective_window(self) -> int:
-        """The flush window fair-share quotas divide: the adaptive AIMD
-        threshold when a controller is attached, the static capacity
-        otherwise."""
+        """The flush window fair-share quotas divide: the static capacity
+        capped by the adaptive AIMD threshold (when a controller is
+        attached) and by the SLO autopilot's window cap (when armed)."""
+        w = self.capacity
         c = self.batch_controller
-        return min(self.capacity, c.current) if c is not None \
-            else self.capacity
+        if c is not None:
+            w = min(w, c.current)
+        if self.slo_window is not None:
+            w = min(w, self.slo_window)
+        return w
 
     def make_stager(self):
         """A PRIVATE stager over the group's shared schema (same dictionary
@@ -677,6 +814,12 @@ class FleetGroup:
                 n, val, dtype=_param_dtype(spec))
 
     def _step(self, cause: str) -> None:
+        # fill span: first-stage → flush wall clock of this window (read
+        # and reset up front so swept windows clear it too)
+        t_flush = time.perf_counter()
+        fill_span = t_flush - self._window_t0 \
+            if self._window_t0 is not None else 0.0
+        self._window_t0 = None
         g = self.guard
         b = g.emit(self.stager) if g is not None else self.stager.emit()
         mids = b["mid"]
@@ -702,9 +845,15 @@ class FleetGroup:
                     self._run_batched(b, mids)
             else:
                 self._step_sliced(b, mids)
+        dt = time.perf_counter() - t0
         c = self.batch_controller
         if c is not None:
-            c.observe(n, time.perf_counter() - t0)
+            c.observe(n, dt)
+        s = self.slo
+        if s is not None:
+            # the autopilot's windowed evidence: fill span + step time per
+            # shared window (decisions read interval snapshots of these)
+            s.on_step(n, fill_span, dt)
         # every in-group member's pending traces close with a 'fleet' span
         # once the shared step consumed the window they staged into
         self._drain_all_traces(n)
@@ -852,4 +1001,6 @@ class FleetGroup:
                 out["guard"] = self.guard.report()
             if self.batch_controller is not None:
                 out["adaptive"] = self.batch_controller.report()
+            if self.slo is not None:
+                out["slo"] = self.slo.report()
             return out

@@ -42,7 +42,14 @@ def fleet_config(app_annotations) -> Optional[dict]:
     PER-TENANT knobs (this app's lanes); ``guard``, ``guard.threshold``,
     ``guard.cooldown.ms``, ``guard.readmit.batches``, ``harden`` and
     ``dict.cap`` configure the shape group's FleetGuard and are taken from
-    the group's FIRST enrolling tenant."""
+    the group's FIRST enrolling tenant.
+
+    SLO surface (the autopilot, :mod:`siddhi_tpu.observability.slo`):
+    ``slo.p99.ms`` and ``slo.class`` ('premium'|'standard'|'besteffort')
+    are PER-TENANT declarations; ``slo.interval.ms``, ``slo.cooldown.ms``,
+    ``slo.window.min`` and ``slo.dominance`` tune the group's controller
+    (first enrolling tenant, like the guard knobs). Raises ValueError on a
+    malformed class (the app build wraps it)."""
     ann = find_annotation(app_annotations, "fleet")
     if ann is None and os.environ.get("SIDDHI_FLEET", "") != "1":
         return None
@@ -74,6 +81,8 @@ def fleet_config(app_annotations) -> Optional[dict]:
             cfg["harden"] = ann.get("harden").lower() != "false"
         if ann.get("dict.cap"):
             cfg["dict_cap"] = int(ann.get("dict.cap"))
+        from ..observability.slo import parse_slo_fleet_keys
+        parse_slo_fleet_keys(ann, cfg)
     return cfg
 
 
@@ -130,6 +139,10 @@ class FleetManager:
     def __init__(self, cache_size: int = 256):
         self.plan_cache = PlanCache(cache_size)
         self.groups: dict[str, FleetGroup] = {}
+        # SLO-autopilot split siblings: same shape_key as a primary group,
+        # tracked separately so new tenants keep joining the primary while
+        # split-off lanes live out their own group lifecycle
+        self.split_groups: list[FleetGroup] = []
         self._lock = threading.RLock()
         self.fallbacks = 0
         self.enrolled = 0
@@ -274,7 +287,18 @@ class FleetManager:
         member.get_junction = get_junction
         bridge = FleetQueryBridge(group, member)
         app_context.register_state(f"fleet-{name}",
-                                   FleetMemberState(group, member))
+                                   FleetMemberState(member))
+        # SLO autopilot: a declared budget/class arms the group's closed
+        # loop (first tenant's slo.* controller knobs, like the guard's)
+        if "slo_p99_ms" in cfg or "slo_class" in cfg:
+            from ..observability.slo import SLOController, TenantSLO
+            with self._lock:
+                if group.slo is None:
+                    group.slo = SLOController(group, self, cfg)
+                slo = TenantSLO(member, cfg.get("slo_p99_ms"),
+                                cfg.get("slo_class", "standard"))
+                group.slo.attach(member, slo)
+            self._register_slo_metrics(app_context, member)
         self._register_metrics(app_context, group, member)
         self.enrolled += 1
         return bridge
@@ -307,14 +331,48 @@ class FleetManager:
 
         return self.plan_cache.get(normalized.shape_key, "jax", build).plan
 
+    # ------------------------------------------------------------------ split
+    def split_group(self, group: FleetGroup,
+                    move: list) -> Optional[FleetGroup]:
+        """The SLO autopilot's split actuator: move ``move`` members into a
+        sibling group over the same cached plan (lock order matches
+        enrollment: ``manager._lock → group._lock``). The sibling gets its
+        own controller when any moved lane declared an SLO; moved lanes
+        keep their TenantSLO objects."""
+        with self._lock:
+            with group._lock:
+                movable = [m for m in move if m.mid in group.members]
+                if not movable or len(movable) >= len(group.members):
+                    return None
+                sibling = group.split(movable)
+            self.split_groups.append(sibling)
+            slo = group.slo
+            if slo is not None:
+                moved = [(m, m.slo) for m in movable
+                         if getattr(m, "slo", None) is not None]
+                for m, _t in moved:
+                    slo.detach(m)
+                if moved:
+                    from ..observability.slo import SLOController
+                    sibling.slo = SLOController(sibling, self, slo.cfg)
+                    for m, t in moved:
+                        sibling.slo.attach(m, t)
+            log.info("fleet group '%s' split: %d lane(s) moved to a "
+                     "sibling (%d stay)", group.shape_key[:60],
+                     len(movable), len(group.members))
+            return sibling
+
     # ---------------------------------------------------------------- teardown
     def release_member(self, bridge: FleetQueryBridge) -> None:
         group = bridge.group
         with self._lock:
             left = group.remove_member(bridge.member)
             if left == 0:
-                self.groups.pop(group.shape_key, None)
-                self.plan_cache.unpin(group.shape_key, "numpy")
+                if self.groups.get(group.shape_key) is group:
+                    self.groups.pop(group.shape_key, None)
+                    self.plan_cache.unpin(group.shape_key, "numpy")
+                elif group in self.split_groups:
+                    self.split_groups.remove(group)
 
     def release_app(self, app_name: str) -> int:
         """Detach every member of one tenant app (app shutdown); the shared
@@ -322,7 +380,7 @@ class FleetManager:
         tenant of the shape. Returns members released."""
         released = 0
         with self._lock:
-            for group in list(self.groups.values()):
+            for group in list(self.groups.values()) + list(self.split_groups):
                 for m in [m for m in group.members.values()
                           if m.app_context.name == app_name]:
                     self.release_member(m.bridge)
@@ -373,13 +431,43 @@ class FleetManager:
             sm.gauge_tracker(f"fleet.tenant.{q}.arrival_evps",
                              lambda x=lane: x.arrival_evps)
 
+    def _register_slo_metrics(self, app_context, member) -> None:
+        """``slo.*`` compliance gauges on the member app (rendered as
+        ``siddhi_tpu_slo_*{app,query}`` families; torn down with the
+        app's ``slo.`` prefix on shutdown). Gauges read through
+        ``member.group`` so a split keeps them live."""
+        sm = app_context.statistics_manager
+        if sm is None:
+            return
+        q = member.query_name
+
+        def _slo(mm=member):
+            return mm.slo
+
+        sm.gauge_tracker(f"slo.{q}.p99_budget_ms",
+                         lambda: (_slo().p99_budget_ms or 0.0))
+        sm.gauge_tracker(f"slo.{q}.p99_window_ms",
+                         lambda: round(_slo().last_p99_ms, 3))
+        sm.gauge_tracker(f"slo.{q}.compliant",
+                         lambda: 1 if _slo().compliant else 0)
+        sm.gauge_tracker(f"slo.{q}.class_code", lambda: _slo().class_code)
+        sm.gauge_tracker(f"slo.{q}.shed_hold",
+                         lambda: 1 if _slo().shed_hold else 0)
+        sm.gauge_tracker(
+            f"slo.{q}.decisions_total",
+            lambda m=member: m.group.slo.decisions
+            if m.group is not None and m.group.slo is not None else 0)
+
     def stats(self) -> dict:
         with self._lock:
+            groups = {k: g.report() for k, g in self.groups.items()}
+            for i, g in enumerate(self.split_groups):
+                groups[f"{g.shape_key}#split{i}"] = g.report()
             return {"cache": self.plan_cache.stats(),
-                    "groups": {k: g.report()
-                               for k, g in self.groups.items()},
+                    "groups": groups,
                     "members": sum(len(g.members)
-                                   for g in self.groups.values()),
+                                   for g in list(self.groups.values())
+                                   + self.split_groups),
                     "enrolled": self.enrolled,
                     "fallbacks": self.fallbacks,
                     "fallback_reasons": list(self.fallback_reasons)}

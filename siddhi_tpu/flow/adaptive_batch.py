@@ -67,6 +67,10 @@ class AdaptiveBatchController:
         # (set post-construction by the observability wiring)
         self.flight = None
         self.site = ""
+        # externally imposed hard cap on the threshold (the SLO autopilot's
+        # shrink actuator): AIMD may roam below it, never above — the two
+        # control loops must not fight over the same knob
+        self.ceiling: Optional[int] = None
         self._lat_ms: collections.deque = collections.deque(maxlen=history)
         self._cooldown = max(1, int(cooldown))
         self._since_adjust = 0
@@ -129,6 +133,8 @@ class AdaptiveBatchController:
                       self.current + max(self.min_batch // 2, 1))
         else:
             return self.current
+        if self.ceiling is not None:
+            nxt = min(nxt, self.ceiling)
         if nxt != self.current:
             old, self.current = self.current, nxt
             self.adjustments += 1
@@ -140,6 +146,25 @@ class AdaptiveBatchController:
                                  "budget_ms": round(budget, 3)})
         self._since_adjust = 0
         return self.current
+
+    # -- external cap (SLO autopilot) ------------------------------------------
+    def impose_ceiling(self, n: int) -> None:
+        """Cap the threshold from outside (clamping the current operating
+        point immediately). The imposer records its own decision; the
+        clamp itself also lands on the flight timeline as an aimd_resize
+        so the knob's history stays complete."""
+        n = max(self.min_batch, int(n))
+        self.ceiling = n
+        if self.current > n:
+            old, self.current = self.current, n
+            self.adjustments += 1
+            f = self.flight
+            if f is not None:
+                f.record("flow", "aimd_resize", site=self.site,
+                         detail={"from": old, "to": n, "cap": "slo"})
+
+    def lift_ceiling(self) -> None:
+        self.ceiling = None
 
     # -- readouts --------------------------------------------------------------
     @property
@@ -189,6 +214,8 @@ class AdaptiveBatchController:
             "observations": self.observations,
             "adjustments": self.adjustments,
         }
+        if self.ceiling is not None:
+            out["ceiling"] = self.ceiling
         if self.mode == "latency":
             out["latency_target_ms"] = self.latency_target_ms
             out["arrival_evps"] = round(self.arrival_evps)

@@ -21,8 +21,14 @@ and counters; PR 3 added the gaps this package closes:
   ``trace_id`` exemplars when sampled;
 - **flight recorder** (``flight_recorder.py``) — a bounded ring of
   control-plane transitions (AIMD resizes, flush-cause flips, breaker
-  state, quarantine/ejection, takeover/rejoin), dumped to JSON on fault
-  and served at ``GET /siddhi-apps/{name}/flightrecorder``;
+  state, quarantine/ejection, SLO decisions, takeover/rejoin), dumped to
+  JSON on fault, served at ``GET /siddhi-apps/{name}/flightrecorder``
+  and tailable incrementally via ``?since_ns=``;
+- **SLO autopilot** (``slo.py``, PR 12) — per-tenant SLO classes on
+  ``@app:fleet`` close the loop: a per-group controller samples windowed
+  phase evidence and moves one actuator per decision (shed / shrink /
+  split / eject), every decision on the flight recorder first
+  (``GET /siddhi-apps/{name}/slo``, ``siddhi_tpu_slo_*`` gauges);
 - **device profiling** (``profiler.py`` + the step probe below).
 
 Apps without ``@app:trace`` / ``@app:profile`` pay one ``is None`` check
@@ -43,6 +49,7 @@ from .histogram import LogHistogram
 from .phases import PHASES, PhaseBreakdown, phase_of_stage
 from .profiler import DeviceProfiler, parse_profile_annotation
 from .prometheus import CONTENT_TYPE, render
+from .slo import GroupEvidence, SLOController, TenantSLO
 from .tracing import (
     PipelineTracer,
     Span,
@@ -55,8 +62,9 @@ log = logging.getLogger("siddhi_tpu.observability")
 
 __all__ = [
     "CONTENT_TYPE", "DeviceProfiler", "DeviceStepProbe", "FlightRecorder",
-    "LogHistogram", "ObservabilitySubsystem", "PHASES", "PhaseBreakdown",
-    "PipelineTracer", "Span", "Trace", "TraceContext",
+    "GroupEvidence", "LogHistogram", "ObservabilitySubsystem", "PHASES",
+    "PhaseBreakdown", "PipelineTracer", "SLOController", "Span",
+    "TenantSLO", "Trace", "TraceContext",
     "parse_flightrecorder_annotation", "parse_profile_annotation",
     "parse_trace_annotation", "phase_of_stage", "render",
 ]
@@ -391,9 +399,10 @@ class ObservabilitySubsystem:
                 "traces": self.tracer.export(limit, stream=stream)}
 
     def flight_export(self, category: Optional[str] = None,
-                      limit: Optional[int] = None) -> dict:
+                      limit: Optional[int] = None,
+                      since_ns: Optional[int] = None) -> dict:
         return {"enabled": True, **self.flight.report(),
-                "entries": self.flight.export(category, limit)}
+                "entries": self.flight.export(category, limit, since_ns)}
 
     def latency_report(self) -> dict:
         """``GET /siddhi-apps/{name}/latency``: per-query end-to-end

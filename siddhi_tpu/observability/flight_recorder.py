@@ -27,7 +27,11 @@ Design constraints (this runs on EVERY app, armed by default):
   file so post-mortems survive the process.
 
 Served at ``GET /siddhi-apps/{name}/flightrecorder`` (``?category=`` /
-``?limit=`` filters).
+``?limit=`` / ``?since_ns=`` filters). Entries carry a per-recorder
+strictly-increasing ``t_ns`` wall-clock nanosecond stamp, so ``since_ns``
+is a loss-free tail cursor: pass the largest ``t_ns`` seen and only newer
+transitions come back — the SLO controller and external pollers tail the
+bounded ring incrementally instead of re-reading it.
 """
 
 from __future__ import annotations
@@ -42,7 +46,9 @@ from typing import Optional
 
 log = logging.getLogger("siddhi_tpu.observability")
 
-# entry tuple layout (kept positional — one tuple per transition)
+# entry tuple layout (kept positional — one tuple per transition);
+# _T is wall-clock NANOSECONDS, strictly increasing per recorder (the
+# since_ns cursor contract)
 _T, _SEQ, _CAT, _KIND, _SITE, _DETAIL, _TRACE = range(7)
 
 
@@ -62,14 +68,22 @@ class FlightRecorder:
         self.recorded = 0
         self._seq = itertools.count()
         self._last_kind: dict = {}      # (category, site) -> kind
+        self._last_t_ns = 0             # monotonic-bump cursor state
 
     # -- recording (hot-path safe) --------------------------------------------
     def record(self, category: str, kind: str, site: str = "",
                detail=None, trace_id=None) -> None:
         """Append one transition. Never raises, never blocks: tuple build +
-        deque append under the GIL."""
+        deque append under the GIL. The stored nanosecond stamp is bumped
+        past the previous entry's, so ``t_ns`` is a usable tail cursor
+        (best-effort under concurrent recorders racing the bump — ``seq``
+        stays strict regardless)."""
         self.recorded += 1
-        self.ring.append((time.time(), next(self._seq), category, kind,
+        t_ns = time.time_ns()
+        if t_ns <= self._last_t_ns:
+            t_ns = self._last_t_ns + 1
+        self._last_t_ns = t_ns
+        self.ring.append((t_ns, next(self._seq), category, kind,
                           site, detail, trace_id))
 
     def record_transition(self, category: str, kind: str, site: str = "",
@@ -116,16 +130,22 @@ class FlightRecorder:
 
     # -- export ----------------------------------------------------------------
     def export(self, category: Optional[str] = None,
-               limit: Optional[int] = None) -> list[dict]:
+               limit: Optional[int] = None,
+               since_ns: Optional[int] = None) -> list[dict]:
+        """Exported entries, oldest first. ``since_ns`` tails the ring
+        incrementally: only entries with ``t_ns`` strictly greater come
+        back (pass the largest ``t_ns`` of the previous page)."""
         entries = list(self.ring)
+        if since_ns is not None:
+            entries = [e for e in entries if e[_T] > since_ns]
         if category is not None:
             entries = [e for e in entries if e[_CAT] == category]
         if limit is not None:
             entries = entries[-limit:] if limit > 0 else []
         out = []
         for e in entries:
-            d = {"t": e[_T], "seq": e[_SEQ], "category": e[_CAT],
-                 "kind": e[_KIND], "site": e[_SITE]}
+            d = {"t": e[_T] / 1e9, "t_ns": e[_T], "seq": e[_SEQ],
+                 "category": e[_CAT], "kind": e[_KIND], "site": e[_SITE]}
             if e[_DETAIL] is not None:
                 d["detail"] = e[_DETAIL]
             if e[_TRACE] is not None:

@@ -86,25 +86,61 @@ class LogHistogram:
                 self._exemplars[i] = (str(exemplar), v, time.time())
 
     # -- readouts --------------------------------------------------------------
+    def _percentile_of(self, counts, count: int, q: float,
+                       cap: Optional[float]) -> float:
+        """Percentile over an arbitrary counts array sharing this ladder's
+        bucket bounds (``cap`` bounds the overflow-bucket answer)."""
+        if count == 0:
+            return 0.0
+        rank = max(1, math.ceil(q * count))
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank:
+                if i >= len(self._bounds):          # overflow bucket
+                    return cap if cap is not None else self._bounds[-1]
+                return min(self._bounds[i],
+                           cap if cap is not None else self._bounds[i])
+        return cap or 0.0                           # unreachable
+
     def percentile(self, q: float) -> float:
         """Upper bucket bound at quantile ``q`` in [0, 1] (0.0 when empty).
         Conservative: the true sample quantile is ≤ the returned value and
         > returned/growth."""
         with self._lock:
-            if self.count == 0:
-                return 0.0
-            rank = max(1, math.ceil(q * self.count))
-            cum = 0
-            for i, c in enumerate(self._counts):
-                cum += c
-                if cum >= rank:
-                    if i >= len(self._bounds):      # overflow bucket
-                        return self.max if self.max is not None \
-                            else self._bounds[-1]
-                    return min(self._bounds[i],
-                               self.max if self.max is not None
-                               else self._bounds[i])
-            return self.max or 0.0                  # unreachable
+            return self._percentile_of(self._counts, self.count, q, self.max)
+
+    # -- interval snapshots (the control-plane view) ---------------------------
+    def checkpoint(self) -> tuple:
+        """Opaque cursor over the current bucket state. Feed it back to
+        :meth:`since` for a WINDOWED snapshot — cumulative-since-start
+        percentiles flatten out as history accumulates and cannot drive a
+        control loop (a ten-minute-old tail masks the last 200ms)."""
+        with self._lock:
+            return (list(self._counts), self.count, self.sum)
+
+    def since(self, chk: tuple) -> dict:
+        """Percentile snapshot over the samples recorded AFTER ``chk`` was
+        taken — the interval view the SLO controller samples. Returns the
+        same shape as :meth:`snapshot` minus min/max (not tracked per
+        interval; p-values are upper bucket bounds, so they stay
+        conservative)."""
+        prev_counts, prev_count, prev_sum = chk
+        with self._lock:
+            d_counts = [c - p for c, p in zip(self._counts, prev_counts)]
+            d_count = self.count - prev_count
+            d_sum = self.sum - prev_sum
+            if d_count <= 0:
+                return {"count": 0, "sum": 0.0, "avg": 0.0, "p50": 0.0,
+                        "p90": 0.0, "p99": 0.0}
+            return {
+                "count": d_count,
+                "sum": d_sum,
+                "avg": d_sum / d_count,
+                "p50": self._percentile_of(d_counts, d_count, 0.50, self.max),
+                "p90": self._percentile_of(d_counts, d_count, 0.90, self.max),
+                "p99": self._percentile_of(d_counts, d_count, 0.99, self.max),
+            }
 
     def export(self) -> tuple[list[tuple[float, int]], int, float]:
         """One consistent ``(buckets, count, sum)`` read under the lock —

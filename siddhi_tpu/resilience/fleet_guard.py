@@ -71,6 +71,11 @@ _DEF_READMIT_BATCHES = 3    # clean solo batches required to re-admit
 _DEF_DICT_CAP = 65536       # per-tenant distinct new strings
 
 
+class PolicyEviction(Exception):
+    """Marker 'fault' carried as the eject reason when the SLO autopilot
+    (not a lane fault) ejects a tenant to its solo tier — never raised."""
+
+
 def build_scalar_escalation(query, app_context, stream_defs: dict,
                             get_junction, name: str, shared_callbacks,
                             site: str):
@@ -140,6 +145,13 @@ class TenantLane:
         self.solo_batches = 0       # clean solo batches since ejection
         self.solo_events = 0
         self.eject_reason: Optional[str] = None
+        self.policy_hold = False    # SLO-autopilot ejection: auto-readmit
+        # is suspended until the controller releases the hold
+        self.policy_quota: Optional[int] = None   # SLO-autopilot hard
+        # per-window admit cap: unlike max_lag (which steps the group to
+        # open a new window — backpressure, no loss), excess over this
+        # quota SHEDS even when the engine could keep up, so a noisy
+        # neighbour's burst cannot buy itself extra shared steps
         self.escalated = False      # scalar tier reached (one-way; set
         # synchronously at the escalation decision — the runtime itself
         # builds lazily on the deferred replay path)
@@ -175,6 +187,8 @@ class TenantLane:
             "query": self.member.query_name,
             "ejected": self.ejected,
             "eject_reason": self.eject_reason,
+            "policy_hold": self.policy_hold,
+            "policy_quota": self.policy_quota,
             "circuit": self.breaker.state,
             "ejections": self.ejections,
             "readmissions": self.readmissions,
@@ -241,8 +255,54 @@ class FleetGuard:
                 "fleet", "shed", site=f"fleet:{member.query_name}",
                 detail={"tenant": member.tenant, "shed_total": lane.shed})
 
+    def adopt(self, member, lane: TenantLane) -> None:
+        """Re-register an EXISTING lane under this guard (FleetGroup.split
+        moves members between sibling groups; their breakers, shed/poison
+        counters and solo tiers must survive the move)."""
+        self.lanes[member.mid] = lane
+        member.lane = lane
+
     def detach(self, member) -> None:
         self.lanes.pop(member.mid, None)
+
+    # -- policy ejection (the SLO autopilot's actuator surface) -------------
+    def policy_eject(self, member, reason: str) -> bool:
+        """Controller-driven ejection to the solo tier — same mechanics as
+        a fault ejection (private stager over the shared plan, state
+        continuity) but with the auto-readmit path held until
+        :meth:`policy_readmit` releases it. Caller holds the group lock."""
+        lane = self.lanes.get(member.mid)
+        if lane is None or member.ejected:
+            return False
+        lane.policy_hold = True
+        self._eject(member, lane, PolicyEviction(reason))
+        return True
+
+    def policy_readmit(self, member) -> bool:
+        """Release a policy hold and re-join the group immediately (state
+        stepped solo through the shared plan, so re-entry needs no
+        translation). Escalated lanes stay solo — the scalar tier owns
+        their state (same one-way contract as fault escalation)."""
+        lane = self.lanes.get(member.mid)
+        if lane is None or not lane.policy_hold:
+            return False
+        lane.policy_hold = False
+        if lane.escalated:
+            return False
+        if member.ejected:
+            # drain the solo tier first (may itself auto-readmit now that
+            # the hold is released — don't double-count that)
+            self.flush_solo(member, lane, cause="policy-readmit")
+        if member.ejected:
+            member.ejected = False
+            lane.readmissions += 1
+            lane.eject_reason = None
+            fl = self._flight(member)
+            if fl is not None:
+                fl.record("fleet", "readmitted",
+                          site=f"fleet:{member.query_name}",
+                          detail={"tenant": member.tenant, "policy": True})
+        return True
 
     # -- staging: fair share + dictionary caps ------------------------------
     def admit(self, member, gsid: str, rows: list) -> int:
@@ -286,6 +346,19 @@ class FleetGuard:
         """max_lag fair-share quota: how many LEADING rows may stage."""
         k = n
         lane.observe_arrival(n)
+        pq = lane.policy_quota
+        if pq is not None:
+            # SLO-autopilot shed: hard cap per flush window, no
+            # step-to-open-a-new-window escape — the overflow drops
+            allowed = pq - lane.staged_window
+            if allowed <= 0:
+                lane.shed += k
+                self._record_shed(member, lane)
+                return 0
+            if allowed < k:
+                lane.shed += k - allowed
+                k = allowed
+                self._record_shed(member, lane)
         if member.max_lag:
             fl = self._flight(member)
             allowed = member.max_lag - lane.staged_window
@@ -297,7 +370,9 @@ class FleetGuard:
                 self.group._step("quota")
                 allowed = member.max_lag - lane.staged_window
             if allowed <= 0:
-                lane.shed += n
+                # shed only the rows still in play (k, not n — the policy
+                # quota above may already have shed and counted a prefix)
+                lane.shed += k
                 self._record_shed(member, lane)
                 return 0
             if allowed < k:
@@ -865,6 +940,11 @@ class FleetGuard:
 
     def _maybe_readmit(self, m, lane: TenantLane) -> None:
         if not m.ejected or lane.solo_batches < self.readmit_batches:
+            return
+        if lane.policy_hold:
+            # the SLO autopilot ejected this lane deliberately: it comes
+            # back when the controller releases the hold, not on the
+            # fault-recovery clock
             return
         if lane.escalated:
             # the ladder's bottom is one-way: the scalar interpreter owns

@@ -43,7 +43,14 @@ on request. Endpoints (stdlib http.server, threaded; no framework deps):
                                              reconciliation
     GET    /siddhi-apps/{name}/flightrecorder
                                              control-plane transition ring
-                                             (?category=, ?limit= filters)
+                                             (?category=, ?limit=,
+                                             ?since_ns= incremental-tail
+                                             cursor filters)
+    GET    /siddhi-apps/{name}/slo           SLO-autopilot state: per-query
+                                             class/budget vs windowed p99,
+                                             controller decisions + ladder
+                                             position (fleet tenants with
+                                             @app:fleet slo.* keys)
     DELETE /siddhi-apps/{name}               undeploy (shutdown + forget)
     POST   /siddhi-apps/{name}/streams/{sid} body = JSON {"data": [...],
                                              "timestamp": ms?} → send event
@@ -104,17 +111,20 @@ class SiddhiService:
             def _parse_limit(self, query: dict):
                 """``?limit=`` → (ok, limit|None); replies 400 itself on a
                 malformed value (shared by the ring-paging endpoints)."""
-                limit = query.get("limit")
+                return self._parse_nonneg(query, "limit")
+
+            def _parse_nonneg(self, query: dict, key: str):
+                value = query.get(key)
                 try:
-                    limit = int(limit) if limit else None
-                    if limit is not None and limit < 0:
-                        raise ValueError(limit)
+                    value = int(value) if value else None
+                    if value is not None and value < 0:
+                        raise ValueError(value)
                 except ValueError:
                     self._reply(400, {
                         "status": "ERROR",
-                        "message": "limit must be a non-negative integer"})
+                        "message": f"{key} must be a non-negative integer"})
                     return False, None
-                return True, limit
+                return True, value
 
             def do_POST(self):
                 parts = [p for p in self.path.split("/") if p]
@@ -175,8 +185,15 @@ class SiddhiService:
                     ok, limit = self._parse_limit(query)
                     if not ok:
                         return
+                    ok, since_ns = self._parse_nonneg(query, "since_ns")
+                    if not ok:
+                        return
                     code, payload = service.flight_export(
-                        parts[1], query.get("category"), limit)
+                        parts[1], query.get("category"), limit, since_ns)
+                    self._reply(code, payload)
+                elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                        and parts[2] == "slo":
+                    code, payload = service.slo_stats(parts[1])
                     self._reply(code, payload)
                 elif len(parts) == 3 and parts[0] == "siddhi-apps" \
                         and parts[2] == "status":
@@ -399,17 +416,44 @@ class SiddhiService:
         return 200, {"status": "OK", **rt.observability.latency_report()}
 
     def flight_export(self, name: str, category: Optional[str] = None,
-                      limit: Optional[int] = None) -> tuple[int, dict]:
+                      limit: Optional[int] = None,
+                      since_ns: Optional[int] = None) -> tuple[int, dict]:
         """The app's flight-recorder ring: timestamped control-plane
         transitions (AIMD resizes, flush causes, breaker flips, ejections,
-        takeovers), trace-cross-referenced where provoked by a traced
-        batch."""
+        SLO decisions, takeovers), trace-cross-referenced where provoked
+        by a traced batch. ``since_ns`` tails the ring incrementally: pass
+        the largest ``t_ns`` already seen, only newer entries return."""
         rt = self.runtimes.get(name)
         if rt is None:
             return 404, {"status": "ERROR",
                          "message": f"no app '{name}' deployed"}
         return 200, {"status": "OK",
-                     **rt.observability.flight_export(category, limit)}
+                     **rt.observability.flight_export(category, limit,
+                                                      since_ns)}
+
+    def slo_stats(self, name: str) -> tuple[int, dict]:
+        """SLO-autopilot state for one tenant app: its queries' declared
+        class/budget against the windowed measured p99, plus each attached
+        group controller's ladder position and recent decision log."""
+        rt = self.runtimes.get(name)
+        if rt is None:
+            return 404, {"status": "ERROR",
+                         "message": f"no app '{name}' deployed"}
+        queries, controllers, seen = [], [], set()
+        for b in getattr(rt, "fleet_bridges", []):
+            member = b.member
+            group = member.group if member.group is not None else b.group
+            t = getattr(member, "slo", None)
+            if t is not None:
+                queries.append(t.report())
+            ctrl = getattr(group, "slo", None)
+            if ctrl is not None and id(ctrl) not in seen:
+                seen.add(id(ctrl))
+                controllers.append(ctrl.report())
+        if not queries and not controllers:
+            return 200, {"status": "OK", "enabled": False}
+        return 200, {"status": "OK", "enabled": True, "queries": queries,
+                     "controllers": controllers}
 
     def resilience_stats(self, name: str) -> tuple[int, dict]:
         """Sink circuits/retries, device quarantine, chaos counters."""

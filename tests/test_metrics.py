@@ -111,11 +111,14 @@ def test_guard_metric_families_unregister_on_shutdown():
     families ride the same prefixes: fleet.tenant.* (ejections/readmit/
     shed/circuit) and the host_batch.{q}.circuit_state /fallback_events
     gauges must disappear with their app — a stopped tenant must not leak
-    dead gauges into the engine-wide exposition."""
+    dead gauges into the engine-wide exposition. PR 12's slo.* compliance
+    families (p99_budget_ms/p99_window_ms/compliant/class_code/
+    decisions_total) ride the same contract."""
     m = SiddhiManager()
     try:
         rt = m.create_siddhi_app_runtime(
-            "@app(name='gm0')\n@app:fleet(batch='64')\n"
+            "@app(name='gm0')\n"
+            "@app:fleet(batch='64', slo.p99.ms='50', slo.class='premium')\n"
             "define stream S (sym string, v double);\n"
             "@info(name='fq') from S[v > 1.0] select v insert into Out;",
             playback=True)
@@ -125,9 +128,15 @@ def test_guard_metric_families_unregister_on_shutdown():
         assert gauges["fleet.tenant.fq.ejections"].value == 0
         assert gauges["fleet.tenant.fq.circuit_state"].value == 0
         assert gauges["fleet.solo_fallbacks"].value == 0
+        assert gauges["slo.fq.p99_budget_ms"].value == 50.0
+        assert gauges["slo.fq.compliant"].value == 1
+        assert gauges["slo.fq.class_code"].value == 2
+        assert gauges["slo.fq.decisions_total"].value == 0
         rt.shutdown()
         snap = sm.snapshot_trackers()
         assert not any(k.startswith("fleet.")
+                       for d in snap.values() for k in d)
+        assert not any(k.startswith("slo.")
                        for d in snap.values() for k in d)
 
         hrt = m.create_siddhi_app_runtime(

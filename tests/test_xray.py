@@ -572,6 +572,70 @@ def test_device_quarantine_lands_on_flight_recorder(manager):
         sorted(e["t"] for e in all_entries)
 
 
+def test_flightrecorder_since_ns_cursor():
+    """Satellite pin (ISSUE 12): the ring is tailable incrementally — the
+    SLO controller and external pollers pass the largest ``t_ns`` seen
+    and get only newer transitions, loss-free (per-recorder ``t_ns`` is
+    strictly increasing by construction)."""
+    fr = FlightRecorder(capacity=64)
+    for i in range(10):
+        fr.record("flow", f"k{i}", site="s")
+    entries = fr.export()
+    t_ns = [e["t_ns"] for e in entries]
+    assert t_ns == sorted(t_ns) and len(set(t_ns)) == 10, \
+        "t_ns must be strictly increasing (the cursor contract)"
+    cursor = entries[3]["t_ns"]
+    tail = fr.export(since_ns=cursor)
+    assert [e["kind"] for e in tail] == [f"k{i}" for i in range(4, 10)]
+    # composes with category and limit
+    fr.record("fleet", "ejected", site="s")
+    assert [e["kind"] for e in fr.export(category="fleet",
+                                         since_ns=cursor)] == ["ejected"]
+    assert len(fr.export(since_ns=cursor, limit=2)) == 2
+    # past-the-end cursor → empty page, and new records resume the tail
+    end = fr.export()[-1]["t_ns"]
+    assert fr.export(since_ns=end) == []
+    fr.record("flow", "k10", site="s")
+    assert [e["kind"] for e in fr.export(since_ns=end)] == ["k10"]
+
+
+def test_flightrecorder_since_ns_http(manager):
+    from siddhi_tpu.service import SiddhiService
+    svc = SiddhiService(manager, port=0)
+    rt = manager.create_siddhi_app_runtime(
+        "@app(name='FRTail')\n"
+        "define stream S (v double);\n"
+        "from S[v > 0.0] select v insert into Out;", playback=True)
+    rt.start()
+    svc.runtimes = {rt.name: rt}
+    svc.start()
+    try:
+        for i in range(5):
+            rt.ctx.flight.record("flow", f"k{i}", site="q")
+        conn = http.client.HTTPConnection("127.0.0.1", svc.port,
+                                          timeout=10)
+        conn.request("GET", "/siddhi-apps/FRTail/flightrecorder")
+        body = json.loads(conn.getresponse().read().decode())
+        assert len(body["entries"]) == 5
+        cursor = body["entries"][2]["t_ns"]
+        conn.request("GET", "/siddhi-apps/FRTail/flightrecorder"
+                     f"?since_ns={cursor}")
+        body = json.loads(conn.getresponse().read().decode())
+        assert [e["kind"] for e in body["entries"]] == ["k3", "k4"]
+        # the incremental poll loop: nothing new → empty page
+        cursor = body["entries"][-1]["t_ns"]
+        conn.request("GET", "/siddhi-apps/FRTail/flightrecorder"
+                     f"?since_ns={cursor}")
+        body = json.loads(conn.getresponse().read().decode())
+        assert body["entries"] == []
+        conn.request("GET",
+                     "/siddhi-apps/FRTail/flightrecorder?since_ns=bogus")
+        assert conn.getresponse().status == 400
+        conn.close()
+    finally:
+        svc.stop()
+
+
 def test_flightrecorder_http_endpoint(manager):
     from siddhi_tpu.service import SiddhiService
     svc = SiddhiService(manager, port=0)
