@@ -125,6 +125,21 @@ SLO_BUDGET_MS = float(os.environ.get("BENCH_SLO_BUDGET_MS", 100.0))
 # must OPEN in violation (fill-wait past the budget) so the report shows
 # the loop closing it, not a scenario that was never stressed
 SLO_BATCH = int(os.environ.get("BENCH_SLO_BATCH", 65536))
+# mesh-fabric scenario (--mesh-child): the tenant population placed across
+# a forced-host multi-device mesh (XLA_FLAGS
+# --xla_force_host_platform_device_count=N, the MULTICHIP_r05 setup) —
+# placement quality (shape-locality vs random: compiled programs per host,
+# lanes per step), scaling curves of the Kleene anomaly workload over mesh
+# sizes, a live migration under sustained ingest, and a host leave/join
+# elasticity cycle, all exactly-once vs solo oracles
+MESH_HOSTS = int(os.environ.get("BENCH_MESH_HOSTS", 8))
+MESH_PLACE_TENANTS = int(os.environ.get("BENCH_MESH_PLACE_TENANTS", 1024))
+MESH_SHAPES = int(os.environ.get("BENCH_MESH_SHAPES", 8))
+MESH_PLACE_FEED = int(os.environ.get("BENCH_MESH_PLACE_FEED", 256))
+MESH_SCALE_TENANTS = int(os.environ.get("BENCH_MESH_SCALE_TENANTS", 2))
+MESH_FEED = int(os.environ.get("BENCH_MESH_FEED", 4000))
+MESH_CHUNK = int(os.environ.get("BENCH_MESH_CHUNK", 64))
+MESH_DEADLINE_S = int(os.environ.get("BENCH_MESH_DEADLINE_S", 900))
 HOST_DEADLINE_S = int(os.environ.get("BENCH_HOST_DEADLINE_S", 300))
 FLEET_DEADLINE_S = int(os.environ.get("BENCH_FLEET_DEADLINE_S", 300))
 SLO_DEADLINE_S = int(os.environ.get("BENCH_SLO_DEADLINE_S", 240))
@@ -1416,6 +1431,282 @@ def child_slo() -> None:
     print(json.dumps(out))
 
 
+def _mesh_shape_app(i: int, shape: int, ann: str) -> str:
+    """Tenant i of structural shape ``shape``: filter conjunct count and
+    select-list length are STRUCTURAL (different fleet fingerprints), the
+    thresholds stay per-tenant constants (hoisted to params — tenants of
+    one shape still share one compiled program)."""
+    terms = " and ".join(
+        [f"v > {80.0 + i % 8}"] + [f"v < {200.0 + j}"
+                                   for j in range(shape % 4)])
+    sel = ", ".join(["dev", "v"] + [f"v * {1.5 + j} as x{j}"
+                                    for j in range(shape // 4 + 1)])
+    return (f"@app(name='mtenant-{i}')\n{ann}"
+            f"define stream S (dev string, v double);\n"
+            f"@info(name='rule')\n"
+            f"from S[{terms}] select {sel} insert into Alerts;\n")
+
+
+def _mesh_kleene_app(i: int, ann: str) -> str:
+    """Tenant i's Kleene anomaly rule: the BASELINE.json config-#5 family
+    (rising chain over the 64-way partitioned synthetic IoT stream) sized
+    for the CPU fleet tier — the scaling line's workload."""
+    return (f"@app(name='kleene-{i}')\n{ann}"
+            f"define stream S (dev string, v double);\n"
+            f"partition with (dev of S)\nbegin\n"
+            f"from every e1=S[v > {90.0 + (i % 8) * 0.25}] -> e2=S[v > e1.v]"
+            f" -> e3=S[v > e2.v] within 4000\n"
+            f"select e1.v as v1, e2.v as v2, e3.v as v3 insert into Alerts;"
+            f"\nend;\n")
+
+
+def _mesh_feed_all(fabric, tenant_ids, rows, tss, chunk, threads=None):
+    """Per-host feeder threads drive every tenant's chunks through the
+    fabric ingress (each host's tenants fed from one thread — the
+    per-host DCN-ingest model). Returns wall seconds."""
+    import threading as _th
+    by_host = {}
+    for t in tenant_ids:
+        by_host.setdefault(fabric.tenants[t].host, []).append(t)
+
+    def feed(tids):
+        for s in range(0, len(rows), chunk):
+            c = rows[s:s + chunk]
+            t = tss[s:s + chunk]
+            for tid in tids:
+                fabric.send(tid, "S", c, t)
+
+    t0 = time.perf_counter()
+    ths = [_th.Thread(target=feed, args=(tids,))
+           for tids in by_host.values()]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join()
+    fabric.flush()
+    return time.perf_counter() - t0
+
+
+def child_mesh() -> None:
+    """Mesh-fabric evidence: placement quality at population scale,
+    ev/s-per-chip scaling curves, live migration + elasticity under
+    sustained ingest — the MULTICHIP_r06 line (ROADMAP item 3)."""
+    import tempfile
+
+    from siddhi_tpu import SiddhiManager, StreamCallback
+    from siddhi_tpu.mesh import MeshConfig, MeshFabric
+
+    fleet_ann = f"@app:fleet(batch='{FLEET_BATCH}', lanes='{HOST_LANES}')\n"
+    out = {"hosts": MESH_HOSTS, "devices": None}
+    try:
+        import jax
+        out["devices"] = len(jax.devices())
+        out["platform"] = jax.devices()[0].platform
+    except Exception as e:  # noqa: BLE001 — device binding is metadata
+        out["device_probe_error"] = str(e)
+
+    # -- 1) placement quality: locality vs random at population scale ------
+    T, H = MESH_PLACE_TENANTS, MESH_HOSTS
+    cap = (T + H - 1) // H            # equal fill: policies differ ONLY in
+    # which tenants co-locate, not how many land per host
+    feed = gen_events(MESH_PLACE_FEED)
+    prows = [[dev, v] for dev, v, _ in feed]
+    ptss = [ts for _, _, ts in feed]
+    placement = {}
+    for policy in ("locality", "random"):
+        t0 = time.perf_counter()
+        fab = MeshFabric(H, tempfile.mkdtemp(prefix=f"mesh-{policy}-"),
+                         MeshConfig(capacity_per_host=cap, policy=policy))
+        fab.add_tenants([
+            _mesh_shape_app(i, i % MESH_SHAPES, fleet_ann)
+            for i in range(T)])
+        deploy_s = time.perf_counter() - t0
+        wall = _mesh_feed_all(fab, [f"mtenant-{i}" for i in range(T)],
+                              prows, ptss, MESH_CHUNK)
+        ev = fab.evidence()
+        compiles = [e["compiled_programs"] for e in ev.values()]
+        lanes = [e["lanes_per_step"] for e in ev.values()
+                 if e["lanes_per_step"]]
+        placement[policy] = {
+            "tenants_per_host": [e["tenants"] for e in ev.values()],
+            "compiles_per_host": compiles,
+            "compiles_per_host_mean": sum(compiles) / len(compiles),
+            "lanes_per_step_mean": (sum(lanes) / len(lanes)) if lanes
+            else 0.0,
+            "evps": round(T * MESH_PLACE_FEED / wall) if wall else 0,
+            "deploy_s": round(deploy_s, 2),
+        }
+        fab.close()
+        print(f"# mesh placement {policy}: compiles/host="
+              f"{placement[policy]['compiles_per_host_mean']:.2f} "
+              f"lanes/step={placement[policy]['lanes_per_step_mean']:.1f} "
+              f"tenants/host={placement[policy]['tenants_per_host']}",
+              file=sys.stderr)
+    out["placement"] = {
+        "tenants": T, "shapes": MESH_SHAPES, "feed_events": MESH_PLACE_FEED,
+        **{f"{k}_{policy}": v
+           for policy, p in placement.items() for k, v in p.items()},
+        "compile_advantage":
+            placement["random"]["compiles_per_host_mean"]
+            / max(placement["locality"]["compiles_per_host_mean"], 1e-9),
+        "lanes_advantage":
+            placement["locality"]["lanes_per_step_mean"]
+            / max(placement["random"]["lanes_per_step_mean"], 1e-9),
+    }
+
+    # -- 2) scaling: the Kleene anomaly workload over mesh sizes -----------
+    sizes = [s for s in (1, 2, 4, 8) if s <= MESH_HOSTS]
+    kfeed = gen_events(MESH_FEED)
+    krows = [[dev, v] for dev, v, _ in kfeed]
+    ktss = [ts for _, _, ts in kfeed]
+    scaling = {}
+    base_evps = None
+    for size in sizes:
+        fab = MeshFabric(size, tempfile.mkdtemp(prefix=f"mesh-s{size}-"),
+                         MeshConfig(capacity_per_host=MESH_SCALE_TENANTS))
+        k = MESH_SCALE_TENANTS * size
+        fab.add_tenants([_mesh_kleene_app(i, fleet_ann) for i in range(k)])
+        tids = [f"kleene-{i}" for i in range(k)]
+        # per-tenant slots: one tenant's callbacks fire on ONE feeder
+        # thread, so disjoint slots need no lock (a shared accumulator
+        # would lose increments across the per-host threads)
+        kmatches = [0] * k
+        for j, tid in enumerate(tids):
+            fab.add_callback(tid, "Alerts",
+                             lambda evs, j=j: kmatches.__setitem__(
+                                 j, kmatches[j] + len(evs)))
+        # short warm pass (numpy kernels, dictionary encode)
+        _mesh_feed_all(fab, tids, krows[:max(MESH_CHUNK, 256)],
+                       ktss[:max(MESH_CHUNK, 256)], MESH_CHUNK)
+        wall = _mesh_feed_all(fab, tids, krows, ktss, MESH_CHUNK)
+        total = k * MESH_FEED
+        evps = total / wall if wall else 0.0
+        if base_evps is None:
+            base_evps = evps
+        scaling[str(size)] = {
+            "tenants": k, "evps": round(evps),
+            "evps_per_chip": round(evps / size),
+            "scaling_efficiency": round(evps / (size * base_evps), 3)
+            if base_evps else 0.0,
+            # REAL Kleene match emissions (counted at the callbacks) —
+            # events_in would be ingress, not matches
+            "match_total": sum(kmatches),
+            "events_in_total": sum(
+                e["events_in"] for e in fab.evidence().values()),
+        }
+        fab.close()
+        print(f"# mesh scaling x{size}: {scaling[str(size)]['evps']:,} "
+              f"ev/s ({scaling[str(size)]['evps_per_chip']:,}/chip, "
+              f"eff={scaling[str(size)]['scaling_efficiency']})",
+              file=sys.stderr)
+    out["scaling"] = scaling
+    out["scaling_efficiency_max_size"] = \
+        scaling[str(sizes[-1])]["scaling_efficiency"]
+    out["scaling_note"] = (
+        "in-process mesh on a shared-GIL container: per-host feeder "
+        "threads contend for the same cores, so efficiency here measures "
+        "fabric plumbing overhead, not chip scaling — hardware curves "
+        "need one OS process per host over the DCN tier")
+
+    # -- 3) live migration under sustained ingest (exactly-once) -----------
+    K = 4
+    fab = MeshFabric(2, tempfile.mkdtemp(prefix="mesh-mig-"),
+                     MeshConfig(capacity_per_host=K))
+    fab.add_tenants([_mesh_shape_app(i, 0, fleet_ann) for i in range(K)])
+    counts = {i: [] for i in range(K)}
+    for i in range(K):
+        fab.add_callback(f"mtenant-{i}", "Alerts",
+                         lambda evs, i=i: counts[i].extend(
+                             tuple(e.data) for e in evs))
+    chunks = [(krows[s:s + MESH_CHUNK], ktss[s:s + MESH_CHUNK])
+              for s in range(0, MESH_FEED, MESH_CHUNK)]
+    half = len(chunks) // 2
+    mig_wall = 0.0
+    for ci, (c, t) in enumerate(chunks):
+        if ci == half:
+            src = fab.tenants["mtenant-0"].host
+            t0 = time.perf_counter()
+            fab.migrate("mtenant-0", 1 - src, reason="bench")
+            mig_wall = time.perf_counter() - t0
+        for i in range(K):
+            fab.send(f"mtenant-{i}", "S", c, t)
+    fab.flush()
+    mesh_counts = {i: list(counts[i]) for i in range(K)}
+    fab.close()
+    # solo oracles: each tenant alone on one manager, same feed
+    oracle_ok = True
+    m = SiddhiManager()
+    for i in range(K):
+        rt = m.create_siddhi_app_runtime(
+            _mesh_shape_app(i, 0, ""), playback=True)
+        solo = []
+        rt.add_callback("Alerts", StreamCallback(
+            lambda evs, solo=solo: solo.extend(tuple(e.data) for e in evs)))
+        rt.start()
+        ih = rt.input_handler("S")
+        for c, t in chunks:
+            ih.send_rows([list(r) for r in c], list(t))
+        if solo != mesh_counts[i]:
+            oracle_ok = False
+    m.shutdown()
+    out["migration"] = {"tenants": K, "moves": 1,
+                        "wall_ms": round(mig_wall * 1e3, 1),
+                        "oracle_ok": oracle_ok}
+    print(f"# mesh migration: {mig_wall * 1e3:.0f}ms, oracle_ok="
+          f"{oracle_ok}", file=sys.stderr)
+
+    # -- 4) elasticity: host leave + rejoin under sustained ingest ---------
+    # two FULL hosts (capacity = tenants/2): the join's balanced recompute
+    # must shed load onto the newcomer (bulk adoption), the leave must
+    # bulk-migrate it back — all exactly-once vs solo oracles
+    KE = 6
+    fab = MeshFabric(2, tempfile.mkdtemp(prefix="mesh-ela-"),
+                     MeshConfig(capacity_per_host=KE // 2))
+    fab.add_tenants([_mesh_shape_app(i, i % 2, fleet_ann)
+                     for i in range(KE)])
+    ecounts = {i: [] for i in range(KE)}
+    for i in range(KE):
+        fab.add_callback(f"mtenant-{i}", "Alerts",
+                         lambda evs, i=i: ecounts[i].extend(
+                             tuple(e.data) for e in evs))
+    third = len(chunks) // 3
+    moves = join_moves = 0
+    for ci, (c, t) in enumerate(chunks):
+        if ci == third:
+            before = fab.migrations
+            new_host = fab.add_host(capacity=KE)    # join → bulk adoption
+            join_moves = fab.migrations - before
+        if ci == 2 * third:
+            moves = fab.remove_host(new_host)       # leave → bulk adoption
+        for i in range(KE):
+            fab.send(f"mtenant-{i}", "S", c, t)
+    fab.flush()
+    ela_ok = True
+    m = SiddhiManager()
+    for i in range(KE):
+        rt = m.create_siddhi_app_runtime(
+            _mesh_shape_app(i, i % 2, ""), playback=True)
+        solo = []
+        rt.add_callback("Alerts", StreamCallback(
+            lambda evs, solo=solo: solo.extend(tuple(e.data) for e in evs)))
+        rt.start()
+        ih = rt.input_handler("S")
+        for c, t in chunks:
+            ih.send_rows([list(r) for r in c], list(t))
+        if solo != ecounts[i]:
+            ela_ok = False
+    m.shutdown()
+    ela_report = fab.report()
+    fab.close()
+    out["elasticity"] = {"join_moves": join_moves, "leave_moves": moves,
+                         "migrations": ela_report["migrations"],
+                         "recoveries": ela_report["recoveries"],
+                         "oracle_ok": ela_ok}
+    print(f"# mesh elasticity: join moved {join_moves}, leave moved "
+          f"{moves}, oracle_ok={ela_ok}", file=sys.stderr)
+    print(json.dumps(out))
+
+
 # ---------------------------------------------------------------------------
 # parent: orchestration (no jax import — immune to backend-init hangs)
 # ---------------------------------------------------------------------------
@@ -1816,5 +2107,7 @@ if __name__ == "__main__":
         child_slo()
     elif len(sys.argv) > 1 and sys.argv[1] == "--edge-child":
         child_edge()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--mesh-child":
+        child_mesh()
     else:
         main()

@@ -24,6 +24,14 @@ vs BASELINE.json ``slo_baseline``: premium p99 within the declared budget
 (ceiling scaled by 1/tol), ZERO premium sheds, best-effort absorbing the
 shedding, and at least one controller decision taken.
 
+A ``mesh`` guard (``run_mesh_guard``) runs a fresh ``bench.py
+--mesh-child`` (reduced tenant population over the 8-device forced-host
+mesh) and pins the fabric's contract vs BASELINE.json ``mesh_baseline``:
+shape-locality placement measurably better than random (compiled programs
+per host, lanes per step), the live migration and the host join/leave
+elasticity cycle exactly-once vs solo oracles, and the cross-host scaling
+efficiency above its (plumbing) floor.
+
 A ``device_latency`` guard (``run_device_latency_guard``) additionally pins
 the double-buffered pipeline's recorded evidence: when a bench report with a
 ``latency_mode`` line exists, its p99 must stay under
@@ -319,6 +327,122 @@ def run_slo_guard(tol: float, deadline_s: int = 420) -> int:
     return 1 if failures else 0
 
 
+def run_mesh_guard(tol: float, deadline_s: int = 600) -> int:
+    """Mesh-fabric line vs BASELINE.json ``mesh_baseline``: a fresh
+    ``bench.py --mesh-child`` (reduced tenant population) must keep
+
+    1. shape-locality placement measurably better than random — the
+       random/locality compiled-programs-per-host ratio above the stored
+       floor scaled by ``tol``, and locality's lanes-per-step strictly
+       above random's (the whole point of the placement layer);
+    2. the live migration exactly-once (per-tenant solo-oracle
+       byte-identical — binary, no band);
+    3. the elasticity cycle ENGAGED (host join and leave each bulk-moved
+       at least one tenant) and exactly-once;
+    4. cross-host scaling efficiency at the largest mesh size above the
+       stored floor scaled by ``tol`` (an in-process-mesh plumbing bound
+       on this container — see the report's scaling_note; hardware curves
+       come from the DCN tier)."""
+    with open(os.path.join(REPO, "BASELINE.json")) as f:
+        baseline = json.load(f).get("mesh_baseline") or {}
+    if not baseline:
+        print(json.dumps({"mesh_guard": "skipped",
+                          "reason": "no mesh_baseline in BASELINE.json"}))
+        return 0
+    adv_floor = tol * float(
+        baseline.get("placement_compile_advantage_min", 4.0))
+    eff_floor = tol * float(baseline.get("scaling_efficiency_min", 0.08))
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "BENCH_MESH_PLACE_TENANTS":
+            os.environ.get("BENCH_GUARD_MESH_TENANTS", "128"),
+        "BENCH_MESH_FEED": os.environ.get("BENCH_GUARD_MESH_FEED", "1200"),
+        "BENCH_MESH_PLACE_FEED": "96",
+    }
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--mesh-child"],
+            capture_output=True, text=True, timeout=deadline_s, env=env,
+            cwd=REPO)
+    except subprocess.TimeoutExpired:
+        print(f"GUARD: mesh bench exceeded {deadline_s}s", file=sys.stderr)
+        return 2
+    if p.returncode != 0:
+        tail = (p.stderr or "").strip().splitlines()[-6:]
+        print("GUARD: mesh bench failed: " + " | ".join(tail),
+              file=sys.stderr)
+        return 2
+    data = None
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            data = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    if data is None:
+        print("GUARD: no JSON in mesh bench output", file=sys.stderr)
+        return 2
+
+    failures = []
+    place = data.get("placement") or {}
+    adv = place.get("compile_advantage") or 0.0
+    if adv < adv_floor:
+        failures.append(
+            f"shape-locality compile advantage {adv:.2f}x below the floor "
+            f"{adv_floor:.2f}x ({tol} x stored "
+            f"{baseline.get('placement_compile_advantage_min')})")
+    if not (place.get("lanes_per_step_mean_locality", 0)
+            > place.get("lanes_per_step_mean_random", 0)):
+        failures.append(
+            "locality placement did not widen lane packing "
+            f"(lanes/step locality="
+            f"{place.get('lanes_per_step_mean_locality')} vs random="
+            f"{place.get('lanes_per_step_mean_random')})")
+    mig = data.get("migration") or {}
+    if not mig.get("oracle_ok"):
+        failures.append("live migration broke exactly-once (moved tenant "
+                        "or neighbours diverged from solo oracles)")
+    ela = data.get("elasticity") or {}
+    if not ela.get("oracle_ok"):
+        failures.append("elasticity cycle broke exactly-once")
+    if not ela.get("join_moves") or not ela.get("leave_moves"):
+        failures.append(
+            f"elasticity did not engage (join_moves="
+            f"{ela.get('join_moves')} leave_moves="
+            f"{ela.get('leave_moves')}) — plan recompute/bulk adoption "
+            f"unwired?")
+    eff = data.get("scaling_efficiency_max_size")
+    if eff is None:
+        failures.append("missing scaling_efficiency_max_size")
+    elif eff < eff_floor:
+        failures.append(
+            f"mesh scaling efficiency {eff:.3f} below the floor "
+            f"{eff_floor:.3f} ({tol} x stored "
+            f"{baseline.get('scaling_efficiency_min')})")
+
+    print(json.dumps({
+        "tenants": place.get("tenants"),
+        "hosts": data.get("hosts"),
+        "compile_advantage": adv,
+        "advantage_floor": adv_floor,
+        "lanes_per_step": [place.get("lanes_per_step_mean_locality"),
+                           place.get("lanes_per_step_mean_random")],
+        "migration_oracle_ok": mig.get("oracle_ok"),
+        "elasticity": [ela.get("join_moves"), ela.get("leave_moves"),
+                       ela.get("oracle_ok")],
+        "scaling_efficiency": eff,
+        "efficiency_floor": eff_floor,
+        "ok": not failures,
+    }))
+    for f_ in failures:
+        print(f"GUARD REGRESSION (mesh): {f_}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def _latest_device_report():
     """The report the device_latency guard judges: the file named by
     ``BENCH_GUARD_DEVICE_REPORT``, else the highest-numbered BENCH_r*.json
@@ -505,7 +629,10 @@ def main() -> int:
         return rc or drc or erc
     frc = run_fleet_guard(tol)
     src = run_slo_guard(tol)
-    return rc or frc or src or drc or erc
+    mrc = 0
+    if os.environ.get("BENCH_GUARD_SKIP_MESH", "") != "1":
+        mrc = run_mesh_guard(tol)
+    return rc or frc or src or drc or erc or mrc
 
 
 if __name__ == "__main__":

@@ -23,7 +23,16 @@ tier and asserts the resilience wrap is actually installed:
    moves without a timeline entry is an unaccountable control plane.
    Checked structurally (no direct ``_act_*`` call sites, record precedes
    dispatch in ``_actuate``) and live (a synthetic actuation lands on the
-   member app's ring).
+   member app's ring);
+
+5. **mesh decision paths** — the same record-before-actuate discipline on
+   every cross-host move: ``MeshRebalancer._actuate`` (structural: record
+   precedes dispatch, no ``_act_*`` call site outside it, every decided
+   actuator implemented — and live: a synthetic actuation lands on the
+   fabric's ring BEFORE the tenant moves), ``MeshFabric.migrate`` /
+   ``recover_tenant`` (structural: the decision record precedes the first
+   state move), and the SLO controller's ``mesh_replace`` rung (covered
+   by the decided-actuators check above).
 
 Run from tier-1 (tests/test_fleet_guard.py); exits non-zero on any gap.
 """
@@ -154,6 +163,68 @@ def main() -> int:
                       for e in entries), f"(entries: {entries})")
             check("actuation moved the knob it recorded",
                   group.slo_window == 32)
+
+        # 5) mesh decision paths (record-before-actuate, cross-host)
+        from siddhi_tpu.mesh import fabric as fab_mod
+        from siddhi_tpu.mesh import rebalancer as reb_mod
+        ract = inspect.getsource(reb_mod.MeshRebalancer._actuate)
+        rec_at = ract.find("self._record_decision(")
+        disp_at = ract.find("getattr(self, f\"_act_")
+        check("MeshRebalancer._actuate records the decision before "
+              "dispatching", 0 <= rec_at < disp_at,
+              f"(record at {rec_at}, dispatch at {disp_at})")
+        rsrc = inspect.getsource(reb_mod)
+        direct = [ln for ln in rsrc.splitlines()
+                  if re.search(r"\._act_\w+\(", ln)]
+        check("no mesh actuator has a call site outside _actuate",
+              not direct, f"(direct calls: {direct})")
+        actuators = set(re.findall(r"def _act_(\w+)\(", rsrc))
+        decided = set(re.findall(r'{"actuator": "(\w+)"', rsrc))
+        check("every decided mesh actuator has an _act_ implementation",
+              decided <= actuators,
+              f"(decided {sorted(decided)} vs impl {sorted(actuators)})")
+        msrc = inspect.getsource(fab_mod.MeshFabric._migrate_reserved)
+        rec_at = msrc.find("self._record_move(")
+        move_at = msrc.find("st.migrating = True")
+        check("MeshFabric migration records the decision before the first "
+              "state move", 0 <= rec_at < move_at,
+              f"(record at {rec_at}, move at {move_at})")
+        rsrc2 = inspect.getsource(fab_mod.MeshFabric._recover_admitted)
+        rec_at = rsrc2.find("self.flight.record(")
+        move_at = rsrc2.find("self._restore_on(")
+        check("MeshFabric.recover_tenant records before restoring",
+              0 <= rec_at < move_at,
+              f"(record at {rec_at}, restore at {move_at})")
+        # live: a synthetic rebalancer actuation must land on the fabric
+        # ring BEFORE the migration's own entries (ring order = append
+        # order), and the tenant must actually move
+        import tempfile
+
+        from siddhi_tpu.mesh import MeshConfig, MeshFabric, MeshRebalancer
+        mesh = MeshFabric(2, tempfile.mkdtemp(prefix="lint-mesh-"),
+                          MeshConfig(capacity_per_host=4))
+        try:
+            mesh.add_tenants([
+                "@app(name='lint-mesh-t0')\n@app:fleet(batch='64')\n"
+                + STREAM + "from S[v > 1.0] select v insert into Out;"])
+            src = mesh.tenants["lint-mesh-t0"].host
+            reb = MeshRebalancer(mesh)
+            reb._actuate({"actuator": "migrate_tenant",
+                          "tenant": "lint-mesh-t0", "src": src,
+                          "dst": 1 - src, "load_share": 0.9,
+                          "threshold": 0.5, "window_rows": 4096})
+            entries = mesh.flight.export(category="mesh")
+            kinds = [e["kind"] for e in entries]
+            check("synthetic mesh actuation recorded on the fabric ring",
+                  "decision:migrate_tenant" in kinds, f"(kinds: {kinds})")
+            check("mesh decision recorded before the move completed",
+                  kinds.index("decision:migrate_tenant")
+                  < kinds.index("migrated")
+                  if "migrated" in kinds else False, f"(kinds: {kinds})")
+            check("mesh actuation moved the tenant it recorded",
+                  mesh.tenants["lint-mesh-t0"].host == 1 - src)
+        finally:
+            mesh.close()
     finally:
         m.shutdown()
 
@@ -161,7 +232,7 @@ def main() -> int:
         print(f"\n{len(failures)} guard-coverage gap(s)", file=sys.stderr)
         return 1
     print("\nguard coverage OK: fleet group step, device dispatch/collect, "
-          "host_batch step, slo decision paths")
+          "host_batch step, slo decision paths, mesh decision paths")
     return 0
 
 

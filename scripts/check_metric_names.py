@@ -24,7 +24,12 @@ histograms), renders the exposition, and enforces:
   tenant with declared ``slo.*`` keys in the lint deployment) carry ONLY
   the ``app``/``query`` label set — compliance is per tenant query, and a
   tenant query is already app-scoped, so any further label would be an
-  identity in disguise.
+  identity in disguise;
+- the mesh-fabric families (``siddhi_tpu_mesh_*``, exercised by a small
+  two-host fabric the lint spins up and registers onto the main app's
+  statistics manager) render on every run and carry ONLY the
+  ``app``/``host`` label set — host indices are bounded by the mesh size
+  (≤ 255, the DCN wire bound), tenant identities stay in report payloads.
 
 Usage: ``python scripts/check_metric_names.py``. Exit code 1 on findings.
 Run by ``tests/test_observability.py`` so it gates CI (the
@@ -62,6 +67,8 @@ MAX_EXEMPLAR_RUNES = 128
 EXEMPLAR_LABELS = {"trace_id"}
 # slo.* compliance families: per tenant query, nothing finer
 SLO_LABELS = {"app", "query"}
+# mesh.* fabric families: per host (bounded by mesh size), nothing finer
+MESH_LABELS = {"app", "host"}
 
 APP = """
 @app(name='LintApp', statistics='detail')
@@ -86,8 +93,19 @@ from F[v > 1.0] select sym, v insert into FO;
 """
 
 
+MESH_TENANT = """
+@app(name='lint-mesh-{i}')
+@app:fleet(batch='64')
+define stream S (sym string, v double);
+from S[v > 1.0] select sym, v insert into MO;
+"""
+
+
 def build_exposition() -> str:
+    import tempfile
+
     from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.mesh import MeshConfig, MeshFabric
     from siddhi_tpu.observability import render
 
     m = SiddhiManager()
@@ -104,10 +122,20 @@ def build_exposition() -> str:
     rt.drain_async()
     rt.flush_device()
     srt.flush_host()
+    # a two-host mesh fabric registered onto the main app's statistics
+    # manager: the siddhi_tpu_mesh_* families render (and get linted for
+    # naming + the bounded {app, host} label set) on every run
+    mesh = MeshFabric(2, tempfile.mkdtemp(prefix="lint-mesh-"),
+                      MeshConfig(capacity_per_host=4))
+    mesh.add_tenants([MESH_TENANT.format(i=i) for i in range(2)])
+    mesh.send("lint-mesh-0", "S", [["a", 2.0], ["b", 3.0]], [1000, 1001])
+    mesh.flush()
+    mesh.register_metrics(rt.ctx.statistics_manager)
     # the OpenMetrics-flavored exposition: exemplars present, so their
     # syntax/placement/bounds are exercised by every lint run
     text = render([rt.ctx.statistics_manager,
                    srt.ctx.statistics_manager], with_exemplars=True)
+    mesh.close()
     m.shutdown()
     return text
 
@@ -227,6 +255,13 @@ def check(text: str) -> list[str]:
                     f"line {lineno}: slo family '{family}' carries labels "
                     f"{sorted(extra)} — compliance families allow only "
                     f"{sorted(SLO_LABELS)}")
+        if family.startswith("siddhi_tpu_mesh_"):
+            extra = set(labels) - MESH_LABELS - {"le"}
+            if extra:
+                problems.append(
+                    f"line {lineno}: mesh family '{family}' carries labels "
+                    f"{sorted(extra)} — fabric families allow only "
+                    f"{sorted(MESH_LABELS)}")
         if m.group("exemplar"):
             _check_exemplar(lineno, name, family, typed, labels,
                             m.group("exemplar"), problems)
@@ -285,6 +320,10 @@ def main() -> int:
         problems.append(
             "lint deployment rendered no siddhi_tpu_slo_* family — the "
             "SLO compliance surface is unwired or unregistered")
+    if "siddhi_tpu_mesh_" not in text:
+        problems.append(
+            "lint deployment rendered no siddhi_tpu_mesh_* family — the "
+            "mesh fabric surface is unwired or unregistered")
     for p in problems:
         print(p)
     if problems:

@@ -534,6 +534,21 @@ class DeviceQueryBridge:
             def receive(self, event: StreamEvent) -> None:
                 bridge.on_event(stream_id, event)
 
+        if self.kind == "stream" and hasattr(self.runtime, "send_columns"):
+            # single-stream device queries take columnar chunks straight
+            # into the staging BatchBuilder (append_columns — bulk
+            # slice-copy, no per-event appends): the last per-event hop on
+            # the DCN-ingest → device path the mesh fabric forwards over.
+            # Merged (nfa/join) builders stay per-event by design — their
+            # probe/trace FIFO is stamped per interleaved stream event.
+            class _ColsR(_R):
+                def receive_rows(self, rows: list, timestamps) -> None:
+                    bridge.on_rows_chunk(stream_id, rows, timestamps)
+
+                def receive_columns(self, cols: dict, ts, n: int) -> None:
+                    bridge.on_columns_chunk(stream_id, cols, ts, n)
+
+            return _ColsR()
         return _R()
 
     def on_event(self, stream_id: str, event: StreamEvent) -> None:
@@ -551,6 +566,37 @@ class DeviceQueryBridge:
             self.runtime.send(event.data, timestamp=event.timestamp)
         else:                       # 'nfa' | 'join': merged multi-stream batch
             self.runtime.send(stream_id, event.data, event.timestamp)
+
+    def _register_chunk_trace(self) -> None:
+        """One pending probe-trace entry per CHUNK (the fleet stager's
+        convention) — a chunk's events share one journey, and per-event
+        registration is exactly the hop this path exists to remove."""
+        probe = self.probe
+        if probe is not None and probe.tracer is not None:
+            tr = probe.tracer.active
+            if tr is not None:
+                probe.pending.append((tr, time.perf_counter_ns()))
+
+    def on_rows_chunk(self, stream_id: str, rows: list, timestamps) -> None:
+        """Zero-wrap row-chunk ingress (``deliver_rows``): no StreamEvent
+        materialization, one trace registration per chunk."""
+        self._register_chunk_trace()
+        send = self.runtime.send
+        for row, ts in zip(rows, timestamps):
+            send(row, timestamp=ts)
+        if timestamps:
+            self._out_ts = timestamps[-1]
+
+    def on_columns_chunk(self, stream_id: str, cols: dict, ts,
+                         n: int) -> None:
+        """Zero-object columnar ingress (``deliver_columns``): the chunk
+        bulk-slice-copies into the staging builder via
+        ``BatchBuilder.append_columns`` — no per-event appends at all."""
+        if n == 0:
+            return
+        self._register_chunk_trace()
+        self.runtime.send_columns(cols, ts)
+        self._out_ts = int(ts[-1])
 
     def flush(self, cause: str = "drain") -> None:
         if self.driver is not None:
@@ -757,6 +803,38 @@ def try_build_device_query(query: Query, app_context, stream_defs: dict,
                             else max(self._last_clk, clk)
                     self.builder.append(row, timestamp)
                     self._maybe_flush()
+
+                def send_columns(self, cols, ts):
+                    """Bulk columnar staging: the chunk slice-copies into
+                    the builder (``append_columns``) across as many
+                    micro-batches as it spans — flush causes and adaptive
+                    thresholds behave exactly as per-event ``send``."""
+                    import numpy as np
+                    ts = np.asarray(ts, dtype=np.int64)
+                    n = int(ts.shape[0])
+                    if n == 0:
+                        return
+                    clk_col = ts if self._tk_pos is None else np.asarray(
+                        cols[compiled.time_key].materialize()
+                        if hasattr(cols[compiled.time_key], "materialize")
+                        else cols[compiled.time_key])
+                    try:
+                        clk = clk_col.max()
+                    except TypeError:    # object column with None values
+                        vals = [v for v in clk_col if v is not None]
+                        clk = max(vals) if vals else None
+                    if clk is not None:
+                        self._last_clk = clk if self._last_clk is None \
+                            else max(self._last_clk, clk)
+                    start = 0
+                    while start < n:
+                        take = self.builder.append_columns(cols, ts, start)
+                        start += take
+                        self._maybe_flush()
+                        if take == 0 and len(self.builder):
+                            # defensive: a full builder _maybe_flush did
+                            # not drain (no controller, capacity race)
+                            self.flush()
 
                 def finalize(self):
                     """Force-close the open timeBatch bucket at shutdown: a

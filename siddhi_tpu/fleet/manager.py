@@ -458,6 +458,40 @@ class FleetManager:
             lambda m=member: m.group.slo.decisions
             if m.group is not None and m.group.slo is not None else 0)
 
+    def mesh_evidence(self) -> dict:
+        """Aggregate fleet-tier pressure for the mesh placement scorer
+        (``siddhi_tpu/mesh/``): events/lane-packing plus the guard and SLO
+        evidence (sheds, ejections, violated budgets) that mark a
+        struggling host. Group/member walks are snapshotted under the
+        manager lock; per-member reads are tolerant of concurrent
+        enrollment (the ``_snap`` discipline of the SLO controller)."""
+        with self._lock:
+            groups = list(self.groups.values()) + list(self.split_groups)
+        events = sheds = ejections = violations = 0
+        lanes_per_step = []
+        for g in groups:
+            events += g.events_in
+            if g.lanes_last_step:
+                lanes_per_step.append(g.lanes_last_step)
+            for m in list(g.members.values()):
+                lane = m.lane
+                if lane is not None:
+                    sheds += lane.shed
+                    ejections += lane.ejections
+                slo = getattr(m, "slo", None)
+                if slo is not None and not slo.compliant:
+                    violations += 1
+        return {
+            "fleet_groups": len(groups),
+            "events_in": events,
+            "lanes_per_step": (sum(lanes_per_step) / len(lanes_per_step)
+                               if lanes_per_step else 0.0),
+            "sheds": sheds,
+            "ejections": ejections,
+            "slo_violations": violations,
+            "compiled_programs": self.plan_cache.stats()["size"],
+        }
+
     def stats(self) -> dict:
         with self._lock:
             groups = {k: g.report() for k, g in self.groups.items()}

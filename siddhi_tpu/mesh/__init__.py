@@ -1,0 +1,42 @@
+"""Mesh fabric: tenant placement & live migration across engine shards.
+
+Fuses the two halves that existed separately — single-process fleet lanes
+(PRs 6/8/12: shared compilation, lane batching, FleetGuard, the SLO
+autopilot) and DCN lane-groups with failover (PR 4) — into one placement
+layer (ROADMAP item 3):
+
+- :mod:`plan` — :class:`MeshPlan` / :class:`PlacementPolicy`: tenants get
+  ``(host, lane-group, device)`` slots, locality-aware by shape
+  fingerprint, with evidence-fed capacity scoring;
+- :mod:`fabric` — :class:`MeshFabric`: host shards, exactly-once ingress
+  routing, live tenant migration over the snapshot-store/adoption
+  machinery, host join/leave elasticity, the SLO autopilot's cross-host
+  ``mesh_replace`` rung;
+- :mod:`rebalancer` — :class:`MeshRebalancer`: one move per decision,
+  recorded with its evidence before actuating.
+"""
+
+from .fabric import MeshChaosFault, MeshConfig, MeshFabric, MeshHost
+from .plan import (
+    HostSlot,
+    MeshPlan,
+    MeshSlot,
+    PlacementPolicy,
+    TenantSpec,
+    shape_fingerprint,
+)
+from .rebalancer import MeshRebalancer
+
+__all__ = [
+    "HostSlot",
+    "MeshChaosFault",
+    "MeshConfig",
+    "MeshFabric",
+    "MeshHost",
+    "MeshPlan",
+    "MeshRebalancer",
+    "MeshSlot",
+    "PlacementPolicy",
+    "TenantSpec",
+    "shape_fingerprint",
+]

@@ -1,0 +1,811 @@
+"""MeshFabric: the placement & live-migration layer fusing fleet lanes
+with DCN lane-groups (ROADMAP item 3).
+
+PRs 6/8/12 built the single-host tenant fleet (shared compilation, lane
+batching, blast-radius isolation, the SLO autopilot) and PR 4 built
+multi-host lane-group failover — but nothing composed them: a tenant ran
+wherever its app happened to deploy. The fabric closes that gap:
+
+- **hosts** — each :class:`MeshHost` is one engine shard: its own
+  ``SiddhiManager`` (so its own FleetManager → its own plan cache → the
+  compiled-programs-per-host number placement minimizes) bound to one
+  accelerator device of the mesh;
+- **placement** — a :class:`~siddhi_tpu.mesh.plan.PlacementPolicy` assigns
+  every tenant a ``(host, lane-group, device)`` slot, locality-aware by
+  shape fingerprint with capacity scoring fed by ``fleet.*``/``slo.*``
+  evidence and the flight recorder (``plan.py``);
+- **ingress routing** — :meth:`send` routes per-tenant row chunks to the
+  owning host with per-tenant ``(epoch, seq)`` stamps and a monotone
+  applied-mark — the receiver-side dedup that makes retries, migration
+  replays and kill-recovery exactly-once (the ``K_ROWS`` discipline of
+  ``tpu/dcn.py``, applied to tenants instead of lane groups);
+- **live migration** — :meth:`migrate` moves a tenant between hosts under
+  sustained ingest: fresh chunks spill (bounded, in order — the
+  :class:`~siddhi_tpu.resilience.dcn_guard.SpillQueue`), the source host
+  flushes + snapshots the tenant (the per-tenant snapshot/restore from
+  PR 6, carried as whole-app state bytes), the revision lands in the
+  :class:`~siddhi_tpu.resilience.dcn_guard.LaneGroupSnapshotStore` (keyed
+  by the tenant's global id, dedup mark inside — durable before the
+  hand-off, exactly like a lane-group takeover), the target host restores
+  and ACKs the adoption (lost acks retry, the ``K_ADOPT`` discipline),
+  ownership re-points, and the spill replays in order through the same
+  dedup'd apply path. Zero loss, zero duplication, per-tenant oracle
+  byte-identical — pinned by tests/test_mesh.py under chaos;
+- **elasticity** — :meth:`add_host` / :meth:`remove_host` recompute the
+  plan (sticky: surviving slots keep their tenants) and apply the diff as
+  bulk migrations; :meth:`kill_host` + :meth:`recover_tenant` are the
+  crash path (restore from the latest revision + spill replay — with
+  ``snapshot_every_chunks=1`` an applied chunk is durable before its send
+  returns, the ``snapshot_every_frames=1`` DCN contract);
+- **the cross-host SLO rung** — an armed group's
+  :class:`~siddhi_tpu.observability.slo.SLOController` gets a
+  ``mesh_hook``: when its in-process ladder is exhausted it decides
+  ``mesh_replace`` (recorded with evidence BEFORE dispatch, like every
+  actuator) and the fabric re-places the violating tenant on the
+  least-loaded host — the cross-host actuator PR 12 deferred.
+
+Every fabric decision path records to the flight recorder(s) BEFORE
+actuating (``scripts/check_guard_coverage.py`` pins it for the rebalancer
+the same way it pins the SLO controller).
+
+**Order caveat**: a migration inserts a flush boundary, and the fleet
+tier's NFA match ORDER is flush-cadence-dependent (a pre-existing
+property of every flush — adaptive resize, SLO shrink, drain). The match
+MULTISET is exact (zero loss, zero duplication, pinned); stateless
+shapes are byte-identical including order.
+
+**Dictionary caveat** (the DCN layer's "codes do not cross hosts" rule,
+inherited): a migrated tenant's state restores its string-dictionary
+tables monotonically into the destination group
+(:func:`~siddhi_tpu.fleet.group.restore_dicts_monotonic`). Destination
+tables that EXTEND or match the snapshot's restore exactly; a conflicting
+generation (same values minted in a different order on the target host)
+keeps the live table and logs loudly — co-locate same-shape tenants over
+one multiplexed feed (the locality policy's job) and the tables agree.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from ..observability.flight_recorder import FlightRecorder
+from ..resilience.dcn_guard import LaneGroupSnapshotStore, SpillQueue
+from .plan import HostSlot, MeshPlan, PlacementPolicy, TenantSpec, \
+    shape_fingerprint
+
+log = logging.getLogger("siddhi_tpu.mesh")
+
+_DEF_CAPACITY = 256            # tenant slots per host
+_DEF_SPILL_FRAMES = 4096
+_ADOPT_RETRY_MAX = 3
+
+
+class MeshChaosFault(Exception):
+    """Raised by an armed chaos hook at a named fabric site."""
+
+
+class MeshConfig:
+    """Fabric knobs (kwargs-style; everything has a default)."""
+
+    def __init__(self, capacity_per_host: int = _DEF_CAPACITY,
+                 policy: str = "locality", seed: int = 17,
+                 snapshot_every_chunks: Optional[int] = None,
+                 spill_capacity_frames: int = _DEF_SPILL_FRAMES,
+                 spill_policy: str = "block",
+                 adopt_retry_max: int = _ADOPT_RETRY_MAX,
+                 playback: bool = True):
+        self.capacity_per_host = int(capacity_per_host)
+        self.policy = policy
+        self.seed = seed
+        # None = snapshot only at migration/shutdown; N = persist the
+        # tenant after every N applied chunks BEFORE the send returns (the
+        # DCN snapshot_every_frames durability cadence: at 1, kill-recovery
+        # is exactly-once; at None the loss bound is the chunks since the
+        # last revision)
+        self.snapshot_every_chunks = snapshot_every_chunks
+        self.spill_capacity_frames = int(spill_capacity_frames)
+        self.spill_policy = spill_policy
+        self.adopt_retry_max = int(adopt_retry_max)
+        self.playback = playback
+
+
+class MeshHost:
+    """One engine shard of the mesh: an isolated ``SiddhiManager`` (own
+    FleetManager → own shared-plan cache) bound to one device ordinal."""
+
+    def __init__(self, index: int, capacity: int,
+                 device: Optional[int] = None, playback: bool = True):
+        from ..core.manager import SiddhiManager
+        self.index = index
+        self.capacity = capacity
+        self.device = device
+        self.playback = playback
+        self.manager = SiddhiManager()
+        self.runtimes: dict = {}        # tenant_id -> app runtime
+        self.rows_in = 0                # routed rows (load evidence)
+        self.reserved = 0               # in-flight adoption slots (capacity
+        # admission is check-then-deploy; the reservation closes the race
+        # between concurrent movers targeting the same destination)
+        self.alive = True
+
+    @property
+    def free_slots(self) -> int:
+        return self.capacity - len(self.runtimes) - self.reserved
+
+    @property
+    def slot(self) -> HostSlot:
+        return HostSlot(self.index, self.capacity, self.device)
+
+    def deploy(self, spec: TenantSpec):
+        rt = self.manager.create_siddhi_app_runtime(
+            spec.app_text, playback=self.playback)
+        rt.start()
+        self.runtimes[spec.tenant_id] = rt
+        return rt
+
+    def undeploy(self, tenant_id: str) -> None:
+        rt = self.runtimes.pop(tenant_id, None)
+        if rt is not None:
+            rt.shutdown()
+            self.manager.runtimes.pop(tenant_id, None)
+
+    def compiled_programs(self) -> int:
+        return self.manager.fleet.plan_cache.stats()["size"]
+
+    def evidence(self) -> dict:
+        """The capacity-scoring/rebalancing evidence for this host — the
+        fleet tier's aggregate (:meth:`FleetManager.mesh_evidence`:
+        events, lane packing, guard shed/eject pressure, violated SLO
+        budgets) plus the host's own routing load. The same numbers the
+        ``mesh.*`` metric families export."""
+        return {
+            "host": self.index, "device": self.device,
+            "alive": self.alive,
+            "tenants": len(self.runtimes),
+            "capacity": self.capacity,
+            "rows_in": self.rows_in,
+            **self.manager.fleet.mesh_evidence(),
+        }
+
+    def close(self) -> None:
+        self.alive = False
+        self.manager.shutdown()
+        self.runtimes.clear()
+
+
+class _TenantState:
+    """Fabric-side runtime state of one tenant: routing, the exactly-once
+    seq/applied marks, and the migration spill queue."""
+
+    __slots__ = ("spec", "gid", "host", "lock", "migrate_lock", "seq",
+                 "applied", "spill", "migrating", "callbacks", "epoch")
+
+    def __init__(self, spec: TenantSpec, gid: int, host: int, cfg: MeshConfig):
+        self.spec = spec
+        self.gid = gid                  # global tenant id → snapshot store key
+        self.host = host                # LIVE owner (plan is the target)
+        self.lock = threading.RLock()
+        # admission guard for migrate(): one in-flight move per tenant —
+        # a second mover (operator + rebalancer + SLO escalation can race)
+        # must bounce, not interleave snapshot/undeploy/adopt
+        self.migrate_lock = threading.Lock()
+        self.seq = 0                    # last assigned chunk seq
+        self.applied = 0                # last APPLIED chunk seq (dedup mark)
+        self.epoch = 0                  # bumped per restore-from-revision
+        self.spill = SpillQueue(cfg.spill_capacity_frames, cfg.spill_policy)
+        self.migrating = False
+        self.callbacks: list = []       # (stream_id, fn) — re-attached on move
+
+
+class MeshFabric:
+    """The mesh control plane: hosts, the plan, ingress routing, live
+    migration, elasticity. One fabric per mesh."""
+
+    def __init__(self, num_hosts: int, store_root: str,
+                 config: Optional[MeshConfig] = None,
+                 devices: Optional[list] = None):
+        self.cfg = config or MeshConfig()
+        if devices is None:
+            devices = self._probe_devices(num_hosts)
+        self.hosts: dict = {
+            i: MeshHost(i, self.cfg.capacity_per_host,
+                        device=(devices[i] if i < len(devices) else None),
+                        playback=self.cfg.playback)
+            for i in range(num_hosts)}
+        self.store = LaneGroupSnapshotStore(store_root)
+        self.policy = PlacementPolicy(self.cfg.policy, self.cfg.seed)
+        self.plan = MeshPlan(policy=self.cfg.policy)
+        self.tenants: dict = {}         # tenant_id -> _TenantState
+        self._next_gid = 0
+        self._lock = threading.RLock()  # hosts/plan/tenants maps
+        # the fabric's own control-plane ring; migration decisions ALSO fan
+        # out to the involved tenant apps' recorders (their operators read
+        # their own timelines)
+        self.flight = FlightRecorder(app_name="mesh")
+        self.migrations = 0
+        self.migration_failures = 0
+        self.recoveries = 0
+        self.spilled_chunks = 0
+        self.shed_chunks = 0            # spill overflow the policy DROPPED
+        self.replayed_chunks = 0
+        self.dup_chunks = 0
+        self.plan_recomputes = 0
+        self.chaos: Optional[Callable[[str], None]] = None  # test hook
+        self._sm = None
+        # windowed-load marks: rows_in at the last PLACEMENT-consuming
+        # evidence read (cumulative shares would let an hour-old burst
+        # repel placements forever)
+        self._ev_last_rows: dict = {}
+
+    @staticmethod
+    def _probe_devices(n: int) -> list:
+        """Best-effort device binding: host i steps on jax device i of the
+        mesh (the forced-host CPU mesh in tests/bench, chips on hardware).
+        Without a live backend the binding stays None — placement and
+        migration are device-agnostic."""
+        try:
+            import jax
+            devs = jax.devices()
+            return [devs[i % len(devs)].id for i in range(n)]
+        except Exception:   # noqa: BLE001 — metadata only, never fatal
+            return [None] * n
+
+    def _site(self, site: str) -> None:
+        if self.chaos is not None:
+            self.chaos(site)
+
+    # -- deployment ----------------------------------------------------------
+    def add_tenants(self, app_texts: list) -> MeshPlan:
+        """Place + deploy a tenant population (placement sees the WHOLE
+        batch, so shape locality packs globally). Tenant id = app name."""
+        from ..compiler import parse as _parse
+        specs = []
+        with self._lock:
+            for text in app_texts:
+                app = _parse(text)
+                tid = app.name()
+                if tid in self.tenants:
+                    raise ValueError(f"tenant '{tid}' already deployed")
+                specs.append(TenantSpec(tid, text,
+                                        shapes=shape_fingerprint(app)))
+            all_specs = [t.spec for t in self.tenants.values()] + specs
+            new_plan = self.policy.recompute(
+                self.plan, all_specs,
+                [h.slot for h in self.hosts.values() if h.alive],
+                self.evidence(window=True))
+            for spec in specs:
+                host = new_plan.host_of(spec.tenant_id)
+                st = _TenantState(spec, self._next_gid, host, self.cfg)
+                self._next_gid += 1
+                self.tenants[spec.tenant_id] = st
+                self._arm_slo_hook(self.hosts[host].deploy(spec))
+            self.plan = new_plan
+        return new_plan
+
+    def add_callback(self, tenant_id: str, stream_id: str, fn) -> None:
+        """Attach an output callback that SURVIVES migration (re-attached
+        on every deploy of the tenant)."""
+        from ..core.stream import StreamCallback
+        st = self.tenants[tenant_id]
+        with st.lock:
+            st.callbacks.append((stream_id, fn))
+            rt = self.hosts[st.host].runtimes.get(tenant_id)
+            if rt is not None:
+                rt.add_callback(stream_id, StreamCallback(fn))
+
+    def _reattach(self, rt, st: _TenantState) -> None:
+        from ..core.stream import StreamCallback
+        for stream_id, fn in st.callbacks:
+            rt.add_callback(stream_id, StreamCallback(fn))
+
+    def _arm_slo_hook(self, rt) -> None:
+        """Give every SLO controller among this tenant's groups the
+        cross-host rung: when its in-process ladder is exhausted it can
+        decide ``mesh_replace`` and the fabric re-places the tenant.
+        Takes the runtime DIRECTLY — during a migration the tenant's
+        ``host`` field still points at the source until adoption
+        completes, so a lookup through it would arm nothing."""
+        for b in getattr(rt, "fleet_bridges", []):
+            group = b.member.group
+            if group is not None and group.slo is not None:
+                group.slo.mesh_hook = self._slo_escalate
+
+    def _slo_escalate(self, decision: dict) -> bool:
+        """The SLO controller's ``mesh_replace`` actuator (its decision is
+        already on the member's flight ring — the controller records before
+        dispatching). Runs the move on a background thread: the evaluation
+        slot rides tenant ingress and must never block on a migration."""
+        tid = decision.get("tenant")
+        st = self.tenants.get(tid)
+        if st is None:
+            return False
+        dst = self._least_loaded_host(exclude=st.host)
+        if dst is None:
+            return False
+        threading.Thread(
+            target=self._migrate_logged, args=(tid, dst),
+            kwargs={"reason": "slo:mesh_replace", "decided": decision},
+            daemon=True).start()
+        return True
+
+    def _migrate_logged(self, tid: str, dst: int, **kw) -> None:
+        try:
+            self.migrate(tid, dst, **kw)
+        except Exception:   # noqa: BLE001 — logged; the autopilot retries
+            log.exception("mesh: slo-escalated migration of '%s' failed", tid)
+
+    def _least_loaded_host(self, exclude: Optional[int] = None
+                           ) -> Optional[int]:
+        cands = [h for h in self.hosts.values()
+                 if h.alive and h.index != exclude and h.free_slots > 0]
+        if not cands:
+            return None
+        # occupancy first (cumulative rows_in would bias against any host
+        # that absorbed traffic once, forever), routed load as tie-break
+        return min(cands, key=lambda h: (len(h.runtimes) + h.reserved,
+                                         h.rows_in, h.index)).index
+
+    # -- ingress routing (exactly-once) --------------------------------------
+    def send(self, tenant_id: str, stream_id: str, rows: list,
+             timestamps) -> None:
+        """Route one per-tenant chunk to its owning host. Chunks get a
+        per-tenant monotone seq; the apply path dedups (seq <= applied →
+        already applied, ack again, apply nothing) so migration replays and
+        kill-recovery replays stay exactly-once. A migrating (or
+        dead-hosted) tenant's chunks spill in order — bounded by the
+        spill policy: ``block`` (default) waits up to the queue's bounded
+        window with NO tenant lock held (the replay drain needs it — the
+        DCN ``_forward`` discipline), then force-admits (counted);
+        ``shed``/``drop_oldest`` trade loss for memory, every dropped
+        chunk counted in ``shed_chunks``/the queue's counters — loss is a
+        visible policy choice, never silent."""
+        st = self.tenants[tenant_id]
+        host = self.hosts.get(st.host)
+        if st.migrating or host is None or not host.alive:
+            # cheap racy pre-check — the locked decision below is
+            # authoritative; a miss costs one forced admit, counted
+            st.spill.wait_for_space()
+        with st.lock:
+            st.seq += 1
+            seq = st.seq
+            host = self.hosts.get(st.host)
+            if st.migrating or host is None or not host.alive:
+                if st.spill.append(
+                        (seq, stream_id, rows, list(timestamps)),
+                        len(rows)):
+                    self.spilled_chunks += 1
+                else:
+                    self.shed_chunks += 1    # policy chose to drop: counted
+                return
+            self._apply_locked(st, seq, stream_id, rows, timestamps)
+
+    def _apply_locked(self, st: _TenantState, seq: int, stream_id: str,
+                      rows: list, timestamps) -> bool:
+        """Apply one chunk under the tenant lock through the dedup mark;
+        returns True when the chunk actually applied. With a snapshot
+        cadence armed, the tenant persists BEFORE the ack (return) — the
+        acked-chunk-is-durable contract kill-recovery leans on."""
+        if seq <= st.applied:
+            self.dup_chunks += 1
+            return False                 # replay of an applied chunk: dedup
+        host = self.hosts[st.host]
+        rt = host.runtimes[st.spec.tenant_id]
+        rt.input_handler(stream_id).send_rows(
+            [list(r) for r in rows], list(timestamps))
+        host.rows_in += len(rows)
+        st.applied = seq
+        n = self.cfg.snapshot_every_chunks
+        if n and seq % n == 0:
+            self._save_tenant_locked(st, rt)
+        return True
+
+    def _save_tenant_locked(self, st: _TenantState, rt) -> int:
+        """Persist the tenant's state bytes (flushed first — staged fleet
+        rows resolve before the walk) as a snapshot-store blob revision
+        keyed by its global id, with the applied mark riding the
+        revision's dedup table — restore resumes the exactly-once window
+        exactly."""
+        rt.flush_host()
+        return self.store.save_blob(st.gid, rt.snapshot(),
+                                    {0: (st.epoch, st.applied)})
+
+    # -- live migration ------------------------------------------------------
+    def migrate(self, tenant_id: str, dst: int, reason: str = "operator",
+                decided: Optional[dict] = None) -> bool:
+        """Move one tenant between hosts under sustained ingest. The
+        decision (with its evidence) hits the flight recorder(s) BEFORE any
+        state moves; the data path is spill → flush+snapshot → revision
+        durable → restore on dst → adoption ack (retried) → owner re-point
+        → in-order spill replay through the dedup'd apply. One in-flight
+        move per tenant: a concurrent mover (operator, rebalancer, SLO
+        escalation) returns False instead of interleaving."""
+        st = self.tenants[tenant_id]
+        if not st.migrate_lock.acquire(blocking=False):
+            log.info("mesh: migration of '%s' already in flight", tenant_id)
+            return False
+        try:
+            return self._migrate_admitted(st, tenant_id, dst, reason,
+                                          decided)
+        finally:
+            st.migrate_lock.release()
+
+    def _migrate_admitted(self, st: "_TenantState", tenant_id: str,
+                          dst: int, reason: str,
+                          decided: Optional[dict]) -> bool:
+        with self._lock:
+            src = st.host
+            dst_host = self.hosts.get(dst)
+            if dst_host is None or not dst_host.alive:
+                raise ValueError(f"mesh host {dst} is not alive")
+            if src == dst:
+                return False
+            if dst_host.free_slots <= 0:
+                raise ValueError(f"mesh host {dst} is at capacity")
+            # RESERVE the slot under the lock: concurrent movers of
+            # DIFFERENT tenants to the same destination must not both
+            # pass a check-then-deploy capacity test
+            dst_host.reserved += 1
+        try:
+            return self._migrate_reserved(st, tenant_id, src, dst, reason,
+                                          decided)
+        finally:
+            with self._lock:
+                dst_host.reserved = max(0, dst_host.reserved - 1)
+
+    def _migrate_reserved(self, st: "_TenantState", tenant_id: str,
+                          src: int, dst: int, reason: str,
+                          decided: Optional[dict]) -> bool:
+        # EVIDENCE FIRST: the decision lands on the fabric ring and the
+        # tenant's own app timeline before the knob moves
+        self._record_move(tenant_id, src, dst, reason, decided)
+        src_rt = self.hosts[src].runtimes.get(tenant_id)
+        try:
+            with st.lock:
+                st.migrating = True      # fresh chunks spill from here on
+            self._site("mesh.migrate.freeze")
+            # quiesce + snapshot on the source (senders spill, not block)
+            if src_rt is not None:
+                self._save_tenant_migration(st, src_rt)
+            self._site("mesh.migrate.snapshot")
+            if src_rt is not None:
+                self.hosts[src].undeploy(tenant_id)
+            self._site("mesh.migrate.src_down")
+            self._adopt(st, dst)
+            with st.lock:
+                st.host = dst
+                slot = self.plan.assignment.get(tenant_id)
+                if slot is not None:
+                    from .plan import MeshSlot
+                    self.plan.assignment[tenant_id] = MeshSlot(
+                        dst, slot.shape, self.hosts[dst].device)
+                st.migrating = False
+                self._replay_spill_locked(st)
+            self.migrations += 1
+            self.flight.record("mesh", "migrated", site=f"tenant:{tenant_id}",
+                               detail={"src": src, "dst": dst})
+            return True
+        except Exception:
+            self.migration_failures += 1
+            raise
+
+    def _save_tenant_migration(self, st: _TenantState, rt) -> int:
+        with st.lock:
+            return self._save_tenant_locked(st, rt)
+
+    def _adopt(self, st: _TenantState, dst: int) -> None:
+        """Deploy + restore the tenant on ``dst`` from its latest revision
+        and confirm the adoption. A lost ack retries against the SAME
+        restored runtime — the restore is idempotent (re-restore from the
+        same revision) and the seq dedup makes the replay side safe, the
+        ``K_ADOPT`` two-attempt discipline."""
+        last_err = None
+        for attempt in range(self.cfg.adopt_retry_max):
+            try:
+                self._restore_on(st, dst)
+                self._site("mesh.migrate.adopt_ack")   # lost-ack chaos site
+                return
+            except MeshChaosFault as e:
+                last_err = e            # ack lost: retry the hand-off
+                continue
+        raise last_err if last_err is not None else \
+            RuntimeError("adoption failed")
+
+    def _restore_on(self, st: _TenantState, dst: int) -> None:
+        tid = st.spec.tenant_id
+        host = self.hosts[dst]
+        rt = host.runtimes.get(tid)
+        if rt is None:
+            rt = host.deploy(st.spec)
+            self._reattach(rt, st)
+        snap = self.store.latest_blob(st.gid)
+        if snap is not None:
+            rt.restore(snap["blob"])
+            mark = snap["dedup"].get(0)
+            if mark is not None:
+                # the saved mark never LOWERS the live incarnation (a
+                # recovery's bump must survive restoring a pre-bump mark)
+                st.epoch = max(st.epoch, int(mark[0]))
+                st.applied = int(mark[1])
+        self._arm_slo_hook(rt)
+
+    def _replay_spill_locked(self, st: _TenantState) -> None:
+        """Drain the tenant's spill in order through the dedup'd apply —
+        chunks the source applied before the snapshot dedup away, the rest
+        apply on the new owner exactly once."""
+        while True:
+            item = st.spill.pop_front()
+            if item is None:
+                return
+            (seq, sid, rows, tss), n = item
+            try:
+                self._apply_locked(st, seq, sid, rows, tss)
+            except Exception:
+                st.spill.push_front(item)   # never lose a popped chunk
+                raise
+            st.spill.mark_replayed(n)
+            self.replayed_chunks += 1
+
+    def _record_move(self, tenant_id: str, src: int, dst: int, reason: str,
+                     decided: Optional[dict]) -> None:
+        detail = {"tenant": tenant_id, "src": src, "dst": dst,
+                  "reason": reason}
+        if decided:
+            detail["decided_by"] = {
+                k: v for k, v in decided.items()
+                if isinstance(v, (str, int, float, bool, type(None)))}
+        self.flight.record("mesh", "decision:migrate_tenant",
+                           site=f"tenant:{tenant_id}", detail=detail)
+        rt = self.hosts[src].runtimes.get(tenant_id) \
+            if src in self.hosts else None
+        fl = getattr(getattr(rt, "ctx", None), "flight", None)
+        if fl is not None:
+            fl.record("mesh", "decision:migrate_tenant",
+                      site=f"tenant:{tenant_id}", detail=detail)
+
+    # -- crash / recovery ----------------------------------------------------
+    def kill_host(self, host: int) -> list:
+        """Simulated host SIGKILL: its runtimes are DISCARDED (no flush, no
+        hand-off). Its tenants' fresh chunks spill until recovery; returns
+        the orphaned tenant ids."""
+        with self._lock:
+            h = self.hosts.get(host)
+            if h is None:
+                return []
+            h.alive = False
+            orphans = sorted(h.runtimes)
+            h.runtimes.clear()           # state is gone, like the process
+            # the manager registry too: a later close() must not "flush"
+            # runtimes whose process memory this kill simulates losing
+            h.manager.runtimes.clear()
+            self.flight.record("mesh", "host_killed", site=f"host:{host}",
+                               detail={"tenants": orphans})
+            return orphans
+
+    def recover_tenant(self, tenant_id: str,
+                       dst: Optional[int] = None) -> int:
+        """Re-place one orphaned tenant from its latest snapshot revision
+        (restore → dedup mark resumes → spill replays in order). With
+        ``snapshot_every_chunks=1`` this is exactly-once; at a looser
+        cadence the loss bound is the chunks applied since the last
+        revision (the DCN ``<= N-1`` frames contract). Shares the
+        per-tenant admission lock with :meth:`migrate` — a recovery
+        racing an in-flight move of the same tenant waits for it to
+        finish or unwind instead of interleaving restores."""
+        st = self.tenants[tenant_id]
+        with st.migrate_lock:
+            return self._recover_admitted(st, tenant_id, dst)
+
+    def _recover_admitted(self, st: "_TenantState", tenant_id: str,
+                          dst: Optional[int]) -> int:
+        if dst is None:
+            dst = self._least_loaded_host(exclude=st.host)
+        if dst is None:
+            raise ValueError("no live host with capacity to recover onto")
+        self.flight.record("mesh", "decision:recover_tenant",
+                           site=f"tenant:{tenant_id}",
+                           detail={"dst": dst, "from": st.host})
+        with st.lock:
+            self._restore_on(st, dst)
+            # incarnation bump AFTER the restore (which re-reads the saved
+            # mark — bumping first would be silently overwritten and the
+            # counter would never advance); the next snapshot persists it
+            st.epoch += 1
+            st.host = dst
+            st.migrating = False
+            slot = self.plan.assignment.get(tenant_id)
+            if slot is not None:
+                from .plan import MeshSlot
+                self.plan.assignment[tenant_id] = MeshSlot(
+                    dst, slot.shape, self.hosts[dst].device)
+            self._replay_spill_locked(st)
+        self.recoveries += 1
+        return dst
+
+    # -- elasticity ----------------------------------------------------------
+    def add_host(self, capacity: Optional[int] = None) -> int:
+        """Host join: a new shard enters, the plan recomputes (sticky), and
+        the diff applies as bulk migrations onto the newcomer."""
+        with self._lock:
+            idx = (max(self.hosts) + 1) if self.hosts else 0
+            dev = self._probe_devices(idx + 1)[-1]
+            self.hosts[idx] = MeshHost(
+                idx, capacity or self.cfg.capacity_per_host, device=dev,
+                playback=self.cfg.playback)
+            if self._sm is not None:      # metrics track elasticity live
+                self._register_host_metrics(self._sm, self.hosts[idx])
+        self.flight.record("mesh", "host_join", site=f"host:{idx}")
+        # balanced recompute: without the retain cap, sticky slots would
+        # leave the newcomer empty — a join must trigger bulk adoption
+        self._apply_recompute(balance=True)
+        return idx
+
+    def remove_host(self, host: int) -> int:
+        """Graceful host leave: recompute the plan without it and bulk-
+        migrate its tenants out (each move is a full live migration —
+        spill/snapshot/restore/replay), then close the shard. Returns the
+        number of tenants moved."""
+        with self._lock:
+            h = self.hosts.get(host)
+            if h is None:
+                return 0
+            h.alive = False              # placement stops targeting it
+        self.flight.record("mesh", "host_leave", site=f"host:{host}")
+        moved = self._apply_recompute()
+        with self._lock:
+            self.hosts[host].close()
+            del self.hosts[host]
+            if self._sm is not None:      # no zombie gauges on a closed
+                self._sm.unregister(f"mesh.h{host}.")   # MeshHost closure
+        return moved
+
+    def _apply_recompute(self, balance: bool = False) -> int:
+        """Plan recompute + bulk adoption: every move in the diff runs as a
+        live migration (the decision trail names the elasticity event)."""
+        with self._lock:
+            specs = [t.spec for t in self.tenants.values()]
+            slots = [h.slot for h in self.hosts.values() if h.alive]
+            new_plan = self.policy.recompute(self.plan, specs, slots,
+                                             self.evidence(window=True),
+                                             balance=balance)
+            moves = self.plan.diff(new_plan)
+            self.plan_recomputes += 1
+        moved = 0
+        for tid, _src, dst in moves:
+            st = self.tenants[tid]
+            if st.host == dst:
+                continue
+            src_host = self.hosts.get(st.host)
+            if src_host is not None and tid in src_host.runtimes:
+                # the source runtime is INTACT (a draining host counts —
+                # alive=False only stops placement): a full live migration
+                # flushes + snapshots the current state. Routing by
+                # aliveness here would silently restore a graceful
+                # leaver's tenants from STALE revisions — duplicates for
+                # every stateful shape.
+                self.migrate(tid, dst, reason="elasticity")
+            else:
+                self.recover_tenant(tid, dst)   # process truly gone
+            moved += 1
+        with self._lock:
+            self.plan = new_plan
+        return moved
+
+    # -- evidence / introspection --------------------------------------------
+    def evidence(self, window: bool = False) -> dict:
+        """Per-host evidence map (the placement scorer's and rebalancer's
+        input). ``load_share`` is each live host's share of rows routed
+        SINCE the last placement-consuming read (``window=True`` advances
+        the marks — placement/recompute callers pass it; plain reads like
+        ``GET /mesh`` observe the same window without consuming it). A
+        cumulative lifetime share would let an hour-old burst repel new
+        placements forever."""
+        with self._lock:
+            hosts = list(self.hosts.values())
+            deltas = {h.index: max(0, h.rows_in
+                                   - self._ev_last_rows.get(h.index, 0))
+                      for h in hosts}
+            if window:
+                for h in hosts:
+                    self._ev_last_rows[h.index] = h.rows_in
+        total = sum(d for h, d in deltas.items()
+                    if self.hosts.get(h) is not None
+                    and self.hosts[h].alive) or 1
+        out = {}
+        for h in hosts:
+            ev = h.evidence() if h.alive else {
+                "host": h.index, "alive": False, "tenants": 0,
+                "rows_in": h.rows_in}
+            ev["load_share"] = deltas[h.index] / total if h.alive else 0.0
+            out[h.index] = ev
+        return out
+
+    def flush(self) -> None:
+        for h in self.hosts.values():
+            if not h.alive:
+                continue
+            for rt in list(h.runtimes.values()):
+                rt.flush_host()
+
+    def report(self) -> dict:
+        """Service-facing state (``GET /mesh``)."""
+        with self._lock:
+            backlog = {t: len(st.spill) for t, st in self.tenants.items()
+                       if len(st.spill)}
+            return {
+                "hosts": self.evidence(),
+                "plan": self.plan.report(),
+                "tenants": len(self.tenants),
+                "migrations": self.migrations,
+                "migration_failures": self.migration_failures,
+                "recoveries": self.recoveries,
+                "plan_recomputes": self.plan_recomputes,
+                "spilled_chunks": self.spilled_chunks,
+                "shed_chunks": self.shed_chunks,
+                "replayed_chunks": self.replayed_chunks,
+                "dup_chunks": self.dup_chunks,
+                "spill_backlog": backlog,
+                "decisions": [e for e in self.flight.export(category="mesh")
+                              if e["kind"].startswith("decision:")][-16:],
+            }
+
+    def register_metrics(self, sm) -> None:
+        """Expose fabric state as ``mesh.*`` trackers → the
+        ``siddhi_tpu_mesh_*`` Prometheus families (label ``host`` = host
+        index, ``self`` for fabric-level; lint-pinned by
+        ``scripts/check_metric_names.py``). Host leave/rejoin cycles tear
+        the families down through ``sm.unregister('mesh.')`` — pinned in
+        tests/test_metrics.py so dead gauges never leak — as are the
+        elasticity edges: a host joining AFTER registration gets its
+        ``mesh.h{i}.*`` gauges on arrival, a removed host's are
+        unregistered with it (no permanent blind spots or zombie gauges
+        across an elasticity event)."""
+        for h in list(self.hosts.values()):
+            self._register_host_metrics(sm, h)
+        sm.gauge_tracker("mesh.self.hosts",
+                         lambda: sum(1 for h in self.hosts.values()
+                                     if h.alive))
+        sm.gauge_tracker("mesh.self.tenants", lambda: len(self.tenants))
+        sm.gauge_tracker("mesh.self.plan_epoch", lambda: self.plan.epoch)
+        sm.gauge_tracker("mesh.self.migrations_total",
+                         lambda: self.migrations)
+        sm.gauge_tracker("mesh.self.migration_failures_total",
+                         lambda: self.migration_failures)
+        sm.gauge_tracker("mesh.self.recoveries_total",
+                         lambda: self.recoveries)
+        sm.gauge_tracker("mesh.self.spilled_chunks_total",
+                         lambda: self.spilled_chunks)
+        sm.gauge_tracker("mesh.self.shed_chunks_total",
+                         lambda: self.shed_chunks)
+        sm.gauge_tracker("mesh.self.replayed_chunks_total",
+                         lambda: self.replayed_chunks)
+        sm.gauge_tracker("mesh.self.dup_chunks_total",
+                         lambda: self.dup_chunks)
+        sm.gauge_tracker("mesh.self.spill_backlog_chunks",
+                         lambda: sum(len(st.spill)
+                                     for st in self.tenants.values()))
+        self._sm = sm
+
+    @staticmethod
+    def _register_host_metrics(sm, h: MeshHost) -> None:
+        hi = h.index
+        sm.gauge_tracker(f"mesh.h{hi}.tenants",
+                         lambda h=h: len(h.runtimes))
+        sm.gauge_tracker(f"mesh.h{hi}.rows_in_total",
+                         lambda h=h: h.rows_in)
+        sm.gauge_tracker(f"mesh.h{hi}.compiled_programs",
+                         lambda h=h: h.compiled_programs()
+                         if h.alive else 0)
+        sm.gauge_tracker(f"mesh.h{hi}.alive",
+                         lambda h=h: 1 if h.alive else 0)
+
+    def close(self) -> None:
+        if self._sm is not None:
+            self._sm.unregister("mesh.")
+            self._sm = None
+        for h in list(self.hosts.values()):
+            h.close()
+        self.hosts.clear()
+        self.tenants.clear()

@@ -55,7 +55,8 @@ _T, _SEQ, _CAT, _KIND, _SITE, _DETAIL, _TRACE = range(7)
 class FlightRecorder:
     """One app's control-plane ring."""
 
-    CATEGORIES = ("flow", "breaker", "device", "fleet", "host", "dcn")
+    CATEGORIES = ("flow", "breaker", "device", "fleet", "host", "dcn",
+                  "slo", "mesh")
 
     def __init__(self, capacity: int = 2048,
                  dump_dir: Optional[str] = None, app_name: str = ""):

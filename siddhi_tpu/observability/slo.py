@@ -186,6 +186,11 @@ class SLOController:
         self._last_relax_action_t = 0.0
         self._relax_ok = True
         self.decision_log: deque = deque(maxlen=64)
+        # the cross-host rung (PR 12 deferred it; the mesh fabric arms it):
+        # a MeshFabric sets this to its escalation callback, and the
+        # exhausted ladder gains a final actuator — re-place the violating
+        # tenant on another host's group (siddhi_tpu/mesh/fabric.py)
+        self.mesh_hook = None
         self._last_eval_t = 0.0
         self._last_act_t = 0.0           # tighten-side cooldown
         self._last_relax_t = 0.0         # relax-side cooldown (longer)
@@ -354,6 +359,11 @@ class SLOController:
             # shed quota was not enough: the solo tier takes the neighbour
             m, t = held[0]
             return {"actuator": "eject_besteffort", "member": m, **base}
+        if self.mesh_hook is not None:
+            # the in-process ladder ran out but the mesh can still move
+            # load: re-place the violating tenant on another host's group
+            # (the cross-host actuator ROADMAP item 5 deferred to item 3)
+            return {"actuator": "mesh_replace", **base}
         # the ladder ran out — record it (an operator reading the timeline
         # must see the controller is at its limits, not asleep)
         return {"actuator": "exhausted", **base}
@@ -420,7 +430,7 @@ class SLOController:
 
     # -- actuation (decision recorded BEFORE the knob moves) -----------------
     _TIGHTENERS = ("shrink_window", "shed_besteffort", "split_group",
-                   "eject_besteffort", "exhausted")
+                   "eject_besteffort", "mesh_replace", "exhausted")
 
     def _actuate(self, decision: dict) -> None:
         """THE single actuation gate: records the decision with its
@@ -542,6 +552,17 @@ class SLOController:
                     m, "slo: best-effort neighbour over shared budget"):
                 if t is not None:
                     t.policy_ejected = True
+
+    def _act_mesh_replace(self, decision: dict) -> None:
+        """The cross-host rung: hand the decision (already on the flight
+        ring — :meth:`_actuate` recorded it before dispatching here) to
+        the mesh fabric, which re-places the violating tenant on the
+        least-loaded host. The fabric runs the migration on its own
+        thread — the evaluation slot rides tenant ingress and must never
+        block on a cross-host move."""
+        hook = self.mesh_hook
+        if hook is not None:
+            hook(decision)
 
     def _act_readmit_besteffort(self, decision: dict) -> None:
         group = self.group

@@ -325,6 +325,25 @@ class LaneGroupSnapshotStore:
                                 d, stale)
             return rev
 
+    def save_blob(self, group: int, blob: bytes, dedup: dict) -> int:
+        """Opaque state-bytes revision (the mesh fabric's per-tenant app
+        snapshots ride here, keyed by global tenant id): one uint8 leaf
+        under the SAME revision/tmp+fsync+rename/pruning discipline as
+        lane-group pytrees — an acked revision is durable before the
+        hand-off that depends on it."""
+        return self.save(group, [group],
+                         [np.frombuffer(blob, dtype=np.uint8)], dedup)
+
+    def latest_blob(self, group: int) -> Optional[dict]:
+        """Newest :meth:`save_blob` revision as ``{blob, dedup,
+        revision}``, or None."""
+        snap = self.latest(group)
+        if snap is None:
+            return None
+        return {"blob": np.asarray(snap["leaves"][0],
+                                   dtype=np.uint8).tobytes(),
+                "dedup": snap["dedup"], "revision": snap["revision"]}
+
     def next_epoch(self, host: int) -> int:
         """Monotone per-host incarnation counter (0 on first call). A
         worker constructed without an explicit epoch draws one here, so a

@@ -34,15 +34,37 @@ from .circuit import CircuitBreaker, CircuitState
 log = logging.getLogger("siddhi_tpu.resilience")
 
 
+class _ShadowCols:
+    """Lazy shadow of one columnar chunk slice: the raw column references
+    (numpy slices are views — cheap) materialize to replayable rows ONLY
+    when a fault actually consumes the shadow (the FleetGuard
+    ``admit_columns`` discipline — the zero-object path must not pay a
+    per-row Python tax for a replay that almost never happens)."""
+
+    __slots__ = ("cols", "ts", "names")
+
+    def __init__(self, cols: dict, ts, names: list):
+        self.cols = cols
+        self.ts = ts
+        self.names = names
+
+    def rows(self) -> list:
+        from ..core.columns import columns_to_rows
+        n = int(self.ts.shape[0])
+        return [(None, row, int(t)) for row, t in zip(
+            columns_to_rows(self.cols, self.names, n), self.ts.tolist())]
+
+
 class _ShadowBuilder:
     """Batch-builder proxy retaining the raw rows of the batch being packed,
     so a failed device step can replay exactly those events on the host.
 
     Wraps both builder shapes: ``BatchBuilder.append(row, ts)`` (single
-    stream) and ``MergedBatchBuilder.append(stream_id, row, ts)``. The bulk
-    pre-encoded path (``append_many``) has no row-level shadow — batches that
-    used it are marked incomplete and a failed step can only count, not
-    replay, them."""
+    stream) and ``MergedBatchBuilder.append(stream_id, row, ts)``, plus the
+    columnar chunk path (``append_columns`` — shadowed as lazy column
+    slices, materialized only on fault). The bulk pre-encoded path
+    (``append_many``) has no row-level shadow — batches that used it are
+    marked incomplete and a failed step can only count, not replay, them."""
 
     def __init__(self, inner, merged: bool):
         self._inner = inner
@@ -79,6 +101,22 @@ class _ShadowBuilder:
         shadow — it is not an event and must never replay."""
         self._inner.append(row, ts)
         self._rows.append(None)
+
+    def append_columns(self, cols: dict, ts, start: int = 0) -> int:
+        """Columnar chunk staging WITH a (lazy) shadow: the inner builder
+        takes what fits, the shadow keeps references to exactly that slice.
+        Without this override ``__getattr__`` would route straight to the
+        inner builder and silently leave the shadow missing rows — a failed
+        step would then replay a PARTIAL batch."""
+        import numpy as np
+        ts = np.asarray(ts, dtype=np.int64)
+        take = self._inner.append_columns(cols, ts, start)
+        if take:
+            sl = slice(start, start + take)
+            self._rows.append(_ShadowCols(
+                {n: cols[n][sl] for n in self._inner.schema.names},
+                ts[sl], self._inner.schema.names))
+        return take
 
     def append_many(self, *args, **kwargs):
         self._incomplete = True
@@ -301,8 +339,18 @@ class DeviceGuard:
                       "(bulk-ingress batches cannot be replayed)",
                       self._site, n)
             return
-        # None markers are append_sentinel() bookkeeping rows, not events
-        shadow = [s for s in shadow if s is not None]
+        # None markers are append_sentinel() bookkeeping rows, not events;
+        # _ShadowCols markers are lazy columnar slices — they materialize
+        # to rows HERE, on the fault path only
+        expanded: list = []
+        for s in shadow:
+            if s is None:
+                continue
+            if isinstance(s, _ShadowCols):
+                expanded.extend(s.rows())
+            else:
+                expanded.append(s)
+        shadow = expanded
         if not shadow:
             return
         rt = self._fallback_runtime()

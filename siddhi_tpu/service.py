@@ -46,6 +46,12 @@ on request. Endpoints (stdlib http.server, threaded; no framework deps):
                                              (?category=, ?limit=,
                                              ?since_ns= incremental-tail
                                              cursor filters)
+    GET    /mesh                             mesh-fabric state (placement
+                                             plan, per-host evidence,
+                                             migration/recovery counters,
+                                             recent decisions) when a
+                                             MeshFabric is attached via
+                                             ``service.attach_mesh``
     GET    /siddhi-apps/{name}/slo           SLO-autopilot state: per-query
                                              class/budget vs windowed p99,
                                              controller decisions + ladder
@@ -155,6 +161,9 @@ class SiddhiService:
                 if parts == ["siddhi-apps"]:
                     self._reply(200, {"status": "OK",
                                       "apps": sorted(service.runtimes)})
+                elif parts == ["mesh"]:
+                    code, payload = service.mesh_stats()
+                    self._reply(code, payload)
                 elif parts == ["metrics"]:
                     code, text, ctype = service.metrics_text(
                         None, openmetrics=self._wants_openmetrics())
@@ -235,6 +244,19 @@ class SiddhiService:
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
+        self.mesh = None                # MeshFabric via attach_mesh()
+
+    # -- mesh fabric -----------------------------------------------------------
+    def attach_mesh(self, fabric) -> None:
+        """Attach a :class:`~siddhi_tpu.mesh.MeshFabric` so ``GET /mesh``
+        serves its placement plan, per-host evidence and decision trail
+        (the fabric is engine-level, not app-level — one per mesh)."""
+        self.mesh = fabric
+
+    def mesh_stats(self) -> tuple[int, dict]:
+        if self.mesh is None:
+            return 200, {"status": "OK", "enabled": False}
+        return 200, {"status": "OK", "enabled": True, **self.mesh.report()}
 
     # -- operations (also usable programmatically) -----------------------------
     def deploy(self, app_text: str) -> tuple[int, dict]:

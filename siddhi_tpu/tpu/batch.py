@@ -269,11 +269,13 @@ class BatchBuilder:
         resumes past them). The device-tier twin of
         ``HostRowStager.append_columns`` — no per-row Python.
 
-        GROUNDWORK (pinned by tests, not yet wired): the device bridge's
-        junction receiver is still per-event, because its probe/trace FIFO
-        and ``_out_ts`` bookkeeping are stamped per event — wiring a
-        ``receive_columns`` there belongs to the device evidence round
-        (ROADMAP item 1, pack-behind-step), which should batch those too."""
+        Wired end-to-end since the mesh round: single-stream device
+        bridges expose ``receive_columns`` (``core/device_bridge.py``
+        ``on_columns_chunk`` → ``_StreamRT.send_columns``), with the
+        probe/trace FIFO stamped per CHUNK and the DeviceGuard shadow
+        captured as lazy column slices — columnar chunks reach the device
+        tier with zero per-event appends on the DCN-ingest → device
+        path."""
         ts = np.asarray(ts, dtype=np.int64)
         n = int(ts.shape[0]) - start
         if n <= 0:

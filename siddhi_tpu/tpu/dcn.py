@@ -206,6 +206,47 @@ def pack_rows(types: str, rows: list, timestamps: list) -> bytes:
     return b"".join(parts)
 
 
+def pack_columns(types: str, cols: list, timestamps) -> bytes:
+    """Columns → the SAME self-describing SoA payload as :func:`pack_rows`,
+    built WITHOUT materializing per-event row lists (the bulk forwarding
+    path: a :class:`~siddhi_tpu.core.columns.RowsChunk` ships straight from
+    its numpy columns into wire bytes — byte-identical layout, pinned by
+    tests against ``pack_rows`` on the same data). ``cols`` is positional
+    (one entry per type char): numeric columns as numpy arrays (object
+    arrays may carry None → null bit + zero), string columns as object
+    arrays/lists of ``str | None``."""
+    ts = np.asarray(timestamps, dtype=np.int64)
+    n = int(ts.shape[0])
+    parts = [struct.pack(">IB", n, len(types)), types.encode("ascii"),
+             ts.astype(">i8").tobytes()]
+    for t, col in zip(types, cols):
+        if t == "s":
+            vals = col if isinstance(col, np.ndarray) \
+                else np.asarray(col, dtype=object)
+            nulls = np.fromiter((v is None for v in vals), np.uint8,
+                                count=n)
+            parts.append(nulls.tobytes())
+            blobs = [b"" if v is None else str(v).encode() for v in vals]
+            offs = np.zeros(n + 1, dtype=">u4")
+            if n:
+                np.cumsum([len(b) for b in blobs], out=offs[1:])
+            parts.append(offs.tobytes())
+            parts.append(b"".join(blobs))
+        else:
+            arr = np.asarray(col)
+            if arr.dtype == object:
+                nulls = np.fromiter((v is None for v in arr), np.uint8,
+                                    count=n)
+                arr = np.array([0 if v is None else v for v in arr],
+                               dtype=_NUM_DT[t])
+            else:
+                nulls = np.zeros(n, dtype=np.uint8)
+                arr = arr.astype(_NUM_DT[t], copy=False)
+            parts.append(nulls.tobytes())
+            parts.append(arr.tobytes())
+    return b"".join(parts)
+
+
 def unpack_rows(payload: bytes) -> tuple[list, list]:
     """Inverse of :func:`pack_rows`; returns (rows, timestamps)."""
     n, n_cols = struct.unpack_from(">IB", payload, 0)
@@ -391,6 +432,7 @@ class DCNWorker:
         self.rt = self._shards.get(host_index)   # home shard, if owned
 
         self.forwarded = 0            # rows ACKED by (or re-owned from) peers
+        self.forward_chunk_rows = 0   # rows forwarded via the bulk SoA path
         self.received = 0             # rows accepted from peers
         self.dup_frames = 0           # retried frames deduped by seq
         self.frame_errors = 0         # serve-side engine failures (no ack)
@@ -498,6 +540,94 @@ class DCNWorker:
                 # spilled/failed frames are counted by the spill queue
                 with self._engine_lock:
                     self.forwarded += acked
+
+    # -- bulk SoA ingest (RowsChunk → wire, no per-row framing) --------------
+    def _lanes_of_column(self, key_col, n: int) -> np.ndarray:
+        """Vectorized global-lane assignment for a key COLUMN: crc32 runs
+        once per DISTINCT key (``np.unique`` + gather) instead of once per
+        row — same lane function as :meth:`LaneTopology.lane_of`."""
+        vals = key_col.materialize() if hasattr(key_col, "materialize") \
+            else key_col
+        if not isinstance(vals, np.ndarray):
+            vals = np.asarray(vals, dtype=object)
+        try:
+            su = vals.astype("U")
+        except (TypeError, ValueError):     # None/mixed: slow-path stringify
+            su = np.array([str(v) for v in vals], dtype="U")
+        uniq, inv = np.unique(su, return_inverse=True)
+        # ONE source of truth for the hash: _hash_key (tpu/partition.py) —
+        # str(np.str_) round-trips, so _hash_key(u) == _hash_key(value)
+        lanes_u = np.fromiter(
+            ((_hash_key(u) % self.topo.num_lanes) for u in uniq),
+            np.int64, count=uniq.size)
+        return lanes_u[inv]
+
+    def ingest_chunk(self, chunk) -> None:
+        """Bulk SoA ingest of one :class:`~siddhi_tpu.core.columns.
+        RowsChunk`: lanes compute vectorized over the key column, the
+        locally-owned slice applies under the engine lock, and each remote
+        lane group's slice ships as ONE frame packed straight from the
+        columns (:func:`pack_columns` — no per-event row lists, no
+        re-framing per send). Delivery rides the same ``_forward``
+        retry/dedup/spill machinery as :meth:`ingest`, so exactly-once is
+        unchanged; rows acked through this path count in
+        ``forward_chunk_rows`` (the ``dcn.forward.rows`` metric) — the
+        DCN-ingest saturation fix of ROADMAP item 3."""
+        from ..core.columns import column_tolist
+        names = [a.name for a in self.stream_defs[self.stream_id].attributes]
+        n = chunk.count
+        if n == 0:
+            return
+        ts = np.asarray(chunk.ts, dtype=np.int64)
+        tr = self.tracer.maybe_trace(self.stream_id) \
+            if self.tracer is not None else None
+        t_ing0 = time.perf_counter_ns() if tr is not None else 0
+        lanes = self._lanes_of_column(chunk.cols[names[self._key_pos]], n)
+        groups = lanes // self.topo.lanes_per_host
+        backlogged = set(self.guard.backlogged_groups())
+        present = np.unique(groups)
+        remote: list = []
+        with self._engine_lock:
+            for g in present.tolist():
+                g = int(g)
+                mask = groups == g
+                if g in self._shards and g not in backlogged:
+                    # local slice: apply in chunk order (per-key order is
+                    # per-lane order — the boolean mask preserves it)
+                    idx = np.nonzero(mask)[0]
+                    py = [column_tolist(chunk.cols[nm][idx])
+                          for nm in names]
+                    for j, i in enumerate(idx.tolist()):
+                        self._apply_locked(g, int(lanes[i]),
+                                           [c[j] for c in py], int(ts[i]))
+                else:
+                    remote.append((g, mask))
+        if tr is not None:
+            tr.add_span("ingress", self.stream_id,
+                        time.perf_counter_ns() - t_ing0, n)
+        for g, mask in remote:
+            # dictionary codes do not cross hosts: DictColumns materialize
+            # to raw strings for the wire (the receiver re-encodes locally)
+            sub = [c.materialize() if hasattr(c, "materialize") else c
+                   for c in (chunk.cols[nm][mask] for nm in names)]
+            body = pack_columns(self._types, sub, ts[mask])
+            k = int(np.count_nonzero(mask))
+            ctxs = [self.tracer.context_of(tr)] if tr is not None else []
+            t_fwd0 = time.perf_counter_ns() if tr is not None else 0
+            try:
+                acked = self._forward(g, body, k, ctxs)
+            except Exception:   # noqa: BLE001 — parked in the spill queue
+                log.exception("host %d: bulk forward to group %d failed",
+                              self.host_index, g)
+                continue
+            finally:
+                if tr is not None:
+                    tr.add_span("dcn", f"h{self.host_index}->g{g}",
+                                time.perf_counter_ns() - t_fwd0, k)
+            if acked:
+                with self._engine_lock:
+                    self.forwarded += acked
+                    self.forward_chunk_rows += acked
 
     def _apply_locked(self, group: int, lane: int, row: list,
                       ts: int) -> None:
@@ -624,13 +754,31 @@ class DCNWorker:
                 if self._stop.wait(self.guard.backoff_s(attempts - 1)):
                     return "failed"
 
+    def _decode_frame_body(self, body: bytes):
+        """K_ROWS body → ``(rows, tss, lanes)``: null-FAITHFUL row decode
+        (:func:`unpack_rows` rebuilds ``None`` from the null bits — a
+        columns decode would substitute 0 and, worse, recompute a null
+        KEY's lane from the substituted value, diverging from the lane
+        the sender routed by) with lanes vectorized once per DISTINCT key
+        over the faithful values (``astype('U')`` renders ``None`` as
+        ``'None'`` — exactly ``_hash_key``'s ``str()``)."""
+        rows, tss = unpack_rows(body)
+        n = len(rows)
+        if n == 0:
+            return [], [], np.zeros(0, dtype=np.int64)
+        keys = np.empty(n, dtype=object)
+        kp = self._key_pos
+        for i, row in enumerate(rows):
+            keys[i] = row[kp]
+        return rows, tss, self._lanes_of_column(keys, n)
+
     def _apply_frame_locally(self, frame: bytes) -> int:
         """Apply a framed K_ROWS payload to a locally-owned shard through
         the SAME dedup path a remote receiver uses (takeover replay and
         ownership changes mid-send land here)."""
         sender, group, epoch, seq = _ROWS_HDR.unpack_from(frame)
         ctxs, body_off = _unpack_ctxs(frame, _ROWS_HDR.size)
-        rows, tss = unpack_rows(frame[body_off:])
+        rows, tss, lanes = self._decode_frame_body(frame[body_off:])
         with self._engine_lock:
             if group not in self._shards:
                 raise ConnectionError(
@@ -639,9 +787,8 @@ class DCNWorker:
             if self._is_dup_locked(group, sender, epoch, seq):
                 self.dup_frames += 1
                 return 0
-            for row, ts in zip(rows, tss):
-                lane = self.topo.lane_of(row[self._key_pos])
-                self._apply_locked(group, lane, row, ts)
+            for i, (row, ts) in enumerate(zip(rows, tss)):
+                self._apply_locked(group, int(lanes[i]), row, ts)
             self._mark_locked(group, sender, epoch, seq)
             # locally re-owned rows count as forwarded ("delivered to the
             # group's owner — us"), keeping the row totals reconcilable
@@ -1061,7 +1208,7 @@ class DCNWorker:
         if self.chaos is not None:
             self.chaos.on_dcn_serve(site)   # kill-peer site: abort, no ack
         ctxs, body_off = _unpack_ctxs(payload, _ROWS_HDR.size)
-        rows, tss = unpack_rows(payload[body_off:])
+        rows, tss, lanes = self._decode_frame_body(payload[body_off:])
         redirect = None
         due = False
         applied = False
@@ -1073,10 +1220,9 @@ class DCNWorker:
                 # means ack again, apply nothing
                 self.dup_frames += 1
             else:
-                for row, ts in zip(rows, tss):
+                for i, (row, ts) in enumerate(zip(rows, tss)):
                     self.received += 1
-                    lane = self.topo.lane_of(row[self._key_pos])
-                    self._apply_locked(group, lane, row, ts)
+                    self._apply_locked(group, int(lanes[i]), row, ts)
                 self._mark_locked(group, sender, epoch, seq)
                 applied = True
                 # the durability cadence is PER GROUP: a global counter
@@ -1128,6 +1274,7 @@ class DCNWorker:
                          "owner": owner},
             "owned_groups": owned,
             "forwarded_rows": self.forwarded,
+            "forward_chunk_rows": self.forward_chunk_rows,
             "received_rows": self.received,
             "dup_frames": self.dup_frames,
             "takeovers": self.takeovers,
@@ -1168,6 +1315,10 @@ class DCNWorker:
                               + guard.spill(gg).shed_frames))
         sm.gauge_tracker("dcn.self.forwarded_rows_total",
                          lambda: self.forwarded)
+        # the bulk SoA path: rows that shipped as whole RowsChunk frames
+        # (ingest_chunk → pack_columns) — the ingest-saturation evidence
+        sm.gauge_tracker("dcn.forward.rows_total",
+                         lambda: self.forward_chunk_rows)
         sm.gauge_tracker("dcn.self.received_rows_total",
                          lambda: self.received)
         sm.gauge_tracker("dcn.self.dup_frames_total",

@@ -153,5 +153,29 @@ def test_guard_metric_families_unregister_on_shutdown():
         snap = hsm.snapshot_trackers()
         assert not any(k.startswith("host_batch.")
                        for d in snap.values() for k in d)
+
+        # mesh.* fabric families ride the same contract (ISSUE 14): a host
+        # leave/rejoin cycle re-registers through close(), so dead per-host
+        # gauges must never survive into the engine-wide exposition
+        import tempfile
+
+        from siddhi_tpu.mesh import MeshConfig, MeshFabric
+        mrt = m.create_siddhi_app_runtime(
+            "@app(name='gm2')\ndefine stream S (v double);\n"
+            "from S select v insert into Out;", playback=True)
+        mrt.start()
+        msm = mrt.ctx.statistics_manager
+        fab = MeshFabric(2, tempfile.mkdtemp(prefix="gm-mesh-"),
+                         MeshConfig(capacity_per_host=2))
+        fab.register_metrics(msm)
+        gauges = msm.snapshot_trackers()["gauges"]
+        assert gauges["mesh.self.hosts"].value == 2
+        assert gauges["mesh.h0.tenants"].value == 0
+        assert gauges["mesh.self.migrations_total"].value == 0
+        fab.close()
+        snap = msm.snapshot_trackers()
+        assert not any(k.startswith("mesh.")
+                       for d in snap.values() for k in d)
+        mrt.shutdown()
     finally:
         m.shutdown()
