@@ -1707,6 +1707,147 @@ def child_mesh() -> None:
     print(json.dumps(out))
 
 
+def child_procmesh() -> None:
+    """Process-fabric evidence (ISSUE 16, the MULTICHIP_r07 line): each
+    mesh host its OWN OS process with its own JAX runtime, driven over the
+    procmesh control socket — per-host-process Kleene scaling curves and a
+    real-SIGKILL restart-recovery measurement (supervisor detect → respawn
+    → spill replay), exactly-once vs solo oracles."""
+    import tempfile
+
+    from siddhi_tpu import SiddhiManager, StreamCallback
+    from siddhi_tpu.mesh import MeshConfig, MeshFabric
+
+    fleet_ann = f"@app:fleet(batch='{FLEET_BATCH}', lanes='{HOST_LANES}')\n"
+    cores = os.cpu_count() or 1
+    out = {"hosts": MESH_HOSTS, "mode": "process", "cores": cores}
+    # honesty note the guard carries forward: process isolation only buys
+    # PARALLEL compute when the container has cores to park workers on —
+    # on a 1-core box the curve measures control-socket plumbing, not
+    # scaling (the paper's multi-host claim needs >=4 real cores)
+    out["core_note"] = (
+        f"container has {cores} core(s): with fewer cores than worker "
+        f"processes the scaling efficiency is a core-limited plumbing "
+        f"number, not a hardware scaling claim")
+
+    # -- 1) per-host-process Kleene scaling --------------------------------
+    sizes = [s for s in (1, 2, 4, 8) if s <= MESH_HOSTS]
+    kfeed = gen_events(MESH_FEED)
+    krows = [[dev, v] for dev, v, _ in kfeed]
+    ktss = [ts for _, _, ts in kfeed]
+    scaling = {}
+    base_evps = None
+    for size in sizes:
+        t0 = time.perf_counter()
+        fab = MeshFabric(size, tempfile.mkdtemp(prefix=f"pmesh-s{size}-"),
+                         MeshConfig(capacity_per_host=MESH_SCALE_TENANTS,
+                                    mode="process"))
+        boot_s = time.perf_counter() - t0
+        k = MESH_SCALE_TENANTS * size
+        fab.add_tenants([_mesh_kleene_app(i, fleet_ann) for i in range(k)])
+        tids = [f"kleene-{i}" for i in range(k)]
+        kmatches = [0] * k
+        for j, tid in enumerate(tids):
+            fab.add_callback(tid, "Alerts",
+                             lambda evs, j=j: kmatches.__setitem__(
+                                 j, kmatches[j] + len(evs)))
+        # short warm pass (child-side numpy kernels, dictionary encode)
+        _mesh_feed_all(fab, tids, krows[:max(MESH_CHUNK, 256)],
+                       ktss[:max(MESH_CHUNK, 256)], MESH_CHUNK)
+        wall = _mesh_feed_all(fab, tids, krows, ktss, MESH_CHUNK)
+        fab.flush()
+        total = k * MESH_FEED
+        evps = total / wall if wall else 0.0
+        if base_evps is None:
+            base_evps = evps
+        scaling[str(size)] = {
+            "tenants": k, "evps": round(evps),
+            "evps_per_host": round(evps / size),
+            "scaling_efficiency": round(evps / (size * base_evps), 3)
+            if base_evps else 0.0,
+            "match_total": sum(kmatches),
+            "worker_boot_s": round(boot_s, 2),
+        }
+        fab.close()
+        print(f"# procmesh scaling x{size}: "
+              f"{scaling[str(size)]['evps']:,} ev/s "
+              f"({scaling[str(size)]['evps_per_host']:,}/host-process, "
+              f"eff={scaling[str(size)]['scaling_efficiency']})",
+              file=sys.stderr)
+    out["scaling"] = scaling
+    out["scaling_efficiency_max_size"] = \
+        scaling[str(sizes[-1])]["scaling_efficiency"]
+
+    # -- 2) restart recovery: real SIGKILL mid-ingest ----------------------
+    KR = 2
+    fab = MeshFabric(2, tempfile.mkdtemp(prefix="pmesh-kill-"),
+                     MeshConfig(capacity_per_host=KR, mode="process",
+                                snapshot_every_chunks=1,
+                                heartbeat_interval_s=0.2))
+    fab.add_tenants([_mesh_kleene_app(i, fleet_ann) for i in range(KR)])
+    rcounts = {i: [] for i in range(KR)}
+    for i in range(KR):
+        fab.add_callback(f"kleene-{i}", "Alerts",
+                         lambda evs, i=i: rcounts[i].extend(
+                             tuple(e.data) for e in evs))
+    chunks = [(krows[s:s + MESH_CHUNK], ktss[s:s + MESH_CHUNK])
+              for s in range(0, MESH_FEED, MESH_CHUNK)]
+    victim = fab.tenants["kleene-0"].host
+    t_kill = None
+    for ci, (c, t) in enumerate(chunks):
+        if ci == len(chunks) // 2:
+            t_kill = time.perf_counter()
+            fab.kill_host(victim)              # REAL SIGKILL
+        for i in range(KR):
+            fab.send(f"kleene-{i}", "S", c, t)
+    # wait for supervisor respawn + orphan recovery, then drain the spill
+    recover_s = None
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        rep = fab.report()
+        if all(h["alive"] for h in rep["hosts"].values()) \
+                and not rep["spill_backlog"]:
+            recover_s = time.perf_counter() - t_kill
+            break
+        time.sleep(0.1)
+    fab.flush()
+    rep = fab.report()
+    wrk = rep["supervisor"]["workers"][victim]
+    proc_counts = {i: list(rcounts[i]) for i in range(KR)}
+    fab.close()
+    oracle_ok = True
+    m = SiddhiManager()
+    for i in range(KR):
+        rt = m.create_siddhi_app_runtime(
+            _mesh_kleene_app(i, ""), playback=True)
+        solo = []
+        rt.add_callback("Alerts", StreamCallback(
+            lambda evs, solo=solo: solo.extend(
+                tuple(e.data) for e in evs)))
+        rt.start()
+        ih = rt.input_handler("S")
+        for c, t in chunks:
+            ih.send_rows([list(r) for r in c], list(t))
+        if solo != proc_counts[i]:
+            oracle_ok = False
+    m.shutdown()
+    out["restart_recovery"] = {
+        "tenants": KR, "restarts": wrk["restarts"],
+        # kill → fleet healthy again + spill drained (parent clock), plus
+        # the PeerHealth-side downtime the supervisor itself observed
+        "recover_s": round(recover_s, 2) if recover_s else None,
+        "worker_downtime_s": round(wrk.get("last_downtime_s") or 0.0, 2),
+        "replayed_chunks": rep["replayed_chunks"],
+        "dup_chunks": rep["dup_chunks"],
+        "oracle_ok": oracle_ok,
+    }
+    print(f"# procmesh restart: {wrk['restarts']} restart(s), "
+          f"recover={out['restart_recovery']['recover_s']}s, "
+          f"replayed={rep['replayed_chunks']}, oracle_ok={oracle_ok}",
+          file=sys.stderr)
+    print(json.dumps(out))
+
+
 # ---------------------------------------------------------------------------
 # parent: orchestration (no jax import — immune to backend-init hangs)
 # ---------------------------------------------------------------------------
@@ -2109,5 +2250,7 @@ if __name__ == "__main__":
         child_edge()
     elif len(sys.argv) > 1 and sys.argv[1] == "--mesh-child":
         child_mesh()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--procmesh-child":
+        child_procmesh()
     else:
         main()

@@ -32,6 +32,14 @@ per host, lanes per step), the live migration and the host join/leave
 elasticity cycle exactly-once vs solo oracles, and the cross-host scaling
 efficiency above its (plumbing) floor.
 
+A ``procmesh`` guard (``run_procmesh_guard``) runs a fresh ``bench.py
+--procmesh-child`` (reduced feed over REAL host processes) and pins the
+process fabric's contract vs BASELINE.json ``procmesh_baseline``: the
+real-SIGKILL restart cycle exactly-once with zero dup chunks and at least
+one actual respawn, kill→respawn→spill-drained recovery under the stored
+ceiling, and the (core-limited) per-host-process scaling efficiency above
+its floor.
+
 A ``device_latency`` guard (``run_device_latency_guard``) additionally pins
 the double-buffered pipeline's recorded evidence: when a bench report with a
 ``latency_mode`` line exists, its p99 must stay under
@@ -443,6 +451,117 @@ def run_mesh_guard(tol: float, deadline_s: int = 600) -> int:
     return 1 if failures else 0
 
 
+def run_procmesh_guard(tol: float, deadline_s: int = 600) -> int:
+    """Process-fabric line vs BASELINE.json ``procmesh_baseline``: a fresh
+    ``bench.py --procmesh-child`` (reduced feed, 2 then 4 host PROCESSES)
+    must keep
+
+    1. the real-SIGKILL restart cycle exactly-once (solo-oracle
+       byte-identical, zero dup chunks — binary, no band) with at least
+       one actual respawn;
+    2. kill → respawn → spill-drained recovery under the stored ceiling
+       scaled by 1/tol (parent clock);
+    3. per-host-process scaling efficiency at the largest size above the
+       stored floor scaled by ``tol`` — a CORE-LIMITED plumbing floor
+       (see the baseline note: the recording container has one core, so
+       this pins control-socket overhead, not hardware scaling)."""
+    with open(os.path.join(REPO, "BASELINE.json")) as f:
+        baseline = json.load(f).get("procmesh_baseline") or {}
+    if not baseline:
+        print(json.dumps({
+            "procmesh_guard": "skipped",
+            "reason": "no procmesh_baseline in BASELINE.json"}))
+        return 0
+    eff_floor = tol * float(baseline.get("scaling_efficiency_min", 0.06))
+    rec_ceiling = float(baseline.get("restart_recover_ceiling_s", 15.0)) \
+        / max(tol, 1e-9)
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "BENCH_MESH_HOSTS":
+            os.environ.get("BENCH_GUARD_PROCMESH_HOSTS", "4"),
+        "BENCH_MESH_FEED":
+            os.environ.get("BENCH_GUARD_PROCMESH_FEED", "1200"),
+    }
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--procmesh-child"],
+            capture_output=True, text=True, timeout=deadline_s, env=env,
+            cwd=REPO)
+    except subprocess.TimeoutExpired:
+        print(f"GUARD: procmesh bench exceeded {deadline_s}s",
+              file=sys.stderr)
+        return 2
+    if p.returncode != 0:
+        tail = (p.stderr or "").strip().splitlines()[-6:]
+        print("GUARD: procmesh bench failed: " + " | ".join(tail),
+              file=sys.stderr)
+        return 2
+    data = None
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            data = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    if data is None:
+        print("GUARD: no JSON in procmesh bench output", file=sys.stderr)
+        return 2
+
+    failures = []
+    rec = data.get("restart_recovery") or {}
+    if not rec.get("oracle_ok"):
+        failures.append(
+            "real-SIGKILL restart broke exactly-once (killed tenant or "
+            "neighbour diverged from its solo oracle)")
+    if rec.get("dup_chunks"):
+        failures.append(
+            f"spill replay duplicated {rec.get('dup_chunks')} chunk(s) "
+            f"through the child-side seq dedup")
+    if not rec.get("restarts"):
+        failures.append("no worker respawn happened — the SIGKILL was "
+                        "never detected (supervisor monitor unwired?)")
+    recover_s = rec.get("recover_s")
+    if recover_s is None:
+        failures.append("fleet never returned to all-alive with a drained "
+                        "spill (recover_s missing)")
+    elif recover_s > rec_ceiling:
+        failures.append(
+            f"restart recovery took {recover_s:.1f}s, over the ceiling "
+            f"{rec_ceiling:.1f}s (stored "
+            f"{baseline.get('restart_recover_ceiling_s')}s / {tol})")
+    eff = data.get("scaling_efficiency_max_size")
+    if eff is None:
+        failures.append("missing scaling_efficiency_max_size")
+    elif eff < eff_floor:
+        failures.append(
+            f"procmesh scaling efficiency {eff:.3f} below the floor "
+            f"{eff_floor:.3f} ({tol} x stored "
+            f"{baseline.get('scaling_efficiency_min')}) — core-limited "
+            f"plumbing bound, see procmesh_baseline note")
+
+    print(json.dumps({
+        "hosts": data.get("hosts"),
+        "cores": data.get("cores"),
+        "restarts": rec.get("restarts"),
+        "recover_s": recover_s,
+        "worker_downtime_s": rec.get("worker_downtime_s"),
+        "replayed_chunks": rec.get("replayed_chunks"),
+        "dup_chunks": rec.get("dup_chunks"),
+        "restart_oracle_ok": rec.get("oracle_ok"),
+        "scaling_efficiency": eff,
+        "efficiency_floor": eff_floor,
+        "recover_ceiling_s": rec_ceiling,
+        "ok": not failures,
+    }))
+    for f_ in failures:
+        print(f"GUARD REGRESSION (procmesh): {f_}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def _latest_device_report():
     """The report the device_latency guard judges: the file named by
     ``BENCH_GUARD_DEVICE_REPORT``, else the highest-numbered BENCH_r*.json
@@ -629,10 +748,11 @@ def main() -> int:
         return rc or drc or erc
     frc = run_fleet_guard(tol)
     src = run_slo_guard(tol)
-    mrc = 0
+    mrc = prc = 0
     if os.environ.get("BENCH_GUARD_SKIP_MESH", "") != "1":
         mrc = run_mesh_guard(tol)
-    return rc or frc or src or drc or erc or mrc
+        prc = run_procmesh_guard(tol)
+    return rc or frc or src or drc or erc or mrc or prc
 
 
 if __name__ == "__main__":

@@ -34,6 +34,16 @@ tier and asserts the resilience wrap is actually installed:
    state move), and the SLO controller's ``mesh_replace`` rung (covered
    by the decided-actuators check above).
 
+6. **procmesh supervisor decision paths** — process-fleet moves follow
+   the same discipline against REAL processes: ``_on_death`` puts the
+   ``worker_down`` evidence on the ring before tripping the peer
+   detector, ``restart`` records ``decision:restart_worker`` (with its
+   backoff evidence) before the respawn and ``decision:give_up`` before
+   marking the worker abandoned, ``kill_worker`` records before the
+   SIGKILL, and the fabric's ``host_failed`` hook records before any
+   runtime teardown. Checked structurally (record precedes actuate in
+   each source).
+
 Run from tier-1 (tests/test_fleet_guard.py); exits non-zero on any gap.
 """
 
@@ -195,6 +205,39 @@ def main() -> int:
         check("MeshFabric.recover_tenant records before restoring",
               0 <= rec_at < move_at,
               f"(record at {rec_at}, restore at {move_at})")
+        # 6) procmesh supervisor decision paths (ISSUE 16): the same
+        # record-before-actuate discipline against REAL processes
+        from siddhi_tpu.procmesh import supervisor as sup_mod
+        dsrc = inspect.getsource(sup_mod.ProcMeshSupervisor._on_death)
+        rec_at = dsrc.find("self.flight.record(")
+        act_at = dsrc.find("h.health.trip()")
+        check("supervisor._on_death records worker_down before tripping",
+              0 <= rec_at < act_at,
+              f"(record at {rec_at}, trip at {act_at})")
+        rsrc3 = inspect.getsource(sup_mod.ProcMeshSupervisor.restart)
+        rec_at = rsrc3.find('"decision:restart_worker"')
+        act_at = rsrc3.find("self._spawn(h)")
+        check("supervisor.restart records the decision before respawning",
+              0 <= rec_at < act_at,
+              f"(record at {rec_at}, spawn at {act_at})")
+        rec_at = rsrc3.find('"decision:give_up"')
+        act_at = rsrc3.find("h.gave_up = True")
+        check("supervisor.restart records give_up before abandoning",
+              0 <= rec_at < act_at,
+              f"(record at {rec_at}, abandon at {act_at})")
+        ksrc = inspect.getsource(sup_mod.ProcMeshSupervisor.kill_worker)
+        rec_at = ksrc.find('"decision:kill_worker"')
+        act_at = ksrc.find("h.kill()")
+        check("supervisor.kill_worker records before the SIGKILL",
+              0 <= rec_at < act_at,
+              f"(record at {rec_at}, kill at {act_at})")
+        fsrc = inspect.getsource(fab_mod.MeshFabric.host_failed)
+        rec_at = fsrc.find("self.flight.record(")
+        act_at = fsrc.find("drop_runtimes")
+        check("MeshFabric.host_failed records before runtime teardown",
+              0 <= rec_at < act_at,
+              f"(record at {rec_at}, teardown at {act_at})")
+
         # live: a synthetic rebalancer actuation must land on the fabric
         # ring BEFORE the migration's own entries (ring order = append
         # order), and the tenant must actually move
@@ -232,7 +275,8 @@ def main() -> int:
         print(f"\n{len(failures)} guard-coverage gap(s)", file=sys.stderr)
         return 1
     print("\nguard coverage OK: fleet group step, device dispatch/collect, "
-          "host_batch step, slo decision paths, mesh decision paths")
+          "host_batch step, slo decision paths, mesh decision paths, "
+          "procmesh supervisor decision paths")
     return 0
 
 

@@ -8,11 +8,19 @@ on:
 
 - ``socket.create_connection(...)`` / ``create_connection(...)`` calls that
   do not pass a ``timeout=`` keyword (or pass ``timeout=None``);
-- functions that call ``<sock>.recv(...)`` without arming or asserting a
-  deadline in the same scope — i.e. no ``.settimeout(...)`` call and no
-  ``.gettimeout(...)`` guard (``tpu/dcn.py``'s ``_recv_exact`` raises when
-  a caller hands it an undeadlined socket; that guard satisfies the lint
-  because it *proves* the invariant instead of assuming it).
+- functions that call ``<sock>.recv(...)`` or ``<sock>.accept(...)``
+  without arming or asserting a deadline in the same scope — i.e. no
+  ``.settimeout(...)`` call and no ``.gettimeout(...)`` guard
+  (``tpu/dcn.py``'s ``_recv_exact`` raises when a caller hands it an
+  undeadlined socket; that guard satisfies the lint because it *proves*
+  the invariant instead of assuming it). ``accept`` rides the same rule
+  because an undeadlined accept loop never observes its stop flag — the
+  procmesh worker/lane-shard serve loops (ISSUE 16) poll accept under
+  ``_ACCEPT_POLL_S`` for exactly this reason.
+
+The whole package is in scope — ``tpu/dcn.py``'s data plane, ``core/io``
+socket sources, and the ``procmesh/`` control plane (worker server,
+supervisor client, lane-pool shards) alike.
 
 Usage: ``python scripts/check_socket_timeouts.py [paths...]`` (default:
 ``siddhi_tpu/``). Exit code 1 on findings. Run by
@@ -66,7 +74,7 @@ def _scan_scope(node):
             continue
         if isinstance(n, ast.Call):
             attr = _call_attr(n)
-            if attr == "recv":
+            if attr in ("recv", "accept"):
                 recvs.append(n)
             elif attr in ("settimeout", "gettimeout"):
                 armed = True
@@ -99,9 +107,9 @@ def check_file(path: str) -> list[str]:
         if recv_calls and not armed:
             for c in recv_calls:
                 problems.append(
-                    f"{path}:{c.lineno}: blocking recv in '{name}' with no "
-                    f"deadline — call settimeout(...) or guard with "
-                    f"gettimeout()")
+                    f"{path}:{c.lineno}: blocking {_call_attr(c)} in "
+                    f"'{name}' with no deadline — call settimeout(...) or "
+                    f"guard with gettimeout()")
     return problems
 
 

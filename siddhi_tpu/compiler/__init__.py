@@ -47,7 +47,13 @@ def update_variables(app_text: str, env: dict | None = None,
 
 
 def parse(app_text: str) -> SiddhiApp:
-    return Parser(app_text).parse_app()
+    app = Parser(app_text).parse_app()
+    # retain the source for process-parallel tiers: a procmesh lane-pool
+    # child rebuilds an identical engine by re-parsing the SAME text (the
+    # compile-order determinism that keeps dictionary constant codes in
+    # agreement across processes)
+    app.source_text = app_text
+    return app
 
 
 def parse_query(query_text: str) -> Query:

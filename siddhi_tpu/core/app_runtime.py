@@ -239,6 +239,11 @@ class SiddhiAppRuntime:
             try_build_host_query,
         )
         host_cfg = host_batch_config(app.annotations)
+        if host_cfg is not None:
+            # the retained source travels with the config: process-backed
+            # lane pools rebuild identical engines by re-parsing it
+            host_cfg["source_text"] = getattr(app, "source_text", None)
+        part_count = 0
         # @app:fleet: multi-tenant shared compilation — queries join the
         # engine-wide FleetManager's shape groups (one compiled program per
         # shape, cross-app lane batching); non-normalizing queries fall
@@ -308,7 +313,12 @@ class SiddhiAppRuntime:
                 self._fill_implicit(element, rt)
             elif isinstance(element, Partition):
                 q_count += 1
+                part_count += 1
                 name = f"partition-{q_count}"
+                if host_cfg is not None:
+                    # position among the app's partitions: the lane-pool
+                    # child re-parses and indexes to the same block
+                    host_cfg["part_index"] = part_count - 1
                 if fleet_mgr is not None:
                     fbridges = fleet_mgr.enroll_partition(
                         element, ctx, self._stream_defs(),

@@ -63,7 +63,9 @@ def host_batch_config(app_annotations) -> Optional[dict]:
     if ann is None and os.environ.get("SIDDHI_HOST_BATCH", "") != "1":
         return None
     cfg = {"batch": _DEF_BATCH, "lanes": _DEF_LANES,
-           "workers": int(os.environ.get("SIDDHI_HOST_WORKERS", "1"))}
+           "workers": int(os.environ.get("SIDDHI_HOST_WORKERS", "1")),
+           "workers_mode": os.environ.get("SIDDHI_HOST_WORKERS_MODE",
+                                          "thread")}
     if ann is not None:
         if ann.get("enable") and ann.get("enable").lower() == "false":
             return None
@@ -75,6 +77,17 @@ def host_batch_config(app_annotations) -> Optional[dict]:
             # parallel columnar host tier: shard the partitioned-NFA lane
             # space across N worker threads (exact per-lane parity kept)
             cfg["workers"] = int(ann.get("workers"))
+        if ann.get("workers.mode"):
+            # 'process' backs the shards with a procmesh lane pool (one
+            # child process per shard — own GIL); byte-identical outputs
+            cfg["workers_mode"] = ann.get("workers.mode")
+    if cfg["workers_mode"] not in ("thread", "process"):
+        raise ValueError(
+            f"host_batch workers.mode '{cfg['workers_mode']}' is not "
+            "thread|process")
+    if os.environ.get("SIDDHI_PROCMESH_CHILD") == "1":
+        # already inside a procmesh child: no recursive process pools
+        cfg["workers_mode"] = "thread"
     return cfg
 
 
@@ -527,10 +540,22 @@ def try_build_host_partition(partition_ast, app_context, stream_defs: dict,
                 raise DeviceCompileError(
                     "non-pattern partition queries keep the per-key "
                     "interpreter")
+            source = None
+            if cfg.get("source_text") is not None \
+                    and cfg.get("part_index") is not None:
+                # identity a lane-pool child needs to rebuild this exact
+                # engine: re-parse the SAME text, pick the SAME query
+                source = {"app_text": cfg["source_text"],
+                          "part_index": cfg["part_index"],
+                          "query_index": i,
+                          "key_attr": key_attr}
             prt = HostPartitionedNFA(q, stream_defs, key_attr,
                                      num_partitions=cfg.get(
                                          "lanes", _DEF_LANES),
-                                     workers=cfg.get("workers", 1))
+                                     workers=cfg.get("workers", 1),
+                                     workers_mode=cfg.get("workers_mode",
+                                                          "thread"),
+                                     source=source)
             rt = _HostPartitionRT(prt, stream_defs,
                                   cfg.get("batch", _DEF_BATCH))
             bridge = HostQueryBridge(

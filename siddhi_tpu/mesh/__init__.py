@@ -14,6 +14,12 @@ layer (ROADMAP item 3):
   ``mesh_replace`` rung;
 - :mod:`rebalancer` — :class:`MeshRebalancer`: one move per decision,
   recorded with its evidence before actuating.
+
+``MeshConfig(mode='process')`` swaps the in-process host shards for REAL
+OS processes (:mod:`siddhi_tpu.procmesh`): each host is its own
+interpreter + JAX runtime behind a control socket, supervised with
+heartbeats and backoff-paced restarts — the same fabric ladder,
+byte-compatible, with actual SIGKILL chaos instead of simulated kills.
 """
 
 from .fabric import MeshChaosFault, MeshConfig, MeshFabric, MeshHost

@@ -96,10 +96,30 @@ class MeshConfig:
                  spill_capacity_frames: int = _DEF_SPILL_FRAMES,
                  spill_policy: str = "block",
                  adopt_retry_max: int = _ADOPT_RETRY_MAX,
-                 playback: bool = True):
+                 playback: bool = True,
+                 mode: str = "inproc",
+                 heartbeat_interval_s: float = 0.5,
+                 worker_failure_threshold: int = 2,
+                 restart_max: int = 5,
+                 restart_base_s: float = 0.25,
+                 restart_window_s: float = 60.0,
+                 auto_restart: bool = True,
+                 worker_env: Optional[dict] = None):
+        if mode not in ("inproc", "process"):
+            raise ValueError(f"mesh mode '{mode}' is not inproc|process")
         self.capacity_per_host = int(capacity_per_host)
         self.policy = policy
         self.seed = seed
+        # mode='process': every host is its OWN OS process (procmesh) —
+        # same fabric ladder, dispatched over the control socket
+        self.mode = mode
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.worker_failure_threshold = int(worker_failure_threshold)
+        self.restart_max = int(restart_max)
+        self.restart_base_s = float(restart_base_s)
+        self.restart_window_s = float(restart_window_s)
+        self.auto_restart = bool(auto_restart)
+        self.worker_env = dict(worker_env or {})
         # None = snapshot only at migration/shutdown; N = persist the
         # tenant after every N applied chunks BEFORE the send returns (the
         # DCN snapshot_every_frames durability cadence: at 1, kill-recovery
@@ -170,6 +190,15 @@ class MeshHost:
             **self.manager.fleet.mesh_evidence(),
         }
 
+    def kill(self) -> None:
+        """Simulated SIGKILL: runtimes are DISCARDED, no flush, no
+        hand-off — process memory is gone (``ProcMeshHost.kill`` is the
+        real-process twin of this surface)."""
+        self.runtimes.clear()
+        # the manager registry too: a later close() must not "flush"
+        # runtimes whose process memory this kill simulates losing
+        self.manager.runtimes.clear()
+
     def close(self) -> None:
         self.alive = False
         self.manager.shutdown()
@@ -210,21 +239,52 @@ class MeshFabric:
         self.cfg = config or MeshConfig()
         if devices is None:
             devices = self._probe_devices(num_hosts)
-        self.hosts: dict = {
-            i: MeshHost(i, self.cfg.capacity_per_host,
-                        device=(devices[i] if i < len(devices) else None),
-                        playback=self.cfg.playback)
-            for i in range(num_hosts)}
+        # the fabric's own control-plane ring (created before the hosts:
+        # process-mode supervision records its spawn/restart decisions
+        # here); migration decisions ALSO fan out to the involved tenant
+        # apps' recorders (their operators read their own timelines)
+        self.flight = FlightRecorder(app_name="mesh")
+        self.supervisor = None
+        if self.cfg.mode == "process":
+            # procmesh: one OS process per host, the fabric ladder
+            # dispatching over control sockets (lazy import — inproc
+            # meshes never pay the subprocess machinery)
+            from ..procmesh.supervisor import (
+                ProcMeshSupervisor,
+                SupervisorConfig,
+            )
+            self.supervisor = ProcMeshSupervisor(
+                num_hosts,
+                SupervisorConfig(
+                    heartbeat_interval_s=self.cfg.heartbeat_interval_s,
+                    failure_threshold=self.cfg.worker_failure_threshold,
+                    restart_base_s=self.cfg.restart_base_s,
+                    restart_window_s=self.cfg.restart_window_s,
+                    restart_max=self.cfg.restart_max,
+                    auto_restart=self.cfg.auto_restart,
+                    env=self.cfg.worker_env),
+                flight=self.flight, playback=self.cfg.playback)
+            self.supervisor.on_failed = self.host_failed
+            self.supervisor.on_restarted = self.host_restarted
+            self.supervisor.on_escalation = self._slo_escalate
+            self.hosts: dict = {
+                i: self.supervisor.host(
+                    i, self.cfg.capacity_per_host,
+                    device=(devices[i] if i < len(devices) else None))
+                for i in range(num_hosts)}
+        else:
+            self.hosts = {
+                i: MeshHost(i, self.cfg.capacity_per_host,
+                            device=(devices[i] if i < len(devices)
+                                    else None),
+                            playback=self.cfg.playback)
+                for i in range(num_hosts)}
         self.store = LaneGroupSnapshotStore(store_root)
         self.policy = PlacementPolicy(self.cfg.policy, self.cfg.seed)
         self.plan = MeshPlan(policy=self.cfg.policy)
         self.tenants: dict = {}         # tenant_id -> _TenantState
         self._next_gid = 0
         self._lock = threading.RLock()  # hosts/plan/tenants maps
-        # the fabric's own control-plane ring; migration decisions ALSO fan
-        # out to the involved tenant apps' recorders (their operators read
-        # their own timelines)
-        self.flight = FlightRecorder(app_name="mesh")
         self.migrations = 0
         self.migration_failures = 0
         self.recoveries = 0
@@ -239,6 +299,10 @@ class MeshFabric:
         # evidence read (cumulative shares would let an hour-old burst
         # repel placements forever)
         self._ev_last_rows: dict = {}
+        # liveness monitoring starts LAST: a death callback must never
+        # observe a half-built fabric
+        if self.supervisor is not None:
+            self.supervisor.start_monitor()
 
     @staticmethod
     def _probe_devices(n: int) -> list:
@@ -372,15 +436,28 @@ class MeshFabric:
             st.seq += 1
             seq = st.seq
             host = self.hosts.get(st.host)
-            if st.migrating or host is None or not host.alive:
-                if st.spill.append(
-                        (seq, stream_id, rows, list(timestamps)),
-                        len(rows)):
-                    self.spilled_chunks += 1
-                else:
-                    self.shed_chunks += 1    # policy chose to drop: counted
+            # "runtime missing" covers the process-mode restart window: a
+            # respawned worker is alive but EMPTY until recover_tenant
+            # restores the tenant — its chunks spill like a dead host's
+            if st.migrating or host is None or not host.alive \
+                    or st.spec.tenant_id not in host.runtimes:
+                self._spill_locked(st, seq, stream_id, rows, timestamps)
                 return
-            self._apply_locked(st, seq, stream_id, rows, timestamps)
+            try:
+                self._apply_locked(st, seq, stream_id, rows, timestamps)
+            except ConnectionError:
+                # the worker process died under this very chunk (procmesh
+                # WorkerDown is a ConnectionError): the chunk spills and
+                # the recovery replay applies it through the dedup mark
+                self._spill_locked(st, seq, stream_id, rows, timestamps)
+
+    def _spill_locked(self, st: "_TenantState", seq: int, stream_id: str,
+                      rows: list, timestamps) -> None:
+        if st.spill.append((seq, stream_id, rows, list(timestamps)),
+                           len(rows)):
+            self.spilled_chunks += 1
+        else:
+            self.shed_chunks += 1        # policy chose to drop: counted
 
     def _apply_locked(self, st: _TenantState, seq: int, stream_id: str,
                       rows: list, timestamps) -> bool:
@@ -393,6 +470,28 @@ class MeshFabric:
             return False                 # replay of an applied chunk: dedup
         host = self.hosts[st.host]
         rt = host.runtimes[st.spec.tenant_id]
+        if getattr(rt, "procmesh_proxy", False):
+            # process mode: the chunk crosses the control socket (child
+            # dedups by seq — the retried-op side of exactly-once) and its
+            # OUTPUT events come back buffered; they dispatch parent-side
+            # only after the durability step below, so a child SIGKILLed
+            # between apply and ack re-applies from the restored pre-chunk
+            # state and every output is delivered exactly once
+            rt.send_chunk(seq, stream_id, [list(r) for r in rows],
+                          list(timestamps))
+            host.rows_in += len(rows)
+            prev, st.applied = st.applied, seq
+            n = self.cfg.snapshot_every_chunks
+            if n and seq % n == 0:
+                try:
+                    self._save_tenant_locked(st, rt)
+                except Exception:
+                    # not durable: the applied mark rolls back so the
+                    # spill/recovery replay re-applies this chunk
+                    st.applied = prev
+                    raise
+            rt.deliver_pending()
+            return True
         rt.input_handler(stream_id).send_rows(
             [list(r) for r in rows], list(timestamps))
         host.rows_in += len(rows)
@@ -409,8 +508,14 @@ class MeshFabric:
         revision's dedup table — restore resumes the exactly-once window
         exactly."""
         rt.flush_host()
-        return self.store.save_blob(st.gid, rt.snapshot(),
-                                    {0: (st.epoch, st.applied)})
+        rev = self.store.save_blob(st.gid, rt.snapshot(),
+                                   {0: (st.epoch, st.applied)})
+        if getattr(rt, "procmesh_proxy", False):
+            # flush-resolved outputs buffered on the proxy are covered by
+            # the revision that just landed — deliver before any teardown
+            # (migration undeploys the source right after saving)
+            rt.deliver_pending()
+        return rev
 
     # -- live migration ------------------------------------------------------
     def migrate(self, tenant_id: str, dst: int, reason: str = "operator",
@@ -522,8 +627,14 @@ class MeshFabric:
             self._reattach(rt, st)
         snap = self.store.latest_blob(st.gid)
         if snap is not None:
-            rt.restore(snap["blob"])
             mark = snap["dedup"].get(0)
+            if getattr(rt, "procmesh_proxy", False):
+                # the worker's ingest dedup mark rides the restore op so
+                # the child resumes the exactly-once window exactly
+                rt.restore(snap["blob"],
+                           applied=int(mark[1]) if mark else 0)
+            else:
+                rt.restore(snap["blob"])
             if mark is not None:
                 # the saved mark never LOWERS the live incarnation (a
                 # recovery's bump must survive restoring a pre-bump mark)
@@ -567,22 +678,77 @@ class MeshFabric:
 
     # -- crash / recovery ----------------------------------------------------
     def kill_host(self, host: int) -> list:
-        """Simulated host SIGKILL: its runtimes are DISCARDED (no flush, no
-        hand-off). Its tenants' fresh chunks spill until recovery; returns
-        the orphaned tenant ids."""
+        """Host SIGKILL: its runtimes are DISCARDED (no flush, no
+        hand-off). In-process mode simulates the loss
+        (:meth:`MeshHost.kill`); process mode delivers an ACTUAL signal 9
+        to the worker (:meth:`ProcMeshHost.kill`) — same fabric path
+        either way. Its tenants' fresh chunks spill until recovery;
+        returns the orphaned tenant ids."""
         with self._lock:
             h = self.hosts.get(host)
             if h is None:
                 return []
             h.alive = False
             orphans = sorted(h.runtimes)
-            h.runtimes.clear()           # state is gone, like the process
-            # the manager registry too: a later close() must not "flush"
-            # runtimes whose process memory this kill simulates losing
-            h.manager.runtimes.clear()
+            # EVIDENCE FIRST: the kill is on the ring before the signal
             self.flight.record("mesh", "host_killed", site=f"host:{host}",
-                               detail={"tenants": orphans})
+                               detail={"tenants": orphans,
+                                       "mode": self.cfg.mode})
+            h.kill()                     # state is gone, like the process
             return orphans
+
+    def host_failed(self, index: int) -> list:
+        """Supervisor death callback (process mode): the worker's proxies
+        are stale the instant the process dies — drop them so no caller
+        dispatches into a dead incarnation. Tenants spill until recovery;
+        returns the orphaned tenant ids."""
+        with self._lock:
+            h = self.hosts.get(index)
+            if h is None:
+                return []
+            h.alive = False
+            orphans = sorted(h.runtimes)
+            self.flight.record("mesh", "host_failed", site=f"host:{index}",
+                               detail={"tenants": orphans})
+            if hasattr(h, "drop_runtimes"):
+                h.drop_runtimes()
+            else:
+                h.kill()
+            return orphans
+
+    def host_restarted(self, index: int) -> int:
+        """Supervisor restart callback: the respawned worker is ALIVE and
+        EMPTY — replay the fabric's own recovery ladder
+        (:meth:`recover_tenant`) for every tenant the dead incarnation
+        owned, exactly like the simulated-chaos tests drive it by hand.
+        Returns the number of tenants recovered."""
+        with self._lock:
+            h = self.hosts.get(index)
+            if h is None:
+                return 0
+            h.alive = True
+            self.flight.record("mesh", "host_restarted",
+                               site=f"host:{index}")
+            if self._sm is not None and hasattr(h, "register_child_metrics"):
+                # fresh incarnation → fresh child gauge families (the old
+                # generation's were torn down with the process)
+                h.register_child_metrics(self._sm)
+            orphans = [tid for tid, st in self.tenants.items()
+                       if st.host == index
+                       and tid not in h.runtimes
+                       and not st.migrating]
+        recovered = 0
+        for tid in orphans:
+            try:
+                # back onto the respawned (empty) worker: its state
+                # restores from the snapshot store, its spill replays
+                self.recover_tenant(tid, index)
+                recovered += 1
+            except Exception:   # noqa: BLE001 — best-effort heal; the
+                # tenant keeps spilling and an operator recover still works
+                log.exception("mesh: auto-recovery of '%s' after worker %d "
+                              "restart failed", tid, index)
+        return recovered
 
     def recover_tenant(self, tenant_id: str,
                        dst: Optional[int] = None) -> int:
@@ -628,6 +794,12 @@ class MeshFabric:
     def add_host(self, capacity: Optional[int] = None) -> int:
         """Host join: a new shard enters, the plan recomputes (sticky), and
         the diff applies as bulk migrations onto the newcomer."""
+        if self.supervisor is not None:
+            # the process fleet is sized at boot (the supervisor owns the
+            # worker population); growing it live is a follow-up
+            raise ValueError(
+                "process-mode mesh has a fixed worker fleet; size it at "
+                "MeshFabric construction")
         with self._lock:
             idx = (max(self.hosts) + 1) if self.hosts else 0
             dev = self._probe_devices(idx + 1)[-1]
@@ -647,6 +819,10 @@ class MeshFabric:
         migrate its tenants out (each move is a full live migration —
         spill/snapshot/restore/replay), then close the shard. Returns the
         number of tenants moved."""
+        if self.supervisor is not None:
+            raise ValueError(
+                "process-mode mesh has a fixed worker fleet; size it at "
+                "MeshFabric construction")
         with self._lock:
             h = self.hosts.get(host)
             if h is None:
@@ -728,13 +904,35 @@ class MeshFabric:
                 continue
             for rt in list(h.runtimes.values()):
                 rt.flush_host()
+                if getattr(rt, "procmesh_proxy", False):
+                    # a flush resolves staged rows into outputs — the
+                    # buffered outbox tail dispatches now
+                    rt.deliver_pending()
+
+    def sync_children(self) -> dict:
+        """Process-mode observability pull: scrape every live worker's
+        gauge families and absorb its flight-ring tail into the fabric's
+        timeline (site-prefixed ``h{i}:``). Inproc hosts share the parent
+        recorder already — this is a no-op for them."""
+        out = {"scraped": 0, "forwarded": 0}
+        for h in list(self.hosts.values()):
+            if not h.alive or not hasattr(h, "forward_flight"):
+                continue
+            out["scraped"] += len(h.scrape_metrics())
+            out["forwarded"] += h.forward_flight(self.flight)
+        return out
 
     def report(self) -> dict:
         """Service-facing state (``GET /mesh``)."""
+        if self.supervisor is not None:
+            self.sync_children()        # fold worker timelines in first
         with self._lock:
             backlog = {t: len(st.spill) for t, st in self.tenants.items()
                        if len(st.spill)}
             return {
+                "mode": self.cfg.mode,
+                "supervisor": (self.supervisor.report()
+                               if self.supervisor is not None else None),
                 "hosts": self.evidence(),
                 "plan": self.plan.report(),
                 "tenants": len(self.tenants),
@@ -786,6 +984,15 @@ class MeshFabric:
         sm.gauge_tracker("mesh.self.spill_backlog_chunks",
                          lambda: sum(len(st.spill)
                                      for st in self.tenants.values()))
+        sm.gauge_tracker("mesh.self.process_mode",
+                         lambda: 1 if self.cfg.mode == "process" else 0)
+        if self.supervisor is not None:
+            # procmesh.w{i}.* / procmesh.self.* + the per-child scraped
+            # families (mesh.h{i}.child.*) — torn down with their worker
+            self.supervisor.register_metrics(sm)
+            for h in list(self.hosts.values()):
+                if hasattr(h, "register_child_metrics"):
+                    h.register_child_metrics(sm)
         self._sm = sm
 
     @staticmethod
@@ -804,7 +1011,13 @@ class MeshFabric:
     def close(self) -> None:
         if self._sm is not None:
             self._sm.unregister("mesh.")
+            if self.supervisor is not None:
+                self._sm.unregister("procmesh.")
             self._sm = None
+        if self.supervisor is not None:
+            # monitor first: a restart racing the teardown would respawn
+            # workers the loop below is stopping
+            self.supervisor.shutdown()
         for h in list(self.hosts.values()):
             h.close()
         self.hosts.clear()

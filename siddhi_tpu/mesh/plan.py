@@ -184,7 +184,11 @@ class PlacementPolicy:
         return (4.0 * float(ev.get("load_share", 0.0))
                 + 1.0 * min(4, int(ev.get("ejections", 0)))
                 + 0.5 * min(4, int(ev.get("slo_violations", 0)))
-                + 0.25 * min(4, int(ev.get("sheds", 0))))
+                + 0.25 * min(4, int(ev.get("sheds", 0)))
+                # process-mode only (inproc hosts report no restarts): a
+                # worker that has been respawned recently is a worse home
+                # — every restart re-pays compile and replay cost
+                + 0.5 * min(4, int(ev.get("restarts", 0))))
 
     def _score(self, host: HostSlot, free: int, has_shape: bool,
                evidence: Optional[dict]) -> tuple:

@@ -126,7 +126,10 @@ class MeshRebalancer:
                  and e.get("tenants", 0) < e.get("capacity", 0)]
         if not cands:
             return None
+        # process mode: a recently-respawned worker ranks behind a stable
+        # one at equal load (inproc hosts report no restarts — no change)
         return min(cands, key=lambda h: (deltas[h],
+                                         live[h].get("restarts", 0),
                                          live[h].get("tenants", 0), h))
 
     def _pick_tenant(self, hot: int, dst: int) -> Optional[str]:

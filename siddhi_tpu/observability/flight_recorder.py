@@ -56,7 +56,7 @@ class FlightRecorder:
     """One app's control-plane ring."""
 
     CATEGORIES = ("flow", "breaker", "device", "fleet", "host", "dcn",
-                  "slo", "mesh")
+                  "slo", "mesh", "procmesh")
 
     def __init__(self, capacity: int = 2048,
                  dump_dir: Optional[str] = None, app_name: str = ""):
@@ -106,6 +106,27 @@ class FlightRecorder:
             self.record(category, f"circuit:{new}", site,
                         detail={"from": old})
         return on_transition
+
+    def absorb(self, entries: list, site_prefix: str = "") -> int:
+        """Merge EXPORTED entries from another recorder into this ring —
+        the procmesh fabric forwarding a child worker's transitions into
+        the parent's timeline. Sites gain ``site_prefix`` (``h3:``) so a
+        merged timeline still attributes decisions to the host process
+        that made them; stamps are re-minted here (the parent ring's
+        ``t_ns`` cursor contract stays strict, arrival order preserved)."""
+        n = 0
+        for e in entries:
+            try:
+                self.record(e.get("category", "procmesh"),
+                            e.get("kind", ""),
+                            f"{site_prefix}{e.get('site', '')}",
+                            detail=e.get("detail"),
+                            trace_id=e.get("trace_id"))
+                n += 1
+            except Exception:   # noqa: BLE001 — observability must never
+                # take the forwarding path down
+                continue
+        return n
 
     # -- fault dump ------------------------------------------------------------
     def on_fault(self, reason: str, site: str = "") -> Optional[str]:

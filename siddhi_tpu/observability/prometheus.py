@@ -32,7 +32,7 @@ OPENMETRICS_CONTENT_TYPE = \
 _SCOPE_LABEL = {"stream": "stream", "flow": "stream", "device": "query",
                 "query": "query", "partition": "query", "source": "stream",
                 "dcn": "peer", "host_batch": "query", "detection": "query",
-                "slo": "query", "mesh": "host"}
+                "slo": "query", "mesh": "host", "procmesh": "worker"}
 _SAN = re.compile(r"[^a-z0-9_]+")
 
 

@@ -1,0 +1,51 @@
+"""procmesh — process-per-host mesh runtime.
+
+Each mesh host runs as its OWN OS process (its own interpreter, GIL and
+JAX runtime); ``MeshFabric`` becomes a control plane over length-prefixed
+sockets. ``mesh(mode='process')`` arms it; the fabric's placement/
+migration/rebalance/``mesh_replace`` ladder is byte-compatible with the
+in-process mode, and a SIGKILLed child recovers through the SAME
+``kill_host``/``recover_tenant`` path the simulated chaos tests exercise.
+
+Layers:
+
+- :mod:`.protocol` — the frame wire (kind u8 · len u32 · json+body) plus
+  deadline discipline every read arms;
+- :mod:`.worker` — the child entrypoint: SiddhiManager + FleetManager +
+  optional DCN worker behind one control socket, seq-deduped ingest and
+  a cursored output outbox for exactly-once under lost acks;
+- :mod:`.supervisor` — spawns/monitors/restarts workers (PeerHealth
+  heartbeats, exponential backoff with a windowed give-up budget);
+- :mod:`.host` — the fabric-side ``MeshHost``/runtime duck types;
+- :mod:`.lanepool` — ``@app:host_batch(workers.mode='process')``:
+  lane-shard children for the columnar host tier.
+"""
+
+from __future__ import annotations
+
+from .host import ProcMeshHost, RuntimeProxy, WorkerClient
+from .lanepool import LanePoolError, ProcessLanePool
+from .protocol import (
+    CONNECT_TIMEOUT_S,
+    IO_TIMEOUT_S,
+    READY_TIMEOUT_S,
+    WorkerDown,
+    WorkerOpError,
+)
+from .supervisor import ProcMeshSupervisor, SupervisorConfig, WorkerSpawnError
+
+__all__ = [
+    "CONNECT_TIMEOUT_S",
+    "IO_TIMEOUT_S",
+    "READY_TIMEOUT_S",
+    "LanePoolError",
+    "ProcMeshHost",
+    "ProcMeshSupervisor",
+    "ProcessLanePool",
+    "RuntimeProxy",
+    "SupervisorConfig",
+    "WorkerClient",
+    "WorkerDown",
+    "WorkerOpError",
+    "WorkerSpawnError",
+]

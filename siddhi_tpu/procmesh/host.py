@@ -1,0 +1,349 @@
+"""Fabric-side adapters for process-backed mesh hosts.
+
+:class:`ProcMeshHost` duck-types the in-process ``MeshHost`` surface the
+fabric dispatches against (``deploy``/``undeploy``/``evidence``/
+``free_slots``/``kill``/``close``), and :class:`RuntimeProxy` duck-types
+the slice of ``SiddhiAppRuntime`` the fabric's apply/snapshot/restore
+path touches — so ``MeshFabric``'s placement/migration/rebalance ladder
+runs unchanged in either mode, byte-compatible by construction.
+
+The proxy's delivery contract differs from the in-process runtime in ONE
+deliberate way: output events are buffered on the worker (cursored
+outbox) and the fabric dispatches them parent-side only AFTER the chunk
+is durable — so a child SIGKILLed between apply and ack re-applies from
+the restored pre-chunk state and every output is delivered exactly once
+(see ``worker.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from .protocol import (
+    CONNECT_TIMEOUT_S,
+    IO_TIMEOUT_S,
+    WorkerDown,
+    connect,
+    request,
+)
+
+
+def _soa_types(rows: list) -> Optional[str]:
+    """Derive a DCN ``pack_rows`` types string when every value fits the
+    SoA wire (bool before int: bool is an int subclass)."""
+    if not rows:
+        return None
+    width = len(rows[0])
+    kinds = []
+    for c in range(width):
+        k = None
+        for r in rows:
+            if len(r) != width:
+                return None
+            v = r[c]
+            if v is None:
+                continue
+            if isinstance(v, bool):
+                t = "b"
+            elif isinstance(v, int):
+                t = "l"
+            elif isinstance(v, float):
+                t = "d"
+            elif isinstance(v, str):
+                t = "s"
+            else:
+                return None
+            if k is None:
+                k = t
+            elif k != t:
+                return None
+        kinds.append(k or "l")      # all-null column: any numeric lane
+    return "".join(kinds)
+
+
+class WorkerClient:
+    """One persistent control connection to a worker, ops serialized under
+    a lock (the control plane is low-rate; feeder threads of one host
+    serialize here exactly like the per-host DCN ingest model). A dead
+    socket reconnects ONCE per op — every procmesh op is idempotent
+    (deploys dedup by tenant, ingests dedup by seq, restores re-restore
+    the same revision), so the retry is the lost-ack recovery path, not a
+    double-apply risk."""
+
+    def __init__(self, port_fn: Callable[[], Optional[int]],
+                 io_timeout_s: float = IO_TIMEOUT_S):
+        self._port_fn = port_fn
+        self._io_timeout_s = io_timeout_s
+        self._sock = None
+        self._lock = threading.Lock()
+
+    def _socket(self):
+        if self._sock is None:
+            port = self._port_fn()
+            if port is None:
+                raise WorkerDown("worker has no live control port")
+            self._sock = connect(port, timeout=CONNECT_TIMEOUT_S)
+        return self._sock
+
+    def drop(self) -> None:
+        with self._lock:
+            self._drop_locked()
+
+    def _drop_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def call(self, op: str, header: Optional[dict] = None,
+             body: bytes = b"", timeout: Optional[float] = None):
+        timeout = timeout or self._io_timeout_s
+        with self._lock:
+            try:
+                return request(self._socket(), op, header, body,
+                               timeout=timeout)
+            except WorkerDown:
+                # stale connection (worker restarted, idle RST): one
+                # reconnect, then the op's own idempotence carries it
+                self._drop_locked()
+                try:
+                    return request(self._socket(), op, header, body,
+                                   timeout=timeout)
+                except WorkerDown:
+                    self._drop_locked()
+                    raise
+
+
+class RuntimeProxy:
+    """The fabric's handle to one tenant runtime living in a worker
+    process — the ``SiddhiAppRuntime`` surface ``_apply_locked`` /
+    ``_save_tenant_locked`` / ``_restore_on`` dispatch against."""
+
+    procmesh_proxy = True
+
+    def __init__(self, client: WorkerClient, tenant_id: str):
+        self.client = client
+        self.tenant_id = tenant_id
+        self.callbacks: dict = {}       # stream_id -> [StreamCallback]
+        self.delivered = -1             # highest outbox idx dispatched
+        self._pending: list = []        # undispatched (idx, sid, ts, row)
+
+    # -- ingest / outputs ----------------------------------------------------
+    def send_chunk(self, seq: int, stream_id: str, rows: list,
+                   ts: list) -> bool:
+        """Ship one seq-stamped chunk; reply events buffer until the
+        fabric confirms durability and calls :meth:`deliver_pending`."""
+        from ..tpu.dcn import pack_rows
+        h = {"tenant": self.tenant_id, "stream": stream_id, "seq": seq,
+             "ack": self.delivered}
+        types = _soa_types(rows)
+        if types is not None:
+            h["enc"] = "soa"
+            rh, _ = self.client.call(
+                "ingest", h, body=pack_rows(types, rows, ts))
+        else:
+            h["rows"], h["ts"] = rows, ts
+            rh, _ = self.client.call("ingest", h)
+        self._buffer(rh.get("events", ()))
+        return bool(rh.get("applied"))
+
+    def _buffer(self, events) -> None:
+        seen = {e[0] for e in self._pending}
+        for e in events:
+            idx = e[0]
+            if idx > self.delivered and idx not in seen:
+                self._pending.append(tuple(e))
+
+    def deliver_pending(self) -> None:
+        """Dispatch buffered worker outputs to the parent-side callbacks,
+        grouped into per-stream runs (order preserved)."""
+        from ..core.event import Event
+        pending, self._pending = sorted(self._pending), []
+        i = 0
+        while i < len(pending):
+            sid = pending[i][1]
+            j = i
+            while j < len(pending) and pending[j][1] == sid:
+                j += 1
+            evs = [Event(e[2], e[3]) for e in pending[i:j]]
+            for cb in self.callbacks.get(sid, ()):
+                cb.receive(evs)
+            self.delivered = max(self.delivered, pending[j - 1][0])
+            i = j
+
+    # -- the runtime surface the fabric touches ------------------------------
+    def add_callback(self, stream_id: str, callback) -> None:
+        first = stream_id not in self.callbacks
+        self.callbacks.setdefault(stream_id, []).append(callback)
+        if first:
+            self.client.call("subscribe", {"tenant": self.tenant_id,
+                                           "stream": stream_id})
+
+    def flush_host(self) -> None:
+        rh, _ = self.client.call("flush", {"tenant": self.tenant_id,
+                                           "ack": self.delivered})
+        self._buffer(rh.get("events", ()))
+
+    def snapshot(self) -> bytes:
+        _, blob = self.client.call("snapshot", {"tenant": self.tenant_id})
+        return blob
+
+    def restore(self, blob: bytes, applied: int = 0) -> None:
+        self.client.call("restore", {"tenant": self.tenant_id,
+                                     "applied": applied}, body=blob)
+
+    def shutdown(self) -> None:     # parity with SiddhiAppRuntime.shutdown
+        self.client.call("undeploy", {"tenant": self.tenant_id})
+
+
+class ProcMeshHost:
+    """One process-backed engine shard, byte-compatible with ``MeshHost``
+    for the fabric's dispatch surface. The OS process itself belongs to
+    the supervisor (``handle``); this object is the fabric's view."""
+
+    def __init__(self, handle, capacity: int, device: Optional[int] = None,
+                 playback: bool = True):
+        self.handle = handle            # supervisor's ProcWorkerHandle
+        self.index = handle.index
+        self.capacity = capacity
+        self.device = device
+        self.playback = playback
+        self.runtimes: dict = {}        # tenant_id -> RuntimeProxy
+        self.rows_in = 0
+        self.reserved = 0
+        self.alive = True
+        self._specs: dict = {}          # tenant_id -> TenantSpec (redeploy)
+        self._sm = None
+        self._scrape_cache: dict = {}
+        self._last_child_evidence: dict = {}
+
+    @property
+    def client(self) -> WorkerClient:
+        return self.handle.client
+
+    @property
+    def free_slots(self) -> int:
+        return self.capacity - len(self.runtimes) - self.reserved
+
+    @property
+    def slot(self):
+        from ..mesh.plan import HostSlot
+        return HostSlot(self.index, self.capacity, self.device)
+
+    # -- tenant lifecycle ----------------------------------------------------
+    def deploy(self, spec) -> RuntimeProxy:
+        self.client.call("deploy", {"tenant": spec.tenant_id,
+                                    "app_text": spec.app_text,
+                                    "playback": self.playback},
+                         timeout=max(IO_TIMEOUT_S, 60.0))
+        proxy = RuntimeProxy(self.client, spec.tenant_id)
+        self.runtimes[spec.tenant_id] = proxy
+        self._specs[spec.tenant_id] = spec
+        return proxy
+
+    def undeploy(self, tenant_id: str) -> None:
+        rt = self.runtimes.pop(tenant_id, None)
+        self._specs.pop(tenant_id, None)
+        if rt is not None:
+            rt.shutdown()
+
+    def compiled_programs(self) -> int:
+        try:
+            rh, _ = self.client.call("evidence")
+            return int(rh["evidence"].get("compiled_programs", 0))
+        except WorkerDown:
+            return 0
+
+    def evidence(self) -> dict:
+        """Parent-side routing view merged with the child's fleet-tier
+        scrape; a freshly dead child serves the last good scrape so an
+        evidence walk racing a crash never takes the control plane down."""
+        try:
+            rh, _ = self.client.call("evidence")
+            self._last_child_evidence = dict(rh["evidence"])
+        except WorkerDown:
+            pass
+        child = dict(self._last_child_evidence)
+        child.pop("tenants", None)
+        child.pop("rows_in", None)
+        return {
+            "host": self.index, "device": self.device,
+            "alive": self.alive,
+            "tenants": len(self.runtimes),
+            "capacity": self.capacity,
+            "rows_in": self.rows_in,
+            "mode": "process",
+            # restart churn feeds placement/rebalance scoring: a
+            # respawned worker is a worse home until it proves stable
+            "restarts": self.handle.restarts,
+            **child,
+        }
+
+    # -- child metric aggregation -------------------------------------------
+    def scrape_metrics(self) -> dict:
+        try:
+            rh, _ = self.client.call("metrics")
+            self._scrape_cache = dict(rh.get("gauges", {}))
+        except WorkerDown:
+            pass                        # keep the last scrape
+        return self._scrape_cache
+
+    def register_child_metrics(self, sm) -> int:
+        """(Re-)register the child's scraped gauge families under
+        ``mesh.h{i}.child.*``. Idempotent by unregister-first, so a
+        restarted child's fresh families replace the old generation —
+        never leak beside it (tests/test_metrics.py pins the teardown)."""
+        self._sm = sm
+        sm.unregister(f"mesh.h{self.index}.child.")
+        names = sorted(self.scrape_metrics())
+        for name in names:
+            sm.gauge_tracker(
+                f"mesh.h{self.index}.child.{name}",
+                lambda name=name: self._scrape_cache.get(name, 0.0))
+        return len(names)
+
+    def unregister_child_metrics(self) -> None:
+        if self._sm is not None:
+            self._sm.unregister(f"mesh.h{self.index}.child.")
+
+    # -- flight-recorder forwarding -----------------------------------------
+    def forward_flight(self, flight) -> int:
+        """Absorb the child runtimes' control-plane transitions into the
+        fabric's ring (site-prefixed ``h{i}:``), tailing by the ring's
+        loss-free ``since_ns`` cursor."""
+        try:
+            rh, _ = self.client.call(
+                "flight", {"since_ns": self.handle.flight_cursor})
+        except WorkerDown:
+            return 0
+        entries = rh.get("entries", [])
+        if entries:
+            self.handle.flight_cursor = max(e["t_ns"] for e in entries)
+        return flight.absorb(entries, site_prefix=f"h{self.index}:")
+
+    # -- crash / teardown ----------------------------------------------------
+    def kill(self) -> None:
+        """REAL host SIGKILL: the supervisor nukes the child process; the
+        proxies die with it (state recovers from the parent's snapshot
+        store, exactly like the simulated in-process kill)."""
+        self.handle.kill()
+        self.drop_runtimes()
+
+    def drop_runtimes(self) -> None:
+        self.runtimes.clear()
+        self._specs.clear()
+        self.client.drop()
+
+    def close(self) -> None:
+        self.alive = False
+        self.unregister_child_metrics()
+        try:
+            self.client.call("stop", timeout=5.0)
+        except WorkerDown:
+            pass
+        self.handle.reap(timeout=5.0)
+        self.runtimes.clear()
+        self._specs.clear()

@@ -1,0 +1,169 @@
+"""procmesh control-socket wire format.
+
+One frame per control operation, the DCN tier's length-prefixed framing
+(``tpu/dcn.py``'s ``>BI`` header) with a JSON header + optional binary
+body instead of fixed structs — control ops are low-rate and schema-rich
+(deploy carries app text, snapshot/restore carry state blobs, ingest
+carries row chunks), so the header stays readable while blobs stay raw:
+
+``frame  := kind u8 · length u32 · payload``
+``payload:= hdr_len u32 · json header · body bytes``
+
+Kinds: ``F_REQ`` (supervisor/fabric → worker), ``F_RES`` (success reply),
+``F_ERR`` (structured failure reply — the op raised; the connection stays
+usable). Every request carries ``{"op": ...}``; replies echo nothing (the
+protocol is strictly one-in-flight per connection, so responses pair by
+order).
+
+Deadline discipline: every blocking read arms a socket timeout first —
+``_recv_exact`` refuses a timeout-less socket outright, the invariant
+``scripts/check_socket_timeouts.py`` pins across the package. A timeout
+at a frame boundary means *idle* (pollers continue); a timeout or close
+mid-frame means the stream can never resync and raises
+``ConnectionError``.
+
+Ingest rows ride either JSON (``enc='json'``, any row shape) or the DCN
+SoA wire (``enc='soa'`` — :func:`~siddhi_tpu.tpu.dcn.pack_rows` bytes in
+the body, the worker-owned bulk hand-off decoded by ``unpack_rows`` on
+the child), chosen per chunk by whether a types string covers the rows.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Optional
+
+_HDR = struct.Struct(">BI")     # frame kind + payload length (the DCN wire)
+_JLEN = struct.Struct(">I")     # json header length inside the payload
+
+F_REQ, F_RES, F_ERR = 1, 2, 3
+
+CONNECT_TIMEOUT_S = 5.0
+# ops include deploys (parse + numpy plan compile on the child) and
+# chunk-cadence snapshots; generous next to the DCN data-plane deadline
+IO_TIMEOUT_S = 30.0
+# child boot = interpreter + siddhi_tpu import + socket bind, under
+# fork-storm contention on a saturated CI container
+READY_TIMEOUT_S = 120.0
+
+MAX_FRAME = 256 * 1024 * 1024   # desync guard: one tenant snapshot tops out
+# far below this; a larger length prefix means a corrupt stream
+
+
+def child_env(base: Optional[dict] = None) -> dict:
+    """Spawn env for a worker/lane child: the parent may have found
+    ``siddhi_tpu`` via a ``sys.path`` insert (script-style embedding) that a
+    fresh interpreter won't repeat, so prepend the package's parent dir to
+    PYTHONPATH."""
+    import os
+    import sys
+    env = dict(os.environ if base is None else base)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    parts = [pkg_root] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                          if p and p != pkg_root]
+    env["PYTHONPATH"] = os.pathsep.join(parts)
+    return env
+
+
+class WorkerDown(ConnectionError):
+    """The worker's control socket is gone (crash, SIGKILL, stop): the op
+    did not complete and the caller must spill/retry through recovery."""
+
+
+class WorkerOpError(RuntimeError):
+    """The worker executed the op and reports a structured failure (the
+    connection itself is fine)."""
+
+
+def send_frame(sock: socket.socket, kind: int, header: dict,
+               body: bytes = b"") -> None:
+    j = json.dumps(header, separators=(",", ":")).encode()
+    payload = _JLEN.pack(len(j)) + j + body
+    sock.sendall(_HDR.pack(kind, len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket, timeout: float = IO_TIMEOUT_S):
+    """Returns ``(kind, header, body)`` or None on a cleanly closed
+    connection. Arms the deadline; idle timeouts surface as
+    ``socket.timeout`` only at a frame boundary."""
+    sock.settimeout(timeout)
+    hdr = _recv_exact(sock, _HDR.size)
+    if hdr is None:
+        return None
+    kind, n = _HDR.unpack(hdr)
+    if n > MAX_FRAME:
+        raise ConnectionError(f"oversized frame ({n} bytes): desynced")
+    payload = _recv_exact(sock, n) if n else b""
+    if payload is None or len(payload) < _JLEN.size:
+        raise ConnectionError("connection closed mid-frame")
+    (jn,) = _JLEN.unpack_from(payload, 0)
+    header = json.loads(payload[_JLEN.size:_JLEN.size + jn].decode())
+    return kind, header, payload[_JLEN.size + jn:]
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    if sock.gettimeout() is None:
+        # every blocking recv in this package must carry a deadline
+        # (scripts/check_socket_timeouts.py pins the same invariant in CI)
+        raise ValueError("blocking recv on a socket without a timeout")
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            if buf:
+                # a half-read frame can never resync
+                raise ConnectionError(
+                    "connection timed out mid-frame") from None
+            raise
+        if not chunk:
+            if buf:
+                raise ConnectionError("connection closed mid-frame")
+            return None
+        buf += chunk
+    return buf
+
+
+def request(sock: socket.socket, op: str, header: Optional[dict] = None,
+            body: bytes = b"", timeout: float = IO_TIMEOUT_S):
+    """One synchronous control op: send ``F_REQ``, block for the paired
+    reply. Returns ``(header, body)``; raises :class:`WorkerOpError` on an
+    ``F_ERR`` reply and :class:`WorkerDown` when the socket dies."""
+    h = dict(header or ())
+    h["op"] = op
+    try:
+        send_frame(sock, F_REQ, h, body)
+        res = recv_frame(sock, timeout=timeout)
+    except socket.timeout as e:
+        raise WorkerDown(f"worker op '{op}' timed out") from e
+    except (OSError, ConnectionError) as e:
+        raise WorkerDown(f"worker op '{op}' failed: {e}") from e
+    if res is None:
+        raise WorkerDown(f"worker closed during op '{op}'")
+    kind, rh, rbody = res
+    if kind == F_ERR:
+        raise WorkerOpError(rh.get("error", "worker op failed"))
+    if kind != F_RES:
+        raise WorkerDown(f"unexpected frame kind {kind} for op '{op}'")
+    return rh, rbody
+
+
+def connect(port: int, timeout: float = CONNECT_TIMEOUT_S
+            ) -> socket.socket:
+    """Dial a worker's control port (loopback only — procmesh children are
+    co-resident by construction) with connect + IO deadlines armed. A
+    refused/unreachable dial means the process is gone: ``WorkerDown``."""
+    try:
+        sock = socket.create_connection(
+            ("127.0.0.1", port), timeout=timeout)
+    except (OSError, socket.timeout) as e:
+        raise WorkerDown(f"worker port {port} unreachable: {e}") from e
+    sock.settimeout(IO_TIMEOUT_S)
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass                        # best-effort: control ops are small
+    return sock

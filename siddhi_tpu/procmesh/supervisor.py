@@ -1,0 +1,359 @@
+"""procmesh supervisor: spawns host workers, heartbeats them, restarts
+crashed children, and replays the fabric's recovery path against REAL
+SIGKILLed processes.
+
+Each worker is one OS process (``python -m siddhi_tpu.procmesh.worker``)
+handshaking its control port over stdout. Liveness detection runs two
+signals through the existing resilience machinery:
+
+- ``Popen.poll()`` — the process exited: unambiguous hard evidence, the
+  peer detector :meth:`~siddhi_tpu.resilience.dcn_guard.PeerHealth.trip`
+  path (no waiting out a failure threshold);
+- heartbeat pings over the control socket — a hung-but-running child
+  accumulates failures through the same ``PeerHealth``/CircuitBreaker
+  ladder the DCN guard uses for peers (healthy → suspect → down).
+
+Restarts pace through :class:`~siddhi_tpu.resilience.circuit.
+RestartBackoff` (exponential, windowed give-up budget — a crash loop
+becomes a recorded ``decision:give_up``, never a respawn storm). Every
+supervisor decision lands on the flight recorder BEFORE the actuation
+(``scripts/check_guard_coverage.py`` pins restart/give-up the same way it
+pins the rebalancer), and heartbeat replies carry the workers' SLO
+``mesh_replace`` escalations back to the fabric — the cross-host rung
+works across process boundaries.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+from ..observability.flight_recorder import FlightRecorder
+from ..resilience.circuit import RestartBackoff
+from ..resilience.dcn_guard import PeerHealth
+from .host import ProcMeshHost, WorkerClient
+from .protocol import READY_TIMEOUT_S, WorkerDown, child_env
+
+log = logging.getLogger("siddhi_tpu.procmesh")
+
+
+class WorkerSpawnError(RuntimeError):
+    """A child process failed to reach its PROCMESH_READY handshake."""
+
+
+class SupervisorConfig:
+    """Supervisor knobs (kwargs-style; everything has a default)."""
+
+    def __init__(self, heartbeat_interval_s: float = 0.5,
+                 failure_threshold: int = 2,
+                 down_cooldown_s: float = 0.5,
+                 ready_timeout_s: float = READY_TIMEOUT_S,
+                 restart_base_s: float = 0.25,
+                 restart_max_s: float = 8.0,
+                 restart_window_s: float = 60.0,
+                 restart_max: int = 5,
+                 auto_restart: bool = True,
+                 env: Optional[dict] = None):
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.failure_threshold = int(failure_threshold)
+        self.down_cooldown_s = float(down_cooldown_s)
+        self.ready_timeout_s = float(ready_timeout_s)
+        self.restart_base_s = float(restart_base_s)
+        self.restart_max_s = float(restart_max_s)
+        self.restart_window_s = float(restart_window_s)
+        self.restart_max = int(restart_max)
+        self.auto_restart = bool(auto_restart)
+        self.env = dict(env or {})
+
+
+class ProcWorkerHandle:
+    """Supervisor-side state of one child: the process, its live control
+    port, the peer-health detector, and the restart budget."""
+
+    def __init__(self, index: int, cfg: SupervisorConfig):
+        self.index = index
+        self.cfg = cfg
+        self.proc: Optional[subprocess.Popen] = None
+        self.port: Optional[int] = None
+        self.pid: Optional[int] = None
+        self.restarts = 0
+        self.kills = 0
+        self.gave_up = False
+        self.health = PeerHealth(cfg.failure_threshold,
+                                 cfg.down_cooldown_s)
+        self.backoff = RestartBackoff(cfg.restart_base_s, cfg.restart_max_s,
+                                      cfg.restart_window_s, cfg.restart_max)
+        self.client = WorkerClient(lambda: self.port)
+        self.flight_cursor = 0          # child flight-ring tail (since_ns)
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill(self) -> None:
+        """REAL SIGKILL — the chaos sites the in-process fabric simulates
+        become an actual dead process here."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            self.kills += 1
+        self.port = None
+        self.client.drop()
+        self.health.trip()
+
+    def reap(self, timeout: float = 5.0) -> None:
+        if self.proc is not None:
+            try:
+                self.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=timeout)
+
+
+class ProcMeshSupervisor:
+    """Spawns and shepherds one worker process per mesh host."""
+
+    def __init__(self, num_workers: int,
+                 config: Optional[SupervisorConfig] = None,
+                 flight: Optional[FlightRecorder] = None,
+                 playback: bool = True):
+        self.cfg = config or SupervisorConfig()
+        self.flight = flight or FlightRecorder(app_name="procmesh")
+        self.playback = playback
+        self.handles = {i: ProcWorkerHandle(i, self.cfg)
+                        for i in range(num_workers)}
+        # fabric wiring: death/recovery callbacks + the SLO escalation
+        # relay (heartbeat replies carry worker-side mesh_replace asks)
+        self.on_failed: Optional[Callable[[int], None]] = None
+        self.on_restarted: Optional[Callable[[int], None]] = None
+        self.on_gave_up: Optional[Callable[[int], None]] = None
+        self.on_escalation: Optional[Callable[[dict], None]] = None
+        self._sm = None
+        self._stop = threading.Event()
+        self._monitor = None
+        self._lock = threading.RLock()
+        # spawn the fleet: fork everything first, then collect handshakes
+        # (boot cost is import-dominated; overlapping hides it)
+        for h in self.handles.values():
+            self._spawn(h)
+        for h in self.handles.values():
+            self._await_ready(h)
+
+    # -- spawning ------------------------------------------------------------
+    def _spawn(self, h: ProcWorkerHandle) -> None:
+        env = child_env()
+        env["SIDDHI_PROCMESH_CHILD"] = "1"      # no recursive pools
+        env.update(self.cfg.env)
+        h.proc = subprocess.Popen(
+            [sys.executable, "-m", "siddhi_tpu.procmesh.worker",
+             "--index", str(h.index),
+             "--playback", "1" if self.playback else "0"],
+            stdout=subprocess.PIPE, stderr=None, env=env)
+        h.pid = h.proc.pid
+        h.port = None
+
+    def _await_ready(self, h: ProcWorkerHandle) -> None:
+        import json as _json
+        line_box: list = []
+
+        def read_line():
+            line_box.append(h.proc.stdout.readline())
+
+        t = threading.Thread(target=read_line, daemon=True)
+        t.start()
+        t.join(self.cfg.ready_timeout_s)
+        line = line_box[0].decode() if line_box else ""
+        if not line.startswith("PROCMESH_READY"):
+            rc = h.proc.poll()
+            h.kill()
+            raise WorkerSpawnError(
+                f"worker {h.index} never reached READY "
+                f"(rc={rc}, line={line!r})")
+        hello = _json.loads(line.split(None, 1)[1])
+        h.port = int(hello["port"])
+        h.pid = int(hello["pid"])
+        h.health.record_success()
+
+    # -- fabric host construction -------------------------------------------
+    def host(self, index: int, capacity: int,
+             device: Optional[int] = None) -> ProcMeshHost:
+        return ProcMeshHost(self.handles[index], capacity, device=device,
+                            playback=self.playback)
+
+    # -- liveness / restart --------------------------------------------------
+    def start_monitor(self) -> None:
+        if self._monitor is not None:
+            return
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="procmesh-supervisor",
+            daemon=True)
+        self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.is_set():
+            for h in list(self.handles.values()):
+                if self._stop.is_set():
+                    return
+                if h.gave_up:
+                    continue
+                try:
+                    self._check(h)
+                except Exception:   # noqa: BLE001 — one worker's turmoil
+                    # must never take the monitor down
+                    log.exception("procmesh: monitor check of worker %d "
+                                  "failed", h.index)
+            self._stop.wait(self.cfg.heartbeat_interval_s)
+
+    def _check(self, h: ProcWorkerHandle) -> None:
+        if not h.alive:
+            self._on_death(h, cause="exit")
+            return
+        if not h.health.allow_probe():
+            return
+        try:
+            rh, _ = h.client.call("ping", timeout=self.cfg.down_cooldown_s
+                                  + self.cfg.heartbeat_interval_s)
+        except WorkerDown:
+            h.health.record_failure()
+            if h.health.state == "down":
+                self._on_death(h, cause="heartbeat")
+            return
+        h.health.record_success()
+        if rh.get("uptime_s", 0) > self.cfg.restart_window_s:
+            h.backoff.note_stable()     # a stable child earns its budget back
+        for decision in rh.get("escalations", ()):
+            if self.on_escalation is not None:
+                self.on_escalation(decision)
+
+    def _on_death(self, h: ProcWorkerHandle, cause: str) -> None:
+        with self._lock:
+            if h.gave_up:
+                return
+            # EVIDENCE FIRST: the failure is on the ring before any
+            # teardown or restart moves state
+            self.flight.record(
+                "procmesh", "worker_down", site=f"worker:{h.index}",
+                detail={"cause": cause, "pid": h.pid,
+                        "rc": h.proc.poll() if h.proc else None})
+            h.health.trip()
+            h.port = None
+            h.client.drop()
+            if self.on_failed is not None:
+                self.on_failed(h.index)
+            if self.cfg.auto_restart:
+                self.restart(h.index)
+
+    def restart(self, index: int) -> bool:
+        """Backoff-paced restart of one worker. The decision (with its
+        delay and budget evidence) hits the ring BEFORE the spawn; a
+        spent budget records ``decision:give_up`` instead and the worker
+        stays down for an operator."""
+        h = self.handles[index]
+        with self._lock:
+            delay = h.backoff.next_delay()
+            if delay is None:
+                self.flight.record(
+                    "procmesh", "decision:give_up",
+                    site=f"worker:{index}",
+                    detail={"restarts": h.restarts,
+                            **h.backoff.report()})
+                h.gave_up = True
+                if self._sm is not None:
+                    # a permanently-down worker's families go with it —
+                    # no zombie gauges behind a give-up
+                    self._sm.unregister(f"procmesh.w{index}.")
+                if self.on_gave_up is not None:
+                    self.on_gave_up(index)
+                return False
+            self.flight.record(
+                "procmesh", "decision:restart_worker",
+                site=f"worker:{index}",
+                detail={"delay_s": delay, "restarts": h.restarts,
+                        **h.backoff.report()})
+            if delay:
+                self._stop.wait(delay)
+            h.kill()                    # no half-dead twins
+            h.reap()
+            self._spawn(h)
+            try:
+                self._await_ready(h)
+            except WorkerSpawnError:
+                log.warning("procmesh: worker %d respawn failed", index)
+                return self.restart(index)      # burn budget, maybe give up
+            h.restarts += 1
+            h.client.drop()
+            if self.on_restarted is not None:
+                self.on_restarted(index)
+            return True
+
+    def kill_worker(self, index: int) -> Optional[int]:
+        """Operator/chaos SIGKILL (recorded): returns the killed pid. The
+        monitor (or an explicit :meth:`restart`) drives recovery."""
+        h = self.handles[index]
+        pid = h.pid
+        self.flight.record("procmesh", "decision:kill_worker",
+                           site=f"worker:{index}", detail={"pid": pid})
+        h.kill()
+        return pid
+
+    # -- observability -------------------------------------------------------
+    def register_metrics(self, sm) -> None:
+        """``procmesh.w{i}.*`` + ``procmesh.self.*`` families; worker
+        stop/give-up and supervisor shutdown unregister their prefixes
+        (tests/test_metrics.py pins the teardown)."""
+        self._sm = sm
+        for h in self.handles.values():
+            i = h.index
+            sm.gauge_tracker(f"procmesh.w{i}.alive",
+                             lambda h=h: 1 if h.alive else 0)
+            sm.gauge_tracker(f"procmesh.w{i}.pid",
+                             lambda h=h: h.pid or 0)
+            sm.gauge_tracker(f"procmesh.w{i}.restarts_total",
+                             lambda h=h: h.restarts)
+            sm.gauge_tracker(f"procmesh.w{i}.kills_total",
+                             lambda h=h: h.kills)
+            sm.gauge_tracker(f"procmesh.w{i}.peer_state_code",
+                             lambda h=h: h.health.state_code)
+            sm.gauge_tracker(f"procmesh.w{i}.downtime_s",
+                             lambda h=h: h.health.downtime_s())
+        sm.gauge_tracker("procmesh.self.workers",
+                         lambda: sum(1 for h in self.handles.values()
+                                     if h.alive))
+        sm.gauge_tracker("procmesh.self.restarts_total",
+                         lambda: sum(h.restarts
+                                     for h in self.handles.values()))
+        sm.gauge_tracker("procmesh.self.gave_up",
+                         lambda: sum(1 for h in self.handles.values()
+                                     if h.gave_up))
+
+    def report(self) -> dict:
+        return {"workers": {
+            h.index: {"alive": h.alive, "pid": h.pid, "port": h.port,
+                      "restarts": h.restarts, "kills": h.kills,
+                      "gave_up": h.gave_up, **h.health.report()}
+            for h in self.handles.values()}}
+
+    # -- teardown ------------------------------------------------------------
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+            self._monitor = None
+        for h in self.handles.values():
+            try:
+                h.client.call("stop", timeout=2.0)
+            except WorkerDown:
+                pass
+            h.client.drop()
+        for h in self.handles.values():
+            if h.alive:
+                h.proc.terminate()
+        for h in self.handles.values():
+            h.reap()
+        if self._sm is not None:
+            self._sm.unregister("procmesh.")
+            self._sm = None

@@ -123,3 +123,52 @@ class CircuitBreaker:
             if self.state != CircuitState.OPEN or self.opened_at is None:
                 return 0.0
             return max(0.0, self.cooldown_s - (self.clock() - self.opened_at))
+
+
+class RestartBackoff:
+    """Exponential restart pacing with a windowed give-up budget — the
+    supervisor side of crash recovery (``procmesh/supervisor.py``).
+
+    Each restart attempt inside the sliding window doubles the delay from
+    ``base_s`` up to ``max_s``; once ``max_restarts`` attempts land inside
+    ``window_s`` the budget is exhausted and :meth:`next_delay` returns
+    None — a crash-looping child must become a visible give-up decision,
+    not an infinite respawn storm. A child that stays up long enough for
+    its attempts to age out of the window earns its budget back
+    (:meth:`note_stable` resets it immediately on positive evidence)."""
+
+    def __init__(self, base_s: float = 0.25, max_s: float = 8.0,
+                 window_s: float = 60.0, max_restarts: int = 5,
+                 clock=time.monotonic):
+        if max_restarts < 1:
+            raise ValueError("restart max_restarts must be >= 1")
+        self.base_s = float(base_s)
+        self.max_s = float(max_s)
+        self.window_s = float(window_s)
+        self.max_restarts = int(max_restarts)
+        self.clock = clock
+        self.history: list = []         # attempt times inside the window
+        self._lock = threading.Lock()
+
+    def next_delay(self):
+        """Delay (seconds) to pause before the next restart attempt, or
+        None when the windowed budget is exhausted (give up)."""
+        with self._lock:
+            now = self.clock()
+            self.history = [t for t in self.history
+                            if now - t <= self.window_s]
+            if len(self.history) >= self.max_restarts:
+                return None
+            delay = min(self.max_s, self.base_s * (2 ** len(self.history)))
+            self.history.append(now)
+            return delay
+
+    def note_stable(self) -> None:
+        with self._lock:
+            self.history.clear()
+
+    def report(self) -> dict:
+        with self._lock:
+            return {"attempts_in_window": len(self.history),
+                    "max_restarts": self.max_restarts,
+                    "window_s": self.window_s}

@@ -80,6 +80,7 @@ class PeerHealth:
                                       clock=clock)
         self.clock = clock
         self.down_since: Optional[float] = None
+        self.last_downtime_s = 0.0      # length of the last CLOSED outage
 
     @property
     def state(self) -> str:
@@ -100,6 +101,10 @@ class PeerHealth:
         return self.breaker.allow()
 
     def record_success(self) -> None:
+        if self.down_since is not None:
+            # close the outage, keeping its length: the restart-latency
+            # evidence outlives the recovery that ends it
+            self.last_downtime_s = max(0.0, self.clock() - self.down_since)
         self.breaker.record_success()
         self.down_since = None
 
@@ -118,11 +123,22 @@ class PeerHealth:
         if self.down_since is None:
             self.down_since = self.clock()
 
+    def downtime_s(self) -> float:
+        """Seconds since the FIRST down transition of this outage (0 when
+        not down) — the DCN takeover deadline's clock, and the procmesh
+        supervisor's restart-latency evidence (how long a worker's tenants
+        were orphaned before the respawn healed them)."""
+        if self.down_since is None:
+            return 0.0
+        return max(0.0, self.clock() - self.down_since)
+
     def report(self) -> dict:
         return {"state": self.state, "state_code": self.state_code,
                 "consecutive_failures": self.breaker.consecutive_failures,
                 "open_count": self.breaker.open_count,
-                "down_since": self.down_since}
+                "down_since": self.down_since,
+                "downtime_s": self.downtime_s(),
+                "last_downtime_s": self.last_downtime_s}
 
 
 class SpillQueue:

@@ -1127,6 +1127,10 @@ class DCNWorker:
 
     # -- DCN server side ------------------------------------------------------
     def _accept_loop(self) -> None:
+        try:
+            self._srv.settimeout(0.5)  # accept() wakes to observe shutdown
+        except OSError:
+            return                     # closed before the loop started
         while not self._stop.is_set():
             try:
                 conn, _ = self._srv.accept()
@@ -1357,3 +1361,104 @@ class DCNWorker:
         if self._sm is not None:
             self._sm.unregister("dcn.")
             self._sm = None
+
+
+class DCNIngestClient:
+    """External bulk-ingest feeder for one DCNWorker's data port — the
+    worker-owned ingest path of the procmesh runtime: a parent process (or
+    a bench feeder) frames rows as ``K_ROWS`` straight into a child's DCN
+    data plane, never touching the control socket.
+
+    Speaks the exact peer wire: ``(sender, group, epoch, seq)`` prefix,
+    empty trace-context block, :func:`pack_rows` SoA body. The receiver's
+    per-``(sender→group)`` dedup table makes a retried frame (lost ack)
+    idempotent, so the client retries with ONE reconnect per send — the
+    same discipline as the peer forwarding machine, minus redirects (an
+    external feeder targets one worker that owns its groups).
+
+    ``sender`` defaults to 255: host indices are small dense ints, so the
+    top of the u8 space is free for external feeders (two feeders into one
+    group need distinct sender ids or their seq spaces collide)."""
+
+    EXTERNAL_SENDER = 255
+
+    def __init__(self, port: int, types: str, *, sender: int = 255,
+                 group: int = 0, epoch: int = 0,
+                 connect_timeout_s: float = CONNECT_TIMEOUT_S,
+                 io_timeout_s: float = IO_TIMEOUT_S):
+        self.port = int(port)
+        self.types = types
+        self.sender = int(sender)
+        self.group = int(group)
+        self.epoch = int(epoch)
+        self.connect_timeout_s = connect_timeout_s
+        self.io_timeout_s = io_timeout_s
+        self.seq = 0
+        self.sent_rows = 0
+        self.retries = 0
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _socket(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(("127.0.0.1", self.port),
+                                         timeout=self.connect_timeout_s)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _exchange(self, kind: int, payload: bytes):
+        """One framed request/reply with a single reconnect retry (every
+        frame kind here is idempotent: K_ROWS dedups by seq, K_FLUSH is a
+        barrier)."""
+        for attempt in (0, 1):
+            try:
+                s = self._socket()
+                send_msg(s, kind, payload)
+                reply = recv_msg(s, timeout=self.io_timeout_s)
+                if reply is None:
+                    raise ConnectionError("worker closed before ack")
+                return reply
+            except (OSError, ConnectionError):
+                self._drop()
+                if attempt:
+                    raise
+                self.retries += 1
+
+    def send(self, rows: list, timestamps: list) -> None:
+        """Ship one seq-stamped chunk; returns once the worker ACKED it
+        (applied or deduped — either way it is durable per the worker's
+        snapshot cadence)."""
+        with self._lock:
+            self.seq += 1
+            frame = (_ROWS_HDR.pack(self.sender, self.group, self.epoch,
+                                    self.seq)
+                     + _pack_ctxs([])
+                     + pack_rows(self.types, rows, timestamps))
+            kind, _ = self._exchange(K_ROWS, frame)
+            if kind != K_ACK:
+                raise ConnectionError(
+                    f"expected K_ACK for seq {self.seq}, got kind {kind}")
+            self.sent_rows += len(rows)
+
+    def flush(self) -> int:
+        """Flush barrier: the worker drains staged lanes; returns its
+        match_count."""
+        with self._lock:
+            kind, payload = self._exchange(K_FLUSH, b"")
+            if kind != K_FLUSHED:
+                raise ConnectionError(
+                    f"expected K_FLUSHED, got kind {kind}")
+            return struct.unpack(">q", payload)[0]
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop()

@@ -772,7 +772,8 @@ class HostPartitionedNFA:
 
     def __init__(self, query, stream_defs: dict, key_attr: str,
                  num_partitions: int = 32, query_index: int = 0,
-                 compiler=None, engine=None, workers: int = 1):
+                 compiler=None, engine=None, workers: int = 1,
+                 workers_mode: str = "thread", source=None):
         # a prebuilt (compiler, engine) pair shares ONE compiled plan across
         # runtimes (fleet shared compilation) — the caller already injected
         # the key-equality rewrite; otherwise compile from the query AST
@@ -797,8 +798,19 @@ class HostPartitionedNFA:
         self.key_is_string = d.attribute_type(key_attr) == DataType.STRING
         self.lane_states = [self.engine.init_state() for _ in range(self.P)]
         self.workers = max(1, int(workers))
+        self.workers_mode = workers_mode
+        # child-rebuild identity for mode='process' (app source + the
+        # partition/query position; host_bridge supplies it)
+        self._source = source
         self._pool = None
-        if self.workers > 1:
+        self._proc_pool = None          # ProcessLanePool, spawned lazily
+        # process-backed lane shards (procmesh lanepool): children spawn
+        # on the FIRST batch — a deployed-but-idle app must not pay worker
+        # boot. Shard count stays `workers` and the merge order is the
+        # thread path's, so outputs stay byte-identical.
+        self._proc_armed = (self.workers > 1 and workers_mode == "process"
+                            and source is not None)
+        if self.workers > 1 and not self._proc_armed:
             import os
             from concurrent.futures import ThreadPoolExecutor
             # pool capped at the machine's cores: numpy threads beyond the
@@ -811,16 +823,39 @@ class HostPartitionedNFA:
 
     @property
     def match_count(self) -> int:
+        if self._proc_pool is not None:
+            return self._proc_pool.match_count()
         return sum(st["matches"] for st in self.lane_states)
+
+    def _lane_pool(self):
+        """The process lane pool, spawned on first use — seeded with the
+        CURRENT parent lane snapshots so a restore that landed before the
+        first batch carries over."""
+        if self._proc_pool is None:
+            from ..procmesh.lanepool import ProcessLanePool
+            self._proc_pool = ProcessLanePool(
+                self._source, self.P, self.workers,
+                [self.engine.snapshot_state(st) for st in self.lane_states])
+        return self._proc_pool
 
     def close(self) -> None:
         """Shut the worker pool down (bridge finalize / app shutdown):
         pool threads are non-daemon and would otherwise outlive the
         runtime. Late flushes after close() fall back to the sequential
-        loop — identical outputs either way."""
+        loop — identical outputs either way (the process pool first syncs
+        its lane states back so nothing is lost)."""
         pool, self._pool = self._pool, None
         if pool is not None:
             pool.shutdown(wait=False)
+        ppool, self._proc_pool = self._proc_pool, None
+        if ppool is not None:
+            try:
+                self.lane_states = [self.engine.restore_state(s)
+                                    for s in ppool.snapshot_lanes()]
+            except Exception:   # noqa: BLE001 — children already gone:
+                pass            # parent states stay the last known good
+            self._proc_armed = False
+            ppool.close()
 
     def lanes_of(self, key_codes: np.ndarray) -> np.ndarray:
         if self.key_is_string:
@@ -863,7 +898,14 @@ class HostPartitionedNFA:
         bounds = np.searchsorted(lanes_sorted, np.arange(self.P + 1))
         cols_sorted = {k: v[order] for k, v in cols.items()}
         ts_sorted = ts[order]
-        if self._pool is not None and self.P >= 2:
+        if self._proc_armed and self.P >= 2:
+            # process-backed shards: ship each child its slice of the
+            # lane-sorted batch; children return shard-relative match
+            # positions the pool maps through `order` — same merge, same
+            # stable sort, byte-identical outputs
+            outs = self._lane_pool().step(bounds, cols_sorted, ts_sorted,
+                                          order)
+        elif self._pool is not None and self.P >= 2:
             # lane-space sharding: W contiguous shards step concurrently;
             # merge keeps lane order so the by-event sort below is
             # byte-identical to the sequential loop
@@ -892,12 +934,17 @@ class HostPartitionedNFA:
 
     # -- snapshots -------------------------------------------------------
     def snapshot_state(self) -> dict:
+        if self._proc_pool is not None:
+            # the shard owners hold the live states
+            return {"lanes": self._proc_pool.snapshot_lanes()}
         return {"lanes": [self.engine.snapshot_state(st)
                           for st in self.lane_states]}
 
     def restore_state(self, snap: dict) -> None:
         self.lane_states = [self.engine.restore_state(s)
                             for s in snap["lanes"]]
+        if self._proc_pool is not None:
+            self._proc_pool.restore_lanes(snap["lanes"])
 
 
 # ---------------------------------------------------------------------------

@@ -644,8 +644,16 @@ def test_check_socket_timeouts_lint_catches_offenders(tmp_path):
         "    return sock.recv(4096)\n"
         "def ok(sock):\n"
         "    sock.settimeout(5.0)\n"
-        "    return sock.recv(4096)\n")
+        "    return sock.recv(4096)\n"
+        "def serve(listener):\n"
+        "    return listener.accept()\n"
+        "def serve_ok(listener):\n"
+        "    listener.settimeout(0.5)\n"
+        "    return listener.accept()\n")
     problems = mod.check_file(str(offender))
-    assert len(problems) == 2, problems
+    assert len(problems) == 3, problems
     assert any("create_connection" in p for p in problems)
     assert any("blocking recv in 'drain'" in p for p in problems)
+    # ISSUE 16: undeadlined accept loops (the procmesh serve loops) are
+    # findings too — they'd never observe their stop flag
+    assert any("blocking accept in 'serve'" in p for p in problems)

@@ -176,6 +176,22 @@ def test_guard_metric_families_unregister_on_shutdown():
         snap = msm.snapshot_trackers()
         assert not any(k.startswith("mesh.")
                        for d in snap.values() for k in d)
+
+        # ISSUE 16: a process-mode fabric adds the procmesh.w{i}.* worker
+        # gauges and the scraped per-child mesh.h{i}.child.* families —
+        # close() must tear down EVERY child prefix with the fleet (dead
+        # processes must not leave zombie gauges behind)
+        pfab = MeshFabric(1, tempfile.mkdtemp(prefix="gm-procmesh-"),
+                          MeshConfig(capacity_per_host=2, mode="process",
+                                     heartbeat_interval_s=0.2))
+        pfab.register_metrics(msm)
+        gauges = msm.snapshot_trackers()["gauges"]
+        assert gauges["mesh.self.process_mode"].value == 1
+        assert "procmesh.w0.alive" in gauges
+        pfab.close()
+        snap = msm.snapshot_trackers()
+        assert not any(k.startswith(("mesh.", "procmesh."))
+                       for d in snap.values() for k in d)
         mrt.shutdown()
     finally:
         m.shutdown()
