@@ -1845,7 +1845,127 @@ def child_procmesh() -> None:
           f"recover={out['restart_recovery']['recover_s']}s, "
           f"replayed={rep['replayed_chunks']}, oracle_ok={oracle_ok}",
           file=sys.stderr)
+
+    # -- 3) parent recovery: real SIGKILL of the PARENT mid-ingest ---------
+    # (ISSUE 17, the MULTICHIP_r08 line): the durable fabric runs as its
+    # own killable OS process (procmesh.parentmain), is SIGKILLed at a
+    # journal/actuate boundary mid-ingest, and a restarted parent against
+    # the same root must re-adopt the still-live workers (no restore) and
+    # finish the feed byte-identical to solo oracles with zero dup chunks.
+    out["parent_recovery"] = _procmesh_parent_recovery()
     print(json.dumps(out))
+
+
+def _procmesh_parent_recovery() -> dict:
+    """One crash/restart cycle of ``siddhi_tpu.procmesh.parentmain``:
+    SIGKILL at ``SIDDHI_CRASH_AT=ingest.applied:3`` (mid-feed, after the
+    workers are up — the re-adopt path, the one cold-standby HA cannot
+    take), then a clean run over the same root. Parent stdio goes to a
+    FILE, not a pipe: the orphaned workers inherit the parent's fds, so a
+    pipe would never reach EOF after the kill."""
+    import signal
+    import tempfile
+
+    from siddhi_tpu import SiddhiManager, StreamCallback
+    from siddhi_tpu.procmesh.parentmain import APP_TMPL, chunk_rows
+
+    P_HOSTS, P_TENANTS, P_CHUNKS, P_WIDTH = 2, 2, 4, 2
+    crash_site = os.environ.get("BENCH_PARENT_CRASH_AT", "ingest.applied:3")
+    root = tempfile.mkdtemp(prefix="pmesh-parent-")
+    logp = os.path.join(root, "parent.log")
+    cmd = [sys.executable, "-m", "siddhi_tpu.procmesh.parentmain",
+           "--root", root, "--hosts", str(P_HOSTS),
+           "--tenants", str(P_TENANTS), "--chunks", str(P_CHUNKS),
+           "--width", str(P_WIDTH)]
+    env = {k: v for k, v in os.environ.items() if k != "SIDDHI_CRASH_AT"}
+    env["JAX_PLATFORMS"] = "cpu"
+    res = {"crash_site": crash_site, "hosts": P_HOSTS,
+           "tenants": P_TENANTS, "chunks": P_CHUNKS}
+    t_kill = None
+    with open(logp, "ab") as lf:
+        p1 = subprocess.run(cmd, stdout=lf, stderr=lf, cwd=REPO,
+                            env={**env, "SIDDHI_CRASH_AT": crash_site},
+                            timeout=120)
+        t_kill = time.perf_counter()
+    res["killed_rc"] = p1.returncode
+    if p1.returncode != -signal.SIGKILL:
+        res["ok"] = False
+        res["error"] = (f"crash run exited {p1.returncode}, expected "
+                        f"-SIGKILL at {crash_site}")
+        return res
+    time.sleep(0.2)
+    with open(logp, "ab") as lf:
+        p2 = subprocess.run(cmd, stdout=lf, stderr=lf, cwd=REPO, env=env,
+                            timeout=120)
+    res["restart_wall_s"] = round(time.perf_counter() - t_kill, 2)
+    done = None
+    if p2.returncode == 0:
+        with open(logp, "r", encoding="utf-8", errors="replace") as lf:
+            for line in lf:
+                if line.startswith("PARENT_DONE "):
+                    done = json.loads(line[len("PARENT_DONE "):])
+    if done is None:
+        res["ok"] = False
+        res["error"] = f"restarted parent exited {p2.returncode}"
+        return res
+
+    rec = done.get("recovery") or {}
+    res.update({
+        "recover_s": rec.get("recover_s"),
+        "readopted_workers": rec.get("readopted_workers"),
+        "restored_workers": rec.get("restored_workers"),
+        "readopted_tenants": rec.get("readopted_tenants"),
+        "restored_tenants": rec.get("restored_tenants"),
+        "journal_records_replayed": rec.get("journal_records_replayed"),
+        "journal_lsn": (done.get("journal") or {}).get("lsn"),
+        "dup_chunks": done.get("dup_chunks"),
+        "applied": done.get("applied"),
+    })
+    # solo-oracle sink parity: replay the same deterministic chunks
+    # through an in-process runtime, dedup the JSONL sink keep-first on
+    # the (epoch, idx) identity — byte-exact or the cycle lied
+    oracle_ok = all(v == P_CHUNKS for v in (done.get("applied") or {})
+                    .values()) and not done.get("dup_chunks")
+    m = SiddhiManager()
+    for i in range(P_TENANTS):
+        rt = m.create_siddhi_app_runtime(APP_TMPL.format(i=i),
+                                         playback=True)
+        solo = []
+        rt.add_callback("Out", StreamCallback(
+            lambda evs, solo=solo: solo.extend(list(e.data) for e in evs)))
+        rt.start()
+        ih = rt.input_handler("S")
+        for c in range(P_CHUNKS):
+            rows, ts = chunk_rows(c, P_WIDTH)
+            ih.send_rows([list(r) for r in rows], list(ts))
+        seen, got = set(), []
+        try:
+            with open(os.path.join(root, f"sink_t{i}.jsonl"),
+                      encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        e = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue          # only a torn final line is legal
+                    if (e["e"], e["i"]) not in seen:
+                        seen.add((e["e"], e["i"]))
+                        got.append(e["d"])
+        except OSError:
+            pass
+        if got != solo:
+            oracle_ok = False
+    m.shutdown()
+    res["oracle_ok"] = oracle_ok
+    res["ok"] = bool(oracle_ok
+                     and rec.get("readopted_workers", 0)
+                     + rec.get("restored_workers", 0) == P_HOSTS)
+    print(f"# procmesh parent recovery @{crash_site}: "
+          f"recover={res['recover_s']}s readopted_workers="
+          f"{res['readopted_workers']} restored_tenants="
+          f"{res['restored_tenants']} journal_replayed="
+          f"{res['journal_records_replayed']} dup={res['dup_chunks']} "
+          f"oracle_ok={oracle_ok}", file=sys.stderr)
+    return res
 
 
 # ---------------------------------------------------------------------------

@@ -464,7 +464,10 @@ def run_procmesh_guard(tol: float, deadline_s: int = 600) -> int:
     3. per-host-process scaling efficiency at the largest size above the
        stored floor scaled by ``tol`` — a CORE-LIMITED plumbing floor
        (see the baseline note: the recording container has one core, so
-       this pins control-socket overhead, not hardware scaling)."""
+       this pins control-socket overhead, not hardware scaling);
+    4. the parent-SIGKILL cycle (ISSUE 17): a durable fabric killed at a
+       journal boundary and restarted must re-adopt/restore every worker
+       and keep its sinks byte-exact vs solo oracles (binary, no band)."""
     with open(os.path.join(REPO, "BASELINE.json")) as f:
         baseline = json.load(f).get("procmesh_baseline") or {}
     if not baseline:
@@ -472,7 +475,6 @@ def run_procmesh_guard(tol: float, deadline_s: int = 600) -> int:
             "procmesh_guard": "skipped",
             "reason": "no procmesh_baseline in BASELINE.json"}))
         return 0
-    eff_floor = tol * float(baseline.get("scaling_efficiency_min", 0.06))
     rec_ceiling = float(baseline.get("restart_recover_ceiling_s", 15.0)) \
         / max(tol, 1e-9)
 
@@ -533,15 +535,42 @@ def run_procmesh_guard(tol: float, deadline_s: int = 600) -> int:
             f"restart recovery took {recover_s:.1f}s, over the ceiling "
             f"{rec_ceiling:.1f}s (stored "
             f"{baseline.get('restart_recover_ceiling_s')}s / {tol})")
+    # ISSUE 17: the child also SIGKILLs the PARENT at a journal boundary
+    # and restarts it — the durable fabric must re-adopt/restore every
+    # worker and keep the sinks byte-exact (binary verdict, no band)
+    prec = data.get("parent_recovery") or {}
+    if not prec:
+        failures.append("no parent_recovery block in the procmesh line "
+                        "(durable-fabric phase did not run)")
+    elif not prec.get("ok"):
+        failures.append(
+            "parent-SIGKILL recovery broke: "
+            + (prec.get("error")
+               or f"oracle_ok={prec.get('oracle_ok')} readopted="
+                  f"{prec.get('readopted_workers')} restored="
+                  f"{prec.get('restored_workers')} "
+                  f"dup={prec.get('dup_chunks')}"))
+    # scaling_efficiency_min is a FRACTION OF IDEAL, where ideal per-host
+    # efficiency on this machine is min(hosts, cores)/hosts: on a 1-core
+    # container (the recording box, see the baseline note) 8 worker
+    # processes time-slice one core, so perfect plumbing still measures
+    # 1/8 — judging the raw number against a fixed floor would make the
+    # guard's verdict depend on where it runs, not on the code
     eff = data.get("scaling_efficiency_max_size")
+    guard_hosts = max(1, int(data.get("hosts") or baseline.get("hosts", 1)))
+    guard_cores = max(1, int(data.get("cores") or os.cpu_count() or 1))
+    ideal_eff = min(guard_hosts, guard_cores) / guard_hosts
+    eff_floor = tol * ideal_eff * \
+        float(baseline.get("scaling_efficiency_min", 0.4))
     if eff is None:
         failures.append("missing scaling_efficiency_max_size")
     elif eff < eff_floor:
         failures.append(
             f"procmesh scaling efficiency {eff:.3f} below the floor "
-            f"{eff_floor:.3f} ({tol} x stored "
-            f"{baseline.get('scaling_efficiency_min')}) — core-limited "
-            f"plumbing bound, see procmesh_baseline note")
+            f"{eff_floor:.3f} ({tol} x stored fraction-of-ideal "
+            f"{baseline.get('scaling_efficiency_min')} x ideal "
+            f"{ideal_eff:.3f} at {guard_hosts} hosts/{guard_cores} "
+            f"core(s)) — see procmesh_baseline note")
 
     print(json.dumps({
         "hosts": data.get("hosts"),
@@ -552,8 +581,14 @@ def run_procmesh_guard(tol: float, deadline_s: int = 600) -> int:
         "replayed_chunks": rec.get("replayed_chunks"),
         "dup_chunks": rec.get("dup_chunks"),
         "restart_oracle_ok": rec.get("oracle_ok"),
+        "parent_recover_s": prec.get("recover_s"),
+        "parent_readopted_workers": prec.get("readopted_workers"),
+        "parent_restored_tenants": prec.get("restored_tenants"),
+        "parent_journal_replayed": prec.get("journal_records_replayed"),
+        "parent_recovery_ok": prec.get("ok"),
         "scaling_efficiency": eff,
         "efficiency_floor": eff_floor,
+        "efficiency_ideal": ideal_eff,
         "recover_ceiling_s": rec_ceiling,
         "ok": not failures,
     }))

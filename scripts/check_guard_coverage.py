@@ -44,6 +44,16 @@ tier and asserts the resilience wrap is actually installed:
    runtime teardown. Checked structurally (record precedes actuate in
    each source).
 
+7. **durable fabric journal-intent-before-actuate** — on a durable
+   process fabric every control-plane mutation must hit the
+   ``FabricJournal`` BEFORE the worker op it describes: a parent crash in
+   the gap then re-resolves the mutation from the journal instead of
+   leaving a ghost (actuated-but-unjournaled) or a lie
+   (journaled-as-done-but-never-actuated, the unrecoverable direction).
+   Checked structurally per mutation site (``check_journal_intent``,
+   importable — tests/test_parent_recovery.py also feeds it a synthetic
+   offender to prove the check can fail).
+
 Run from tier-1 (tests/test_fleet_guard.py); exits non-zero on any gap.
 """
 
@@ -66,6 +76,62 @@ def check(name, cond, detail=""):
     else:
         failures.append(name)
         print(f"FAIL {name} {detail}")
+
+
+def journal_intent_sites():
+    """(site, source, journal_marker, actuate_marker) per durable-fabric
+    mutation: the journal append must lexically precede the actuation in
+    each source body."""
+    from siddhi_tpu.mesh import fabric as fab_mod
+    from siddhi_tpu.procmesh import supervisor as sup_mod
+    fab = fab_mod.MeshFabric
+    sup = sup_mod.ProcMeshSupervisor
+    return [
+        ("fabric.add_tenants: deploy journaled before the worker deploy",
+         inspect.getsource(fab.add_tenants),
+         'self._journal("deploy"', ".deploy(spec)"),
+        ("fabric.remove_tenant: undeploy journaled before the worker op",
+         inspect.getsource(fab.remove_tenant),
+         'self._journal("undeploy"', ".undeploy("),
+        ("fabric.migrate: intent journaled before the first state move",
+         inspect.getsource(fab._migrate_reserved),
+         'self._journal("migrate_intent"', "st.migrating = True"),
+        ("fabric.migrate: commit journaled before the spill replay",
+         inspect.getsource(fab._migrate_reserved),
+         'self._journal("migrate_commit"', "self._replay_spill_locked("),
+        ("fabric.recover_tenant: recover journaled before the restore",
+         inspect.getsource(fab._recover_admitted),
+         'self._journal("recover"', "self._restore_on("),
+        ("fabric.snapshot: delivery cursor journaled before dispatch",
+         inspect.getsource(fab._save_tenant_locked),
+         'self._journal("cursor"', "rt.deliver_pending()"),
+        ("supervisor.restart: consumed attempt journaled before respawn",
+         inspect.getsource(sup.restart),
+         'self._journal("worker_restart"', "self._spawn(h)"),
+        ("supervisor.restart: give-up journaled before abandoning",
+         inspect.getsource(sup.restart),
+         'self._journal("worker_gave_up"', "h.gave_up = True"),
+    ]
+
+
+def check_journal_intent(sites=None) -> list:
+    """Failure strings for any mutation whose journal append does not
+    precede its actuation (empty = discipline holds)."""
+    problems = []
+    for name, src, journal_marker, actuate_marker in \
+            (journal_intent_sites() if sites is None else sites):
+        j_at = src.find(journal_marker)
+        a_at = src.find(actuate_marker)
+        if j_at < 0:
+            problems.append(f"{name}: journal marker "
+                            f"{journal_marker!r} not found")
+        elif a_at < 0:
+            problems.append(f"{name}: actuation marker "
+                            f"{actuate_marker!r} not found")
+        elif a_at < j_at:
+            problems.append(f"{name}: actuation at {a_at} precedes "
+                            f"journal append at {j_at}")
+    return problems
 
 
 def main() -> int:
@@ -238,6 +304,11 @@ def main() -> int:
               0 <= rec_at < act_at,
               f"(record at {rec_at}, teardown at {act_at})")
 
+        # 7) durable fabric: journal intent before actuation (ISSUE 17)
+        problems = check_journal_intent()
+        check("every durable-fabric mutation journals before actuating",
+              not problems, f"({problems})")
+
         # live: a synthetic rebalancer actuation must land on the fabric
         # ring BEFORE the migration's own entries (ring order = append
         # order), and the tenant must actually move
@@ -276,7 +347,7 @@ def main() -> int:
         return 1
     print("\nguard coverage OK: fleet group step, device dispatch/collect, "
           "host_batch step, slo decision paths, mesh decision paths, "
-          "procmesh supervisor decision paths")
+          "procmesh supervisor decision paths, durable journal intent")
     return 0
 
 

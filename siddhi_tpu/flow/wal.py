@@ -28,16 +28,15 @@ from __future__ import annotations
 
 import logging
 import os
-import struct
 import threading
-import zlib
 from typing import Iterator, Optional
 
 from ..query_api.definition import DataType
+from .records import REC_HDR as _REC_HDR
+from .records import pack_record, scan_file
 
 log = logging.getLogger("siddhi_tpu.flow.wal")
 
-_REC_HDR = struct.Struct(">IIQ")      # payload_len, crc32, first_seq
 _SEG_FMT = "%020d.wal"
 
 # shared column-type vocabulary with tpu/dcn.py and native/ingress.cpp
@@ -101,26 +100,16 @@ class WriteAheadLog:
         if not segs:
             return
         path = os.path.join(self.dir, segs[-1])
-        good_end, last_seq = 0, None
-        with open(path, "rb") as f:
-            buf = f.read()
-        pos = 0
-        while pos + _REC_HDR.size <= len(buf):
-            n, crc, first = _REC_HDR.unpack_from(buf, pos)
-            end = pos + _REC_HDR.size + n
-            if end > len(buf):
-                break                    # torn: header written, payload cut
-            payload = buf[pos + _REC_HDR.size: end]
-            if zlib.crc32(payload) != crc:
-                break                    # torn or corrupt mid-record
+        last_seq = None
+        scan = scan_file(path)
+        for first, payload in scan:
             rows, _ = _unpack(payload)
             last_seq = first + len(rows) - 1
-            good_end = pos = end
-        if good_end < len(buf):
+        if scan.torn:
             log.warning("wal %s: truncating torn tail (%d -> %d bytes)",
-                        path, len(buf), good_end)
+                        path, len(scan.buf), scan.good_end)
             with open(path, "r+b") as f:
-                f.truncate(good_end)
+                f.truncate(scan.good_end)
         if last_seq is not None:
             self.next_seq = last_seq + 1
         else:
@@ -143,9 +132,7 @@ class WriteAheadLog:
                 self._roll()
             first = self.next_seq
             payload = _pack(self.types, rows, timestamps)
-            self._fh.write(_REC_HDR.pack(len(payload), zlib.crc32(payload),
-                                         first))
-            self._fh.write(payload)
+            self._fh.write(pack_record(payload, first))
             self._fh.flush()
             if self.fsync:
                 os.fsync(self._fh.fileno())
@@ -173,20 +160,8 @@ class WriteAheadLog:
             # bounds every seq in this one
             if i + 1 < len(segs) and int(segs[i + 1].split(".")[0]) <= from_seq:
                 continue
-            with open(os.path.join(self.dir, name), "rb") as f:
-                buf = f.read()
-            pos = 0
-            while pos + _REC_HDR.size <= len(buf):
-                n, crc, first = _REC_HDR.unpack_from(buf, pos)
-                end = pos + _REC_HDR.size + n
-                if end > len(buf):
-                    self._warn_replay_stop(name, pos, i, len(segs))
-                    return
-                payload = buf[pos + _REC_HDR.size: end]
-                if zlib.crc32(payload) != crc:
-                    self._warn_replay_stop(name, pos, i, len(segs))
-                    return
-                pos = end
+            scan = scan_file(os.path.join(self.dir, name))
+            for first, payload in scan:
                 rows, tss = _unpack(payload)
                 if first + len(rows) - 1 < from_seq:
                     continue
@@ -194,6 +169,9 @@ class WriteAheadLog:
                     skip = from_seq - first
                     rows, tss, first = rows[skip:], tss[skip:], from_seq
                 yield rows, tss, first
+            if scan.torn:
+                self._warn_replay_stop(name, scan.good_end, i, len(segs))
+                return
 
     def _warn_replay_stop(self, seg: str, pos: int, idx: int,
                           n_segs: int) -> None:

@@ -67,6 +67,7 @@ one multiplexed feed (the locality policy's job) and the tables agree.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Callable, Optional
@@ -104,9 +105,15 @@ class MeshConfig:
                  restart_base_s: float = 0.25,
                  restart_window_s: float = 60.0,
                  auto_restart: bool = True,
-                 worker_env: Optional[dict] = None):
+                 worker_env: Optional[dict] = None,
+                 durable: bool = False,
+                 journal_fsync: bool = False,
+                 journal_checkpoint_every: int = 256):
         if mode not in ("inproc", "process"):
             raise ValueError(f"mesh mode '{mode}' is not inproc|process")
+        if durable and mode != "process":
+            raise ValueError("durable=True requires mode='process' (the "
+                             "fabric journal recovers real worker processes)")
         self.capacity_per_host = int(capacity_per_host)
         self.policy = policy
         self.seed = seed
@@ -130,6 +137,12 @@ class MeshConfig:
         self.spill_policy = spill_policy
         self.adopt_retry_max = int(adopt_retry_max)
         self.playback = playback
+        # durable control plane: every fabric mutation journals its intent
+        # BEFORE actuating, so a SIGKILLed PARENT recovers — live workers
+        # re-adopt without restore, dead ones restore from snapshots
+        self.durable = bool(durable)
+        self.journal_fsync = bool(journal_fsync)
+        self.journal_checkpoint_every = int(journal_checkpoint_every)
 
 
 class MeshHost:
@@ -210,7 +223,8 @@ class _TenantState:
     seq/applied marks, and the migration spill queue."""
 
     __slots__ = ("spec", "gid", "host", "lock", "migrate_lock", "seq",
-                 "applied", "spill", "migrating", "callbacks", "epoch")
+                 "applied", "spill", "migrating", "callbacks", "epoch",
+                 "raw_hooks", "raw_streams")
 
     def __init__(self, spec: TenantSpec, gid: int, host: int, cfg: MeshConfig):
         self.spec = spec
@@ -227,6 +241,10 @@ class _TenantState:
         self.spill = SpillQueue(cfg.spill_capacity_frames, cfg.spill_policy)
         self.migrating = False
         self.callbacks: list = []       # (stream_id, fn) — re-attached on move
+        # durable sinks: fn([(epoch, idx, sid, ts, row), ...]) — re-armed
+        # on every proxy (re)creation, replayed across a parent crash
+        self.raw_hooks: list = []
+        self.raw_streams: set = set()   # streams captured for raw hooks
 
 
 class MeshFabric:
@@ -244,6 +262,23 @@ class MeshFabric:
         # here); migration decisions ALSO fan out to the involved tenant
         # apps' recorders (their operators read their own timelines)
         self.flight = FlightRecorder(app_name="mesh")
+        # durable control plane: the journal replays BEFORE anything is
+        # spawned — worker give-up budgets and tenant ownership come out
+        # of it, and the supervisor's adopt-or-spawn pass consumes them
+        self.journal = None
+        self._recovery: dict = {}       # parent-recovery stats (report())
+        self._staged_outputs: dict = {}  # tid -> journaled undelivered rows
+        self._resync_tids: list = []    # re-adopted tenants to re-snapshot
+        jstate = None
+        t0 = time.monotonic()
+        if self.cfg.durable:
+            from ..procmesh.journal import FabricJournal
+            self.journal = FabricJournal(
+                os.path.join(store_root, "journal"),
+                fsync=self.cfg.journal_fsync)
+            ckpt, tail = self.journal.replay()
+            if ckpt is not None or tail:
+                jstate = self._merge_journal(ckpt, tail)
         self.supervisor = None
         if self.cfg.mode == "process":
             # procmesh: one OS process per host, the fabric ladder
@@ -262,8 +297,12 @@ class MeshFabric:
                     restart_window_s=self.cfg.restart_window_s,
                     restart_max=self.cfg.restart_max,
                     auto_restart=self.cfg.auto_restart,
-                    env=self.cfg.worker_env),
-                flight=self.flight, playback=self.cfg.playback)
+                    env=self.cfg.worker_env,
+                    run_dir=(os.path.join(store_root, "run")
+                             if self.cfg.durable else None)),
+                flight=self.flight, playback=self.cfg.playback,
+                journal=self.journal,
+                worker_state=(jstate or {}).get("workers"))
             self.supervisor.on_failed = self.host_failed
             self.supervisor.on_restarted = self.host_restarted
             self.supervisor.on_escalation = self._slo_escalate
@@ -299,6 +338,12 @@ class MeshFabric:
         # evidence read (cumulative shares would let an hour-old burst
         # repel placements forever)
         self._ev_last_rows: dict = {}
+        if jstate is not None and jstate.get("tenants"):
+            self._recover_parent(jstate, t0)
+        if self.journal is not None:
+            # recovery (or a clean boot) compacts the inherited tail away:
+            # the next parent crash replays from this checkpoint
+            self._journal_checkpoint()
         # liveness monitoring starts LAST: a death callback must never
         # observe a half-built fabric
         if self.supervisor is not None:
@@ -320,6 +365,35 @@ class MeshFabric:
     def _site(self, site: str) -> None:
         if self.chaos is not None:
             self.chaos(site)
+
+    def _crash(self, site: str) -> None:
+        """``SIDDHI_CRASH_AT`` hook at an actuate boundary (armed for
+        durable fabrics only — the journal is what makes a SIGKILL here
+        recoverable; the journal-side boundaries fire inside
+        :meth:`FabricJournal.append` itself)."""
+        if self.journal is not None:
+            from ..procmesh.journal import crash_point
+            crash_point(site)
+
+    def _journal(self, kind: str, **fields) -> int:
+        if self.journal is None:
+            return -1
+        return self.journal.append(kind, **fields)
+
+    def _wire_proxy(self, st: "_TenantState", rt) -> None:
+        """Arm a (re)created worker proxy's durability taps: the epoch its
+        outbox indices are namespaced under, the raw sink hooks, and the
+        delivery-cursor journal callback (``delivered`` records are what a
+        recovering parent reconciles child outboxes against)."""
+        if self.journal is None or not getattr(rt, "procmesh_proxy", False):
+            return
+        rt.out_epoch = st.epoch
+        rt.raw_hooks = list(st.raw_hooks)
+        for sid in sorted(st.raw_streams):
+            rt.subscribe(sid)           # idempotent on the child
+        tid = st.spec.tenant_id
+        rt.on_delivered = lambda idx, tid=tid, rt=rt: self._journal(
+            "delivered", tenant=tid, epoch=rt.out_epoch, idx=idx)
 
     # -- deployment ----------------------------------------------------------
     def add_tenants(self, app_texts: list) -> MeshPlan:
@@ -344,10 +418,33 @@ class MeshFabric:
                 host = new_plan.host_of(spec.tenant_id)
                 st = _TenantState(spec, self._next_gid, host, self.cfg)
                 self._next_gid += 1
+                # INTENT FIRST: the deploy is in the journal before any
+                # worker sees it — a parent crash in the gap re-resolves
+                # to a (re)deploy on recovery, never a ghost tenant
+                self._journal("deploy", tenant=spec.tenant_id, gid=st.gid,
+                              host=host, app_text=spec.app_text)
                 self.tenants[spec.tenant_id] = st
-                self._arm_slo_hook(self.hosts[host].deploy(spec))
+                rt = self.hosts[host].deploy(spec)
+                self._crash("deploy.actuated")
+                self._wire_proxy(st, rt)
+                self._arm_slo_hook(rt)
             self.plan = new_plan
         return new_plan
+
+    def remove_tenant(self, tenant_id: str) -> bool:
+        """Undeploy one tenant fabric-wide (journaled before the worker
+        op, so a recovering parent never resurrects it)."""
+        with self._lock:
+            st = self.tenants.get(tenant_id)
+            if st is None:
+                return False
+            self._journal("undeploy", tenant=tenant_id)
+            host = self.hosts.get(st.host)
+            if host is not None and tenant_id in host.runtimes:
+                host.undeploy(tenant_id)
+            del self.tenants[tenant_id]
+            self.plan.assignment.pop(tenant_id, None)
+        return True
 
     def add_callback(self, tenant_id: str, stream_id: str, fn) -> None:
         """Attach an output callback that SURVIVES migration (re-attached
@@ -359,6 +456,25 @@ class MeshFabric:
             rt = self.hosts[st.host].runtimes.get(tenant_id)
             if rt is not None:
                 rt.add_callback(stream_id, StreamCallback(fn))
+
+    def add_output_hook(self, tenant_id: str, fn, streams=()) -> None:
+        """Durable-sink tap (process mode): ``fn`` receives raw outbox
+        batches ``[(epoch, idx, sid, ts, row), ...]`` BEFORE the
+        event-callback dispatch; ``streams`` names the output streams to
+        capture (child-side capture arms per stream). Delivery is
+        at-least-once across a parent crash (the dispatched-but-uncursored
+        window re-ships on recovery) — sinks dedup by the ``(epoch,
+        idx)`` identity, which is unique per emission across restores
+        (``epoch`` bumps per incarnation)."""
+        st = self.tenants[tenant_id]
+        with st.lock:
+            st.raw_hooks.append(fn)
+            st.raw_streams.update(streams)
+            rt = self.hosts[st.host].runtimes.get(tenant_id)
+            if rt is not None and getattr(rt, "procmesh_proxy", False):
+                rt.raw_hooks.append(fn)
+                for sid in streams:
+                    rt.subscribe(sid)
 
     def _reattach(self, rt, st: _TenantState) -> None:
         from ..core.stream import StreamCallback
@@ -426,6 +542,12 @@ class MeshFabric:
         ``shed``/``drop_oldest`` trade loss for memory, every dropped
         chunk counted in ``shed_chunks``/the queue's counters — loss is a
         visible policy choice, never silent."""
+        j = self.journal
+        if j is not None and \
+                j.records_since_ckpt >= self.cfg.journal_checkpoint_every:
+            # amortized compaction on the ingest path (no locks held):
+            # replay cost after a parent crash stays bounded
+            self._journal_checkpoint()
         st = self.tenants[tenant_id]
         host = self.hosts.get(st.host)
         if st.migrating or host is None or not host.alive:
@@ -479,6 +601,10 @@ class MeshFabric:
             # state and every output is delivered exactly once
             rt.send_chunk(seq, stream_id, [list(r) for r in rows],
                           list(timestamps))
+            # applied on the child, not yet cursored in the journal: a
+            # parent crash here re-adopts the live child and takes ITS
+            # applied mark as authoritative (resync)
+            self._crash("ingest.applied")
             host.rows_in += len(rows)
             prev, st.applied = st.applied, seq
             n = self.cfg.snapshot_every_chunks
@@ -511,6 +637,15 @@ class MeshFabric:
         rev = self.store.save_blob(st.gid, rt.snapshot(),
                                    {0: (st.epoch, st.applied)})
         if getattr(rt, "procmesh_proxy", False):
+            # cursor AFTER the revision landed, BEFORE delivery: a parent
+            # crash in either gap recovers — the journaled undelivered
+            # outputs are the only copy once the child dies, so they ride
+            # the cursor record (staged replay re-ships them)
+            if self.journal is not None:
+                self._journal("cursor", tenant=st.spec.tenant_id,
+                              applied=st.applied, epoch=st.epoch,
+                              outputs=[[rt.out_epoch] + e
+                                       for e in rt.pending_outputs()])
             # flush-resolved outputs buffered on the proxy are covered by
             # the revision that just landed — deliver before any teardown
             # (migration undeploys the source right after saving)
@@ -568,6 +703,12 @@ class MeshFabric:
         self._record_move(tenant_id, src, dst, reason, decided)
         src_rt = self.hosts[src].runtimes.get(tenant_id)
         try:
+            # intent → committed two-record protocol: a parent crash
+            # anywhere between these resolves to exactly one owner (src —
+            # recovery scrubs any half-adopted dst copy and restores from
+            # the pre-undeploy revision)
+            self._journal("migrate_intent", tenant=tenant_id, src=src,
+                          dst=dst)
             with st.lock:
                 st.migrating = True      # fresh chunks spill from here on
             self._site("mesh.migrate.freeze")
@@ -579,13 +720,24 @@ class MeshFabric:
                 self.hosts[src].undeploy(tenant_id)
             self._site("mesh.migrate.src_down")
             self._adopt(st, dst)
+            self._crash("migrate.adopted")
             with st.lock:
                 st.host = dst
+                # the dst child is a fresh incarnation whose outbox indices
+                # restart at 0: without an epoch bump its outputs would
+                # collide with the pre-move (epoch, idx) identities and an
+                # idempotent sink would drop them as duplicates
+                st.epoch += 1
+                new_rt = self.hosts[dst].runtimes.get(tenant_id)
+                if new_rt is not None:
+                    self._wire_proxy(st, new_rt)
                 slot = self.plan.assignment.get(tenant_id)
                 if slot is not None:
                     from .plan import MeshSlot
                     self.plan.assignment[tenant_id] = MeshSlot(
                         dst, slot.shape, self.hosts[dst].device)
+                self._journal("migrate_commit", tenant=tenant_id, dst=dst,
+                              applied=st.applied, epoch=st.epoch)
                 st.migrating = False
                 self._replay_spill_locked(st)
             self.migrations += 1
@@ -640,6 +792,7 @@ class MeshFabric:
                 # recovery's bump must survive restoring a pre-bump mark)
                 st.epoch = max(st.epoch, int(mark[0]))
                 st.applied = int(mark[1])
+        self._wire_proxy(st, rt)
         self._arm_slo_hook(rt)
 
     def _replay_spill_locked(self, st: _TenantState) -> None:
@@ -773,6 +926,7 @@ class MeshFabric:
         self.flight.record("mesh", "decision:recover_tenant",
                            site=f"tenant:{tenant_id}",
                            detail={"dst": dst, "from": st.host})
+        self._journal("recover", tenant=tenant_id, dst=dst)
         with st.lock:
             self._restore_on(st, dst)
             # incarnation bump AFTER the restore (which re-reads the saved
@@ -786,9 +940,318 @@ class MeshFabric:
                 from .plan import MeshSlot
                 self.plan.assignment[tenant_id] = MeshSlot(
                     dst, slot.shape, self.hosts[dst].device)
+            rt = self.hosts[dst].runtimes.get(tenant_id)
+            if rt is not None:
+                self._wire_proxy(st, rt)    # fresh incarnation, fresh epoch
+            self._journal("cursor", tenant=tenant_id, applied=st.applied,
+                          epoch=st.epoch)
             self._replay_spill_locked(st)
         self.recoveries += 1
         return dst
+
+    # -- parent recovery (durable control plane) -----------------------------
+    @staticmethod
+    def _merge_journal(ckpt: Optional[dict], tail: list) -> dict:
+        """Fold a checkpoint plus its journal tail into the recovered
+        control-plane state: ``{next_gid, tenants, workers, records}``.
+        Per-tenant: ``host`` (owner), ``applied``/``epoch`` (the
+        exactly-once window), ``delivered`` (the ``(epoch, idx)`` delivery
+        high-water), ``outputs`` (journaled undelivered outbox entries —
+        the only copy once a child dies) and ``intent`` (an uncommitted
+        migration, resolved to the src owner)."""
+        state = {"next_gid": 0, "tenants": {}, "workers": {}, "records": 0}
+        if ckpt:
+            state["next_gid"] = int(ckpt.get("next_gid", 0))
+            for tid, t in (ckpt.get("tenants") or {}).items():
+                state["tenants"][tid] = dict(t)
+            for w, s in (ckpt.get("workers") or {}).items():
+                state["workers"][int(w)] = dict(s)
+        ts = state["tenants"]
+        for rec in tail:
+            state["records"] += 1
+            k = rec.get("k")
+            if k == "deploy":
+                ts[rec["tenant"]] = {
+                    "app_text": rec["app_text"], "gid": int(rec["gid"]),
+                    "host": int(rec["host"]), "applied": 0, "epoch": 0,
+                    "delivered": [-1, -1], "outputs": [], "intent": None}
+                state["next_gid"] = max(state["next_gid"],
+                                        int(rec["gid"]) + 1)
+            elif k == "undeploy":
+                ts.pop(rec["tenant"], None)
+            elif k == "cursor":
+                t = ts.get(rec["tenant"])
+                if t is not None:
+                    t["applied"] = int(rec["applied"])
+                    t["epoch"] = int(rec["epoch"])
+                    if "outputs" in rec:
+                        t["outputs"] = rec["outputs"]
+            elif k == "delivered":
+                t = ts.get(rec["tenant"])
+                if t is not None:
+                    cur = tuple(int(x) for x in
+                                (t.get("delivered") or (-1, -1)))
+                    new = (int(rec["epoch"]), int(rec["idx"]))
+                    if new > cur:
+                        t["delivered"] = list(new)
+            elif k == "migrate_intent":
+                t = ts.get(rec["tenant"])
+                if t is not None:
+                    t["intent"] = {"src": int(rec["src"]),
+                                   "dst": int(rec["dst"])}
+            elif k == "migrate_commit":
+                t = ts.get(rec["tenant"])
+                if t is not None:
+                    t["host"] = int(rec["dst"])
+                    t["applied"] = int(rec.get("applied", t["applied"]))
+                    t["epoch"] = int(rec.get("epoch", t["epoch"]))
+                    t["intent"] = None
+            elif k == "recover":
+                t = ts.get(rec["tenant"])
+                if t is not None:
+                    t["host"] = int(rec["dst"])
+            elif k == "worker_restart":
+                w = state["workers"].setdefault(
+                    int(rec["worker"]),
+                    {"restarts": 0, "gave_up": False, "attempt_ages_s": []})
+                w["restarts"] = int(w.get("restarts", 0)) + 1
+                w["attempt_ages_s"] = list(rec.get("attempt_ages_s", ()))
+            elif k == "worker_gave_up":
+                w = state["workers"].setdefault(
+                    int(rec["worker"]),
+                    {"restarts": 0, "gave_up": False, "attempt_ages_s": []})
+                w["gave_up"] = True
+        return state
+
+    def _recover_parent(self, state: dict, t0: float) -> None:
+        """Rebuild the control plane after a PARENT crash (the journal's
+        raison d'être): workers the supervisor re-adopted keep their live
+        tenants WITHOUT restore — a resync op reconciles their outbox
+        cursor against the journaled delivery cursor and their applied
+        mark is authoritative; tenants on dead/respawned workers flow
+        through the existing snapshot-restore + spill-replay ladder, with
+        journaled-but-undelivered outputs staged for
+        :meth:`resume_output_delivery`."""
+        from ..compiler import parse as _parse
+        sup = self.supervisor
+        stats = {
+            "readopted_workers": sum(
+                1 for h in sup.handles.values() if h.adopted),
+            "restored_workers": sum(
+                1 for h in sup.handles.values()
+                if not h.adopted and not h.gave_up),
+            "readopted_tenants": 0, "restored_tenants": 0,
+            "journal_records_replayed": int(state.get("records", 0)),
+            "recover_s": 0.0,
+        }
+        # EVIDENCE FIRST: the recovery decision is on the ring before any
+        # worker op moves state
+        self.flight.record(
+            "procmesh", "decision:parent_recovery", site="fabric",
+            detail={"tenants": len(state.get("tenants", {})),
+                    **{k: stats[k] for k in (
+                        "readopted_workers", "restored_workers",
+                        "journal_records_replayed")}})
+        self._next_gid = max(self._next_gid, int(state.get("next_gid", 0)))
+        for tid, t in sorted(state.get("tenants", {}).items()):
+            try:
+                if self._recover_tenant_record(tid, t, _parse):
+                    stats["readopted_tenants"] += 1
+                else:
+                    stats["restored_tenants"] += 1
+            except Exception:   # noqa: BLE001 — one tenant's turmoil must
+                # not strand the rest of the fleet in __init__
+                log.exception("mesh: parent recovery of tenant '%s' failed",
+                              tid)
+        stats["recover_s"] = round(time.monotonic() - t0, 6)
+        self._recovery = stats
+        self.flight.record("procmesh", "parent_recovered", site="fabric",
+                           detail=dict(stats))
+
+    def _recover_tenant_record(self, tid: str, t: dict, _parse) -> bool:
+        """Recover ONE journaled tenant; True when re-adopted live (no
+        restore), False when restored from the snapshot store."""
+        from .plan import MeshSlot
+        spec = TenantSpec(tid, t["app_text"],
+                          shapes=shape_fingerprint(_parse(t["app_text"])))
+        st = _TenantState(spec, int(t["gid"]), int(t["host"]), self.cfg)
+        st.applied = int(t.get("applied", 0))
+        st.epoch = int(t.get("epoch", 0))
+        st.seq = st.applied             # the feeder resumes from applied
+        self.tenants[tid] = st
+        delivered = tuple(int(x) for x in (t.get("delivered") or (-1, -1)))
+        intent = t.get("intent")
+        if intent:
+            # intent without commit: the move never happened — exactly one
+            # owner (src); scrub any half-adopted dst copy first
+            st.host = int(intent["src"])
+            self._scrub_dst_copy(spec, int(intent["dst"]))
+        host = self.hosts.get(st.host)
+        readopted = False
+        if host is not None and \
+                getattr(getattr(host, "handle", None), "adopted", False):
+            readopted = self._readopt_tenant(st, host, delivered)
+        if not readopted:
+            # dead, respawned-empty, or journaled-but-never-actuated: the
+            # existing restore ladder (snapshot store + dedup mark + epoch
+            # bump so the fresh incarnation's outbox indices never collide)
+            dst = st.host if (host is not None and host.alive
+                              and not getattr(getattr(host, "handle", None),
+                                              "gave_up", False)) \
+                else self._least_loaded_host(exclude=st.host)
+            if dst is None:
+                raise ValueError(f"no live host to restore '{tid}' onto")
+            with st.lock:
+                self._restore_on(st, dst)
+                st.epoch += 1
+                st.host = dst
+                st.seq = st.applied
+                rt = self.hosts[dst].runtimes.get(tid)
+                if rt is not None:
+                    self._wire_proxy(st, rt)
+                self._journal("cursor", tenant=tid, applied=st.applied,
+                              epoch=st.epoch)
+            # the dead child's outbox died with it: the journaled
+            # undelivered outputs are the only copy — stage past the
+            # delivery high-water for resume_output_delivery()
+            staged = [list(o) for o in t.get("outputs", ())
+                      if (int(o[0]), int(o[1])) > delivered]
+            if staged:
+                self._staged_outputs[tid] = staged
+            self.recoveries += 1
+        self.plan.assignment[tid] = MeshSlot(
+            st.host, spec.primary_shape,
+            getattr(self.hosts.get(st.host), "device", None))
+        return readopted
+
+    def _readopt_tenant(self, st: "_TenantState", host,
+                        delivered: tuple) -> bool:
+        """Re-adopt a live child's tenant without restore: attach a fresh
+        proxy, resync its outbox against the journaled delivery cursor,
+        and take the child's applied mark as authoritative (it may have
+        applied chunks whose journal cursor never landed)."""
+        tid = st.spec.tenant_id
+        ack = delivered[1] if delivered[0] == st.epoch else -1
+        rt = host.adopt_runtime(st.spec)
+        try:
+            rh = rt.resync(ack)
+        except (ConnectionError, RuntimeError):
+            rh = {"present": False}
+        if not rh.get("present"):
+            # the child does not host it (a deploy journaled but never
+            # actuated, or an undeploy raced the crash): fall through to
+            # the restore path, which (re)deploys fresh
+            host.runtimes.pop(tid, None)
+            host._specs.pop(tid, None)
+            return False
+        st.applied = max(st.applied, int(rh.get("applied", 0)))
+        st.seq = st.applied
+        self._wire_proxy(st, rt)
+        # the snapshot store may trail the child's live applied mark —
+        # re-snapshot once delivery hooks are back (resume_output_delivery)
+        self._resync_tids.append(tid)
+        self.flight.record("procmesh", "tenant_readopt",
+                           site=f"tenant:{tid}",
+                           detail={"host": host.index,
+                                   "applied": st.applied, "ack": ack})
+        return True
+
+    def _scrub_dst_copy(self, spec: TenantSpec, dst: int) -> None:
+        """Uncommitted-migration cleanup: if the move's target child is
+        live (re-adopted) and holds a half-adopted copy, undeploy it — the
+        journal says the move never committed, so src is the one owner."""
+        h = self.hosts.get(dst)
+        if h is None or not getattr(getattr(h, "handle", None),
+                                    "adopted", False):
+            return
+        try:
+            h.adopt_runtime(spec)
+            h.undeploy(spec.tenant_id)   # tolerant child op: no-op if absent
+        except (ConnectionError, RuntimeError):
+            log.warning("mesh: could not scrub half-adopted copy of '%s' "
+                        "on host %d", spec.tenant_id, dst)
+
+    def resume_output_delivery(self) -> dict:
+        """Second half of parent recovery, called once the caller has
+        re-attached its callbacks and output hooks (a fresh parent process
+        has none at construction): replays journal-staged outputs from
+        dead incarnations (at-least-once — sinks dedup by ``(epoch,
+        idx)``), then re-snapshots re-adopted tenants so the store catches
+        up to the child's authoritative applied mark (their resync'd
+        outbox tails dispatch through the normal delivery path here)."""
+        from ..core.event import Event
+        out = {"replayed_outputs": 0, "resnapshotted": 0}
+        staged, self._staged_outputs = self._staged_outputs, {}
+        for tid in sorted(staged):
+            st = self.tenants.get(tid)
+            entries = staged[tid]
+            if st is None or not entries:
+                continue
+            with st.lock:
+                for hook in st.raw_hooks:
+                    hook([tuple(e) for e in entries])
+                i = 0
+                while i < len(entries):
+                    sid = entries[i][2]
+                    j = i
+                    while j < len(entries) and entries[j][2] == sid:
+                        j += 1
+                    evs = [Event(e[3], e[4]) for e in entries[i:j]]
+                    for cb_sid, fn in st.callbacks:
+                        if cb_sid == sid:
+                            fn(evs)
+                    i = j
+                last = entries[-1]
+                self._journal("delivered", tenant=tid,
+                              epoch=int(last[0]), idx=int(last[1]))
+                out["replayed_outputs"] += len(entries)
+        resync, self._resync_tids = self._resync_tids, []
+        for tid in resync:
+            st = self.tenants.get(tid)
+            if st is None:
+                continue
+            with st.lock:
+                rt = self.hosts[st.host].runtimes.get(tid)
+                if rt is not None:
+                    self._save_tenant_locked(st, rt)
+                    out["resnapshotted"] += 1
+        return out
+
+    def _journal_checkpoint(self) -> None:
+        """Fold the whole control plane into one ``ckpt`` record and
+        truncate the acked segments behind it (the journal's compaction
+        contract — replay cost stays bounded by
+        ``journal_checkpoint_every``)."""
+        if self.journal is None:
+            return
+        with self._lock:
+            tenants = {}
+            for tid, st in self.tenants.items():
+                h = self.hosts.get(st.host)
+                rt = h.runtimes.get(tid) if h is not None else None
+                rec = {"app_text": st.spec.app_text, "gid": st.gid,
+                       "host": st.host, "applied": st.applied,
+                       "epoch": st.epoch, "intent": None,
+                       "delivered": [st.epoch, -1], "outputs": []}
+                if rt is not None and getattr(rt, "procmesh_proxy", False):
+                    rec["delivered"] = [rt.out_epoch, rt.delivered]
+                    rec["outputs"] = [[rt.out_epoch] + e
+                                      for e in rt.pending_outputs()]
+                staged = self._staged_outputs.get(tid)
+                if staged:
+                    # recovered-but-not-yet-replayed outputs must survive
+                    # another crash: carry them (pre-filtered, so a reset
+                    # high-water replays exactly this set)
+                    rec["delivered"] = [-1, -1]
+                    rec["outputs"] = [list(o) for o in staged]
+                # a checkpoint racing a live migration journals the
+                # still-src owner with no intent: a crash before the
+                # commit record rolls the move back (restore on src)
+                tenants[tid] = rec
+            state = {"next_gid": self._next_gid, "tenants": tenants,
+                     "workers": (self.supervisor.worker_state()
+                                 if self.supervisor is not None else {})}
+        self.journal.checkpoint(state)
 
     # -- elasticity ----------------------------------------------------------
     def add_host(self, capacity: Optional[int] = None) -> int:
@@ -945,6 +1408,9 @@ class MeshFabric:
                 "replayed_chunks": self.replayed_chunks,
                 "dup_chunks": self.dup_chunks,
                 "spill_backlog": backlog,
+                "journal": (self.journal.position()
+                            if self.journal is not None else None),
+                "recovery": (self._recovery or None),
                 "decisions": [e for e in self.flight.export(category="mesh")
                               if e["kind"].startswith("decision:")][-16:],
             }
@@ -993,6 +1459,21 @@ class MeshFabric:
             for h in list(self.hosts.values()):
                 if hasattr(h, "register_child_metrics"):
                     h.register_child_metrics(sm)
+        if self.journal is not None:
+            # parent-recovery outcome + journal position → the
+            # siddhi_tpu_procmesh_*{worker="recovery"} families
+            for k in ("readopted_workers", "restored_workers",
+                      "readopted_tenants", "restored_tenants",
+                      "journal_records_replayed"):
+                sm.gauge_tracker(f"procmesh.recovery.{k}",
+                                 lambda k=k: int(self._recovery.get(k, 0)))
+            sm.gauge_tracker(
+                "procmesh.recovery.recover_s",
+                lambda: float(self._recovery.get("recover_s", 0.0)))
+            sm.gauge_tracker(
+                "procmesh.recovery.journal_lsn",
+                lambda: (self.journal.position()["lsn"]
+                         if self.journal is not None else 0))
         self._sm = sm
 
     @staticmethod
@@ -1011,14 +1492,25 @@ class MeshFabric:
     def close(self) -> None:
         if self._sm is not None:
             self._sm.unregister("mesh.")
-            if self.supervisor is not None:
+            if self.supervisor is not None or self.journal is not None:
                 self._sm.unregister("procmesh.")
             self._sm = None
+        if self.journal is not None:
+            # final compaction while the workers still answer ops: a clean
+            # restart replays one ckpt record instead of the whole tail
+            try:
+                self._journal_checkpoint()
+            except Exception:   # noqa: BLE001 — teardown must not wedge on
+                # a dead worker mid-checkpoint
+                log.exception("mesh: final journal checkpoint failed")
         if self.supervisor is not None:
             # monitor first: a restart racing the teardown would respawn
             # workers the loop below is stopping
             self.supervisor.shutdown()
         for h in list(self.hosts.values()):
             h.close()
+        if self.journal is not None:
+            self.journal.close()
+            self.journal = None
         self.hosts.clear()
         self.tenants.clear()

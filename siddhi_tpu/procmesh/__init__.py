@@ -16,6 +16,10 @@ Layers:
   a cursored output outbox for exactly-once under lost acks;
 - :mod:`.supervisor` — spawns/monitors/restarts workers (PeerHealth
   heartbeats, exponential backoff with a windowed give-up budget);
+- :mod:`.journal` — the durable control plane: a CRC-framed mutation
+  journal (intent logged BEFORE actuation) + checkpoint/compaction, so a
+  SIGKILLed *parent* restarts, re-adopts still-live workers via their
+  runfiles and resolves in-flight migrations to exactly one owner;
 - :mod:`.host` — the fabric-side ``MeshHost``/runtime duck types;
 - :mod:`.lanepool` — ``@app:host_batch(workers.mode='process')``:
   lane-shard children for the columnar host tier.
@@ -24,6 +28,7 @@ Layers:
 from __future__ import annotations
 
 from .host import ProcMeshHost, RuntimeProxy, WorkerClient
+from .journal import FabricJournal
 from .lanepool import LanePoolError, ProcessLanePool
 from .protocol import (
     CONNECT_TIMEOUT_S,
@@ -38,6 +43,7 @@ __all__ = [
     "CONNECT_TIMEOUT_S",
     "IO_TIMEOUT_S",
     "READY_TIMEOUT_S",
+    "FabricJournal",
     "LanePoolError",
     "ProcMeshHost",
     "ProcMeshSupervisor",

@@ -130,6 +130,12 @@ class RuntimeProxy:
         self.callbacks: dict = {}       # stream_id -> [StreamCallback]
         self.delivered = -1             # highest outbox idx dispatched
         self._pending: list = []        # undispatched (idx, sid, ts, row)
+        # durable-fabric wiring (mesh/fabric.py journal): outbox indices
+        # are namespaced by the tenant's dedup epoch so a restored
+        # incarnation's fresh idx space never collides in idempotent sinks
+        self.out_epoch = 0
+        self.raw_hooks: list = []       # fn([(epoch, idx, sid, ts, row)...])
+        self.on_delivered = None        # fn(highest_idx) — journal cursor
 
     # -- ingest / outputs ----------------------------------------------------
     def send_chunk(self, seq: int, stream_id: str, rows: list,
@@ -159,9 +165,18 @@ class RuntimeProxy:
 
     def deliver_pending(self) -> None:
         """Dispatch buffered worker outputs to the parent-side callbacks,
-        grouped into per-stream runs (order preserved)."""
+        grouped into per-stream runs (order preserved). Raw hooks (durable
+        sinks) see every entry with its ``(epoch, idx)`` identity FIRST —
+        delivery is at-least-once across a parent crash (the window between
+        dispatch and the journaled cursor re-ships), so sinks dedup by that
+        pair."""
         from ..core.event import Event
+        from .journal import crash_point
         pending, self._pending = sorted(self._pending), []
+        if not pending:
+            return
+        for hook in self.raw_hooks:
+            hook([(self.out_epoch, e[0], e[1], e[2], e[3]) for e in pending])
         i = 0
         while i < len(pending):
             sid = pending[i][1]
@@ -173,6 +188,39 @@ class RuntimeProxy:
                 cb.receive(evs)
             self.delivered = max(self.delivered, pending[j - 1][0])
             i = j
+        # delivered-but-not-journaled chaos window: a crash here re-ships
+        # the batch on recovery (resync/staged replay) — sinks dedup
+        crash_point("deliver.dispatched")
+        if self.on_delivered is not None:
+            self.on_delivered(self.delivered)
+
+    def pending_outputs(self) -> list:
+        """Undispatched outbox entries (journal-checkpoint form): the
+        cursor record persists them so a dead-worker recovery can replay
+        outputs the old incarnation emitted but the parent never
+        dispatched."""
+        return [list(e) for e in sorted(self._pending)]
+
+    def resync(self, ack: int) -> dict:
+        """Parent-recovery reconciliation against a re-adopted live worker
+        (see ``worker.op_resync``): prunes the child outbox through the
+        journaled delivery cursor ``ack``, buffers the undelivered tail,
+        and returns the child's authoritative applied mark."""
+        rh, _ = self.client.call("resync", {"tenant": self.tenant_id,
+                                            "ack": ack})
+        if rh.get("present"):
+            self.delivered = max(self.delivered, int(ack))
+            self._buffer(rh.get("events", ()))
+        return rh
+
+    def subscribe(self, stream_id: str) -> None:
+        """Arm child-side output capture for a stream WITHOUT attaching a
+        parent callback (raw-hook sinks read the outbox identity instead
+        of events). Idempotent on both sides."""
+        if stream_id not in self.callbacks:
+            self.callbacks.setdefault(stream_id, [])
+            self.client.call("subscribe", {"tenant": self.tenant_id,
+                                           "stream": stream_id})
 
     # -- the runtime surface the fabric touches ------------------------------
     def add_callback(self, stream_id: str, callback) -> None:
@@ -239,6 +287,16 @@ class ProcMeshHost:
                                     "app_text": spec.app_text,
                                     "playback": self.playback},
                          timeout=max(IO_TIMEOUT_S, 60.0))
+        proxy = RuntimeProxy(self.client, spec.tenant_id)
+        self.runtimes[spec.tenant_id] = proxy
+        self._specs[spec.tenant_id] = spec
+        return proxy
+
+    def adopt_runtime(self, spec) -> RuntimeProxy:
+        """Attach a proxy to a tenant the worker ALREADY hosts (parent
+        recovery re-adoption): no deploy op — the shard keeps its engine
+        state; the caller reconciles cursors via :meth:`RuntimeProxy.
+        resync`."""
         proxy = RuntimeProxy(self.client, spec.tenant_id)
         self.runtimes[spec.tenant_id] = proxy
         self._specs[spec.tenant_id] = spec

@@ -31,6 +31,7 @@ the child), chosen per chunk by whether a types string covers the rows.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import struct
 from typing import Optional
@@ -52,13 +53,44 @@ MAX_FRAME = 256 * 1024 * 1024   # desync guard: one tenant snapshot tops out
 # far below this; a larger length prefix means a corrupt stream
 
 
+def runfile_path(run_dir: str, index: int) -> str:
+    return os.path.join(run_dir, f"w{index}.run")
+
+
+def write_runfile(run_dir: str, index: int, port: int, pid: int,
+                  nonce: str) -> None:
+    """Persist a worker's boot identity (atomic rename, fsynced): the
+    handshake artifact a restarted supervisor scans to re-adopt live
+    shards. Written by the child before it prints ``PROCMESH_READY``."""
+    os.makedirs(run_dir, exist_ok=True)
+    path = runfile_path(run_dir, index)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"index": index, "port": port, "pid": pid,
+                   "nonce": nonce}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_runfile(run_dir: str, index: int) -> Optional[dict]:
+    """Load one worker's runfile; None when absent or unreadable (a torn
+    tmp never lands on the final name — ``os.replace`` is atomic)."""
+    try:
+        with open(runfile_path(run_dir, index), encoding="utf-8") as f:
+            rf = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(rf, dict) or "port" not in rf or "pid" not in rf:
+        return None
+    return rf
+
+
 def child_env(base: Optional[dict] = None) -> dict:
     """Spawn env for a worker/lane child: the parent may have found
     ``siddhi_tpu`` via a ``sys.path`` insert (script-style embedding) that a
     fresh interpreter won't repeat, so prepend the package's parent dir to
     PYTHONPATH."""
-    import os
-    import sys
     env = dict(os.environ if base is None else base)
     pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import logging
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -37,7 +38,15 @@ from ..observability.flight_recorder import FlightRecorder
 from ..resilience.circuit import RestartBackoff
 from ..resilience.dcn_guard import PeerHealth
 from .host import ProcMeshHost, WorkerClient
-from .protocol import READY_TIMEOUT_S, WorkerDown, child_env
+from .protocol import (
+    READY_TIMEOUT_S,
+    WorkerDown,
+    WorkerOpError,
+    child_env,
+    connect,
+    read_runfile,
+    request,
+)
 
 log = logging.getLogger("siddhi_tpu.procmesh")
 
@@ -58,7 +67,8 @@ class SupervisorConfig:
                  restart_window_s: float = 60.0,
                  restart_max: int = 5,
                  auto_restart: bool = True,
-                 env: Optional[dict] = None):
+                 env: Optional[dict] = None,
+                 run_dir: Optional[str] = None):
         self.heartbeat_interval_s = float(heartbeat_interval_s)
         self.failure_threshold = int(failure_threshold)
         self.down_cooldown_s = float(down_cooldown_s)
@@ -69,6 +79,9 @@ class SupervisorConfig:
         self.restart_max = int(restart_max)
         self.auto_restart = bool(auto_restart)
         self.env = dict(env or {})
+        # workers persist runfiles here at handshake; a restarted
+        # supervisor scans them to re-adopt live shards (parent recovery)
+        self.run_dir = run_dir
 
 
 class ProcWorkerHandle:
@@ -81,6 +94,10 @@ class ProcWorkerHandle:
         self.proc: Optional[subprocess.Popen] = None
         self.port: Optional[int] = None
         self.pid: Optional[int] = None
+        self.nonce: Optional[str] = None
+        # re-adopted across a parent restart: not our Popen child — liveness
+        # and kills go through os.kill on the runfile pid instead
+        self.adopted = False
         self.restarts = 0
         self.kills = 0
         self.gave_up = False
@@ -93,7 +110,15 @@ class ProcWorkerHandle:
 
     @property
     def alive(self) -> bool:
-        return self.proc is not None and self.proc.poll() is None
+        if self.proc is not None:
+            return self.proc.poll() is None
+        if self.adopted and self.pid:
+            try:
+                os.kill(self.pid, 0)
+                return True
+            except OSError:
+                return False
+        return False
 
     def kill(self) -> None:
         """REAL SIGKILL — the chaos sites the in-process fabric simulates
@@ -101,6 +126,12 @@ class ProcWorkerHandle:
         if self.proc is not None and self.proc.poll() is None:
             self.proc.kill()
             self.kills += 1
+        elif self.adopted and self.pid:
+            try:
+                os.kill(self.pid, signal.SIGKILL)
+                self.kills += 1
+            except OSError:
+                pass                    # already gone
         self.port = None
         self.client.drop()
         self.health.trip()
@@ -112,6 +143,15 @@ class ProcWorkerHandle:
             except subprocess.TimeoutExpired:
                 self.proc.kill()
                 self.proc.wait(timeout=timeout)
+        elif self.adopted and self.pid:
+            # not our child: init reaps the orphan — poll until it is gone
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                try:
+                    os.kill(self.pid, 0)
+                except OSError:
+                    return
+                time.sleep(0.05)
 
 
 class ProcMeshSupervisor:
@@ -120,10 +160,16 @@ class ProcMeshSupervisor:
     def __init__(self, num_workers: int,
                  config: Optional[SupervisorConfig] = None,
                  flight: Optional[FlightRecorder] = None,
-                 playback: bool = True):
+                 playback: bool = True,
+                 journal=None,
+                 worker_state: Optional[dict] = None):
         self.cfg = config or SupervisorConfig()
         self.flight = flight or FlightRecorder(app_name="procmesh")
         self.playback = playback
+        # durable control plane (parent recovery): restart/give-up
+        # decisions journal BEFORE they actuate, so a restarted parent
+        # re-seeds each worker's give-up budget instead of resetting it
+        self.journal = journal
         self.handles = {i: ProcWorkerHandle(i, self.cfg)
                         for i in range(num_workers)}
         # fabric wiring: death/recovery callbacks + the SLO escalation
@@ -136,11 +182,28 @@ class ProcMeshSupervisor:
         self._stop = threading.Event()
         self._monitor = None
         self._lock = threading.RLock()
-        # spawn the fleet: fork everything first, then collect handshakes
-        # (boot cost is import-dominated; overlapping hides it)
         for h in self.handles.values():
+            st = (worker_state or {}).get(h.index) \
+                or (worker_state or {}).get(str(h.index))
+            if st:
+                h.restarts = int(st.get("restarts", 0))
+                h.backoff.seed_attempt_ages(st.get("attempt_ages_s", ()))
+                if st.get("gave_up"):
+                    h.gave_up = True
+        # adopt-or-spawn: a live shard from a previous parent incarnation
+        # (runfile pid+nonce verified over its control socket) is re-adopted
+        # in place; everything else forks fresh. Fork everything first, then
+        # collect handshakes (boot cost is import-dominated; overlapping
+        # hides it).
+        spawned = []
+        for h in self.handles.values():
+            if h.gave_up:
+                continue                # the budget died with the old parent
+            if self.cfg.run_dir and self._adopt(h):
+                continue
             self._spawn(h)
-        for h in self.handles.values():
+            spawned.append(h)
+        for h in spawned:
             self._await_ready(h)
 
     # -- spawning ------------------------------------------------------------
@@ -148,13 +211,47 @@ class ProcMeshSupervisor:
         env = child_env()
         env["SIDDHI_PROCMESH_CHILD"] = "1"      # no recursive pools
         env.update(self.cfg.env)
+        cmd = [sys.executable, "-m", "siddhi_tpu.procmesh.worker",
+               "--index", str(h.index),
+               "--playback", "1" if self.playback else "0"]
+        if self.cfg.run_dir:
+            cmd += ["--rundir", self.cfg.run_dir]
         h.proc = subprocess.Popen(
-            [sys.executable, "-m", "siddhi_tpu.procmesh.worker",
-             "--index", str(h.index),
-             "--playback", "1" if self.playback else "0"],
-            stdout=subprocess.PIPE, stderr=None, env=env)
+            cmd, stdout=subprocess.PIPE, stderr=None, env=env)
+        h.adopted = False
         h.pid = h.proc.pid
         h.port = None
+
+    def _adopt(self, h: ProcWorkerHandle) -> bool:
+        """Try to re-adopt a live worker left behind by a dead parent: dial
+        the runfile's port and verify the shard's identity (pid AND boot
+        nonce — a reused port or pid cannot spoof it). No restore, no
+        respawn: the shard keeps its engine state and outbox."""
+        rf = read_runfile(self.cfg.run_dir, h.index)
+        if rf is None:
+            return False
+        try:
+            sock = connect(int(rf["port"]))
+            try:
+                rh, _ = request(sock, "ping")
+            finally:
+                sock.close()
+        except (WorkerDown, WorkerOpError, OSError):
+            return False
+        if (rh.get("pid") != rf.get("pid")
+                or rh.get("nonce") != rf.get("nonce")
+                or rh.get("index") != h.index):
+            return False
+        h.proc = None
+        h.adopted = True
+        h.port = int(rf["port"])
+        h.pid = int(rf["pid"])
+        h.nonce = rf.get("nonce")
+        h.health.record_success()
+        self.flight.record("procmesh", "worker_readopt",
+                           site=f"worker:{h.index}",
+                           detail={"pid": h.pid, "port": h.port})
+        return True
 
     def _await_ready(self, h: ProcWorkerHandle) -> None:
         import json as _json
@@ -176,6 +273,7 @@ class ProcMeshSupervisor:
         hello = _json.loads(line.split(None, 1)[1])
         h.port = int(hello["port"])
         h.pid = int(hello["pid"])
+        h.nonce = hello.get("nonce")
         h.health.record_success()
 
     # -- fabric host construction -------------------------------------------
@@ -261,6 +359,8 @@ class ProcMeshSupervisor:
                     site=f"worker:{index}",
                     detail={"restarts": h.restarts,
                             **h.backoff.report()})
+                self._journal("worker_gave_up", worker=index,
+                              restarts=h.restarts)
                 h.gave_up = True
                 if self._sm is not None:
                     # a permanently-down worker's families go with it —
@@ -274,6 +374,10 @@ class ProcMeshSupervisor:
                 site=f"worker:{index}",
                 detail={"delay_s": delay, "restarts": h.restarts,
                         **h.backoff.report()})
+            # journal the consumed attempt BEFORE the spawn: a parent
+            # crash mid-restart must not refund the give-up budget
+            self._journal("worker_restart", worker=index,
+                          attempt_ages_s=h.backoff.attempt_ages_s())
             if delay:
                 self._stop.wait(delay)
             h.kill()                    # no half-dead twins
@@ -289,6 +393,16 @@ class ProcMeshSupervisor:
             if self.on_restarted is not None:
                 self.on_restarted(index)
             return True
+
+    def _journal(self, kind: str, **fields) -> None:
+        if self.journal is not None:
+            self.journal.append(kind, **fields)
+
+    def worker_state(self) -> dict:
+        """Journal-checkpoint form of the fleet's restart ledger."""
+        return {h.index: {"restarts": h.restarts, "gave_up": h.gave_up,
+                          "attempt_ages_s": h.backoff.attempt_ages_s()}
+                for h in self.handles.values()}
 
     def kill_worker(self, index: int) -> Optional[int]:
         """Operator/chaos SIGKILL (recorded): returns the killed pid. The
@@ -320,6 +434,8 @@ class ProcMeshSupervisor:
                              lambda h=h: h.health.state_code)
             sm.gauge_tracker(f"procmesh.w{i}.downtime_s",
                              lambda h=h: h.health.downtime_s())
+            sm.gauge_tracker(f"procmesh.w{i}.last_downtime_s",
+                             lambda h=h: h.health.last_downtime_s)
         sm.gauge_tracker("procmesh.self.workers",
                          lambda: sum(1 for h in self.handles.values()
                                      if h.alive))
@@ -334,7 +450,8 @@ class ProcMeshSupervisor:
         return {"workers": {
             h.index: {"alive": h.alive, "pid": h.pid, "port": h.port,
                       "restarts": h.restarts, "kills": h.kills,
-                      "gave_up": h.gave_up, **h.health.report()}
+                      "gave_up": h.gave_up, "adopted": h.adopted,
+                      **h.health.report()}
             for h in self.handles.values()}}
 
     # -- teardown ------------------------------------------------------------
@@ -350,8 +467,16 @@ class ProcMeshSupervisor:
                 pass
             h.client.drop()
         for h in self.handles.values():
-            if h.alive:
+            if h.proc is not None and h.proc.poll() is None:
                 h.proc.terminate()
+            elif h.adopted:
+                # give the stop op a moment to land (the shard removes its
+                # runfile on a clean exit) before escalating to SIGKILL
+                deadline = time.monotonic() + 2.0
+                while h.alive and time.monotonic() < deadline:
+                    time.sleep(0.05)
+                if h.alive:
+                    h.kill()
         for h in self.handles.values():
             h.reap()
         if self._sm is not None:

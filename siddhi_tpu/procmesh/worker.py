@@ -56,13 +56,14 @@ class _Tenant:
     """Worker-side state of one deployed tenant: the runtime, the ingest
     dedup mark, and the cursored output outbox."""
 
-    __slots__ = ("rt", "applied", "out", "out_next")
+    __slots__ = ("rt", "applied", "out", "out_next", "subs")
 
     def __init__(self, rt):
         self.rt = rt
         self.applied = 0        # last applied chunk seq (op dedup mark)
         self.out = []           # [(idx, stream_id, ts, row), ...] retained
         self.out_next = 0       # next outbox index to assign
+        self.subs = set()       # streams with capture armed (subscribe dedup)
 
 
 class WorkerServer:
@@ -82,6 +83,9 @@ class WorkerServer:
         self.rows_in = 0
         self.escalations: list = []        # SLO mesh_replace decisions
         self.dcn = None                    # optional worker-owned DCNWorker
+        # boot identity: a restarted supervisor re-adopts a live worker only
+        # if pid AND nonce match its runfile (pid reuse cannot spoof a shard)
+        self.nonce = os.urandom(8).hex()
         self.started = time.monotonic()
         self._lock = threading.RLock()     # all op handling (control rate)
         self._stop = threading.Event()
@@ -209,6 +213,7 @@ class WorkerServer:
     def op_ping(self, h: dict, body: bytes):
         esc, self.escalations = self.escalations, []
         return {"pid": os.getpid(),
+                "nonce": self.nonce,
                 "index": self.index,
                 "uptime_s": time.monotonic() - self.started,
                 "tenants": len(self.tenants),
@@ -243,6 +248,11 @@ class WorkerServer:
         from ..core.stream import StreamCallback
         t = self._tenant(h)
         sid = h["stream"]
+        if sid in t.subs:
+            # a restarted parent re-subscribes blindly; a second capture
+            # would double-append every emission to the outbox
+            return {}, b""
+        t.subs.add(sid)
 
         def capture(evs, t=t, sid=sid):
             for e in evs:
@@ -270,6 +280,19 @@ class WorkerServer:
             self.rows_in += len(rows)
             applied = True
         return {"applied": applied,
+                "events": self._out_tail(t, int(h.get("ack", -1)))}, b""
+
+    def op_resync(self, h: dict, body: bytes):
+        """Parent-recovery reconciliation: a restarted supervisor re-adopts
+        this LIVE shard without restore. The reply carries the authoritative
+        child-side applied mark (>= anything the parent journaled) plus the
+        outbox tail past the journaled delivery cursor ``ack`` — entries the
+        old parent delivered but never acked re-ship with their original
+        indices, so idempotent sinks dedup them byte-exactly."""
+        t = self.tenants.get(h["tenant"])
+        if t is None:
+            return {"present": False}, b""
+        return {"present": True, "applied": t.applied,
                 "events": self._out_tail(t, int(h.get("ack", -1)))}, b""
 
     def op_flush(self, h: dict, body: bytes):
@@ -373,6 +396,7 @@ def main(argv=None) -> int:
     ap.add_argument("--index", type=int, required=True)
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--playback", default="1")
+    ap.add_argument("--rundir", default=None)
     args = ap.parse_args(argv)
     # restart-storm test hook: a worker that can never boot exercises the
     # supervisor's backoff/give-up ladder with a real dying process
@@ -381,9 +405,22 @@ def main(argv=None) -> int:
         return 3
     srv = WorkerServer(args.index, playback=args.playback == "1")
     port = srv.bind(args.port)
-    print(f"PROCMESH_READY {json.dumps({'port': port, 'pid': os.getpid()})}",
+    if args.rundir:
+        # the runfile must be durable BEFORE the ready handshake: once the
+        # parent proceeds, a parent crash + restart must find this shard
+        from .protocol import write_runfile
+        write_runfile(args.rundir, args.index, port, os.getpid(), srv.nonce)
+    print(f"PROCMESH_READY "
+          f"{json.dumps({'port': port, 'pid': os.getpid(), 'nonce': srv.nonce})}",
           flush=True)
     srv.serve_forever()
+    if args.rundir:
+        # clean stop: a restarted supervisor must not dial a retired shard
+        from .protocol import runfile_path
+        try:
+            os.remove(runfile_path(args.rundir, args.index))
+        except OSError:
+            pass
     return 0
 
 

@@ -167,6 +167,25 @@ class RestartBackoff:
         with self._lock:
             self.history.clear()
 
+    def attempt_ages_s(self) -> list:
+        """Age (seconds) of each attempt still inside the window — the
+        journal-portable form of the budget (monotonic clocks don't
+        survive a process restart, relative ages do)."""
+        with self._lock:
+            now = self.clock()
+            return [now - t for t in self.history
+                    if now - t <= self.window_s]
+
+    def seed_attempt_ages(self, ages_s) -> None:
+        """Re-seed the window from journaled attempt ages: a restarted
+        supervisor must not hand a crash-looping child a fresh give-up
+        budget just because the parent died with it."""
+        with self._lock:
+            now = self.clock()
+            self.history = sorted(
+                now - float(a) for a in ages_s
+                if 0.0 <= float(a) <= self.window_s)
+
     def report(self) -> dict:
         with self._lock:
             return {"attempts_in_window": len(self.history),

@@ -384,21 +384,39 @@ class LaneGroupSnapshotStore:
             return epoch
 
     def latest(self, group: int) -> Optional[dict]:
-        """Newest revision for ``group`` as ``{leaves, global_lanes, dedup,
-        revision}``, or None when the group has never snapshotted."""
+        """Newest *readable* revision for ``group`` as ``{leaves,
+        global_lanes, dedup, revision}``, or None when the group has never
+        snapshotted. A torn/corrupt newest revision (a crash mid-rename, a
+        scribbled block) falls back to the previous intact one — losing one
+        snapshot interval is recoverable, refusing to restore is not."""
         with self._lock:
-            revs = self._revisions(group)
-            if not revs:
+            meta = leaves = None
+            for name in reversed(self._revisions(group)):
+                path = os.path.join(self._group_dir(group), name)
+                try:
+                    with np.load(path) as z:
+                        meta = json.loads(bytes(z["meta"]).decode())
+                        # numeric sort: lexicographic would interleave
+                        # leaf_1000 between leaf_100 and leaf_101 and
+                        # silently scramble the pytree on restore
+                        keys = sorted(
+                            (k for k in z.files if k.startswith("leaf_")),
+                            key=lambda k: int(k[5:]))
+                        if not keys:
+                            # every writer stores >= 1 leaf: a zip with
+                            # none had a member name scribbled (zipfile
+                            # only CRCs member *data*)
+                            raise ValueError("snapshot has no leaf arrays")
+                        leaves = [z[k] for k in keys]
+                    break
+                except Exception:   # noqa: BLE001 — zipfile/npz/json raise a
+                    # zoo of types for a torn file; all mean "try the
+                    # previous revision"
+                    log.warning("snapshot %s unreadable — falling back to "
+                                "previous revision", path)
+                    meta = leaves = None
+            if meta is None:
                 return None
-            path = os.path.join(self._group_dir(group), revs[-1])
-            with np.load(path) as z:
-                meta = json.loads(bytes(z["meta"]).decode())
-                # numeric sort: lexicographic would interleave leaf_1000
-                # between leaf_100 and leaf_101 and silently scramble the
-                # pytree on restore
-                keys = sorted((k for k in z.files if k.startswith("leaf_")),
-                              key=lambda k: int(k[5:]))
-                leaves = [z[k] for k in keys]
         return {"leaves": leaves,
                 "global_lanes": meta["global_lanes"],
                 "dedup": {int(s): (int(e), int(q))

@@ -180,18 +180,38 @@ def test_guard_metric_families_unregister_on_shutdown():
         # ISSUE 16: a process-mode fabric adds the procmesh.w{i}.* worker
         # gauges and the scraped per-child mesh.h{i}.child.* families —
         # close() must tear down EVERY child prefix with the fleet (dead
-        # processes must not leave zombie gauges behind)
+        # processes must not leave zombie gauges behind). ISSUE 17 rides
+        # the same prefix with the worker availability ledger
+        # (last_downtime_s / restarts_total from the supervisor's
+        # PeerHealth) and, on a durable fabric, the parent-recovery
+        # outcome gauges under the reserved worker="recovery" series.
+        from siddhi_tpu.observability import render
         pfab = MeshFabric(1, tempfile.mkdtemp(prefix="gm-procmesh-"),
                           MeshConfig(capacity_per_host=2, mode="process",
-                                     heartbeat_interval_s=0.2))
+                                     heartbeat_interval_s=0.2,
+                                     durable=True))
         pfab.register_metrics(msm)
         gauges = msm.snapshot_trackers()["gauges"]
         assert gauges["mesh.self.process_mode"].value == 1
         assert "procmesh.w0.alive" in gauges
+        assert gauges["procmesh.w0.last_downtime_s"].value == 0.0
+        assert gauges["procmesh.w0.restarts_total"].value == 0
+        assert gauges["procmesh.recovery.readopted_workers"].value == 0
+        assert gauges["procmesh.recovery.restored_tenants"].value == 0
+        assert gauges["procmesh.recovery.recover_s"].value == 0.0
+        assert gauges["procmesh.recovery.journal_lsn"].value >= 1
+        text = render([msm])
+        assert 'siddhi_tpu_procmesh_last_downtime_s{app="gm2",' \
+            'worker="w0"}' in text
+        assert 'siddhi_tpu_procmesh_restarts_total{app="gm2",' \
+            'worker="w0"}' in text
+        assert 'siddhi_tpu_procmesh_readopted_workers{app="gm2",' \
+            'worker="recovery"}' in text
         pfab.close()
         snap = msm.snapshot_trackers()
         assert not any(k.startswith(("mesh.", "procmesh."))
                        for d in snap.values() for k in d)
+        assert "siddhi_tpu_procmesh_" not in render([msm])
         mrt.shutdown()
     finally:
         m.shutdown()

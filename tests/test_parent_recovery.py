@@ -1,0 +1,516 @@
+"""Durable fabric control plane: parent-crash recovery (journal + re-adopt).
+
+Three layers of coverage:
+
+1. unit — ``FabricJournal`` roundtrip / torn-tail truncation / checkpoint
+   compaction, ``MeshFabric._merge_journal`` fold semantics, and the
+   ``RestartBackoff`` attempt-age seeding that keeps a crash-looping
+   child's give-up budget alive across a parent restart;
+2. in-process — clean-close restore and live-worker re-adoption using two
+   sequential fabrics over one store root;
+3. chaos matrix — a REAL parent process (``siddhi_tpu.procmesh.parentmain``)
+   SIGKILLed at every ``SIDDHI_CRASH_AT`` site, restarted against the same
+   root, and checked byte-exact against the solo oracle with zero duplicate
+   chunks and zero duplicate ``(tenant, epoch, idx)`` outputs.
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.mesh import MeshConfig, MeshFabric
+from siddhi_tpu.procmesh.journal import FabricJournal
+from siddhi_tpu.procmesh.parentmain import APP_TMPL, chunk_rows
+from siddhi_tpu.resilience.circuit import RestartBackoff
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- helpers
+
+def _kill_leftover_workers(root):
+    """SIGKILL any worker whose runfile survives under ``root`` — both the
+    post-test janitor and the chaos matrix's dead-worker hammer."""
+    run_dir = os.path.join(root, "run")
+    if not os.path.isdir(run_dir):
+        return []
+    killed = []
+    for name in sorted(os.listdir(run_dir)):
+        if not name.endswith(".run"):
+            continue
+        try:
+            with open(os.path.join(run_dir, name), encoding="utf-8") as f:
+                pid = int(json.load(f)["pid"])
+            os.kill(pid, signal.SIGKILL)
+            killed.append(pid)
+        except (OSError, ValueError, KeyError):
+            continue
+    return killed
+
+
+def _run_parent(root, crash_at=None, timeout=120, **kw):
+    """Run ``parentmain`` as a real subprocess. With ``crash_at`` set the
+    parent must die by SIGKILL before printing its hand-shake (returns
+    None); otherwise returns the parsed ``PARENT_DONE`` payload.
+
+    stdout/stderr go to files, not pipes: leftover workers inherit the
+    parent's stderr, so a pipe would never reach EOF after the kill.
+    """
+    env = dict(os.environ)
+    env.pop("SIDDHI_CRASH_AT", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if crash_at is not None:
+        env["SIDDHI_CRASH_AT"] = crash_at
+    cmd = [sys.executable, "-m", "siddhi_tpu.procmesh.parentmain",
+           "--root", root]
+    for k, v in kw.items():
+        cmd += ["--" + k.replace("_", "-"), str(v)]
+    out_path = os.path.join(root, "parent.out")
+    err_path = os.path.join(root, "parent.err")
+    with open(out_path, "ab") as out, open(err_path, "ab") as err:
+        proc = subprocess.Popen(cmd, stdout=out, stderr=err, env=env,
+                                cwd=REPO_ROOT)
+        try:
+            rc = proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            raise
+    with open(out_path, encoding="utf-8") as f:
+        done = [ln for ln in f if ln.startswith("PARENT_DONE ")]
+    if crash_at is not None:
+        assert rc == -signal.SIGKILL, \
+            f"expected SIGKILL at {crash_at}, got rc={rc}"
+        assert not done, f"crash at {crash_at} still printed PARENT_DONE"
+        return None
+    if rc != 0:
+        with open(err_path, encoding="utf-8") as f:
+            tail = f.read()[-2000:]
+        raise AssertionError(f"parentmain rc={rc}\n{tail}")
+    assert done, "no PARENT_DONE hand-shake"
+    return json.loads(done[-1].split(None, 1)[1])
+
+
+def _read_sink(root, tid):
+    """Sink entries in file order. Only the SIGKILL-torn final line may be
+    unparseable; everything before it must be intact JSON."""
+    path = os.path.join(root, f"sink_{tid}.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    entries = []
+    for n, line in enumerate(lines):
+        try:
+            entries.append(json.loads(line))
+        except ValueError:
+            assert n == len(lines) - 1, f"torn line mid-file in {path}:{n}"
+    return entries
+
+
+def _dedup(entries):
+    """Keep-first dedup by the (epoch, idx) output identity — what an
+    idempotent downstream consumer does with at-least-once delivery."""
+    seen, out = set(), []
+    for e in entries:
+        key = (e["e"], e["i"])
+        if key not in seen:
+            seen.add(key)
+            out.append(e)
+    return out
+
+
+def _oracle_rows(chunks, width):
+    """Solo single-process run of the same app over the same bytes."""
+    manager = SiddhiManager()
+    try:
+        rt = manager.create_siddhi_app_runtime(APP_TMPL.format(i=0),
+                                               playback=True)
+        got = []
+        rt.add_callback("Out", StreamCallback(
+            lambda evs: got.extend(list(e.data) for e in evs)))
+        rt.start()
+        handler = rt.input_handler("S")
+        for c in range(chunks):
+            rows, ts = chunk_rows(c, width)
+            handler.send_rows([list(r) for r in rows], list(ts))
+        return got
+    finally:
+        manager.shutdown()
+
+
+# -------------------------------------------------------- journal (unit)
+
+def test_journal_roundtrip(tmp_path):
+    jdir = str(tmp_path / "j")
+    j = FabricJournal(jdir)
+    lsns = [j.append("deploy", tenant=f"t{i}", gid=i, host=0, app_text="x")
+            for i in range(8)]
+    assert lsns == sorted(lsns) and len(set(lsns)) == 8
+    j.close()
+
+    j2 = FabricJournal(jdir)
+    ckpt, tail = j2.replay()
+    assert ckpt is None
+    assert [r["tenant"] for r in tail] == [f"t{i}" for i in range(8)]
+    assert all(r["k"] == "deploy" for r in tail)
+    j2.close()
+
+
+def test_journal_checkpoint_compacts_segments(tmp_path):
+    jdir = str(tmp_path / "j")
+    j = FabricJournal(jdir, segment_bytes=256)   # force frequent rolls
+    for i in range(40):
+        j.append("cursor", tenant="t0", applied=i, epoch=0)
+    assert j.position()["segments"] > 1
+    j.checkpoint({"next_gid": 1, "tenants": {}, "workers": {}})
+    assert j.position()["segments"] == 1         # pre-ckpt segments gone
+    j.append("cursor", tenant="t0", applied=99, epoch=0)
+    j.close()
+
+    j2 = FabricJournal(jdir, segment_bytes=256)
+    ckpt, tail = j2.replay()
+    assert ckpt == {"next_gid": 1, "tenants": {}, "workers": {}}
+    assert [r["applied"] for r in tail] == [99]
+    j2.close()
+
+
+def test_journal_torn_tail_truncates(tmp_path):
+    jdir = str(tmp_path / "j")
+    j = FabricJournal(jdir)
+    for i in range(5):
+        j.append("cursor", tenant="t0", applied=i, epoch=0)
+    j.close()
+    (seg,) = [f for f in os.listdir(jdir) if f.endswith(".jnl")]
+    path = os.path.join(jdir, seg)
+    intact = os.path.getsize(path)
+
+    # garbage appended after the last intact record: dropped on reopen,
+    # and the journal stays appendable
+    with open(path, "ab") as f:
+        f.write(b"\x7fgarbage-not-a-record")
+    j2 = FabricJournal(jdir)
+    _, tail = j2.replay()
+    assert [r["applied"] for r in tail] == [0, 1, 2, 3, 4]
+    assert os.path.getsize(path) == intact       # tail was truncated away
+    j2.append("cursor", tenant="t0", applied=5, epoch=0)
+    j2.close()
+    j2b = FabricJournal(jdir)
+    _, tail = j2b.replay()
+    assert [r["applied"] for r in tail] == [0, 1, 2, 3, 4, 5]
+    j2b.close()
+
+    # tear mid-record in an EARLIER segment: replay keeps the intact
+    # prefix and refuses to leap the gap into later segments — a causal
+    # hole must not resurrect records that depend on the lost one
+    with open(path, "r+b") as f:
+        f.truncate(intact - 7)
+    j3 = FabricJournal(jdir)
+    _, tail = j3.replay()
+    assert [r["applied"] for r in tail] == [0, 1, 2, 3]
+    j3.close()
+
+
+# --------------------------------------------------- merge fold (unit)
+
+def _rec(k, **fields):
+    fields["k"] = k
+    return fields
+
+
+def test_merge_journal_cursor_and_delivery():
+    state = MeshFabric._merge_journal(None, [
+        _rec("deploy", tenant="a", gid=3, host=1, app_text="app-a"),
+        _rec("cursor", tenant="a", applied=2, epoch=0,
+             outputs=[[0, 0, "Out", 1000, ["d", 1.0]],
+                      [0, 1, "Out", 1000, ["e", 2.0]]]),
+        _rec("delivered", tenant="a", epoch=0, idx=0),
+        _rec("cursor", tenant="a", applied=3, epoch=0),   # no outputs key
+        _rec("delivered", tenant="a", epoch=0, idx=1),
+        _rec("delivered", tenant="a", epoch=0, idx=0),    # stale: ignored
+    ])
+    t = state["tenants"]["a"]
+    assert (t["gid"], t["host"], t["applied"]) == (3, 1, 3)
+    assert state["next_gid"] == 4
+    # cursor without an outputs key must NOT clear the staged outputs
+    assert len(t["outputs"]) == 2
+    assert tuple(t["delivered"]) == (0, 1)                # high-water only
+
+
+def test_merge_journal_migration_intent_and_commit():
+    base = [_rec("deploy", tenant="a", gid=0, host=0, app_text="x"),
+            _rec("cursor", tenant="a", applied=5, epoch=0)]
+    # intent without commit: ownership stays at src, intent is exposed
+    state = MeshFabric._merge_journal(
+        None, base + [_rec("migrate_intent", tenant="a", src=0, dst=1)])
+    t = state["tenants"]["a"]
+    assert t["host"] == 0 and t["intent"] == {"src": 0, "dst": 1}
+    # commit repoints ownership and clears the intent
+    state = MeshFabric._merge_journal(
+        None, base + [_rec("migrate_intent", tenant="a", src=0, dst=1),
+                      _rec("migrate_commit", tenant="a", dst=1, applied=5)])
+    t = state["tenants"]["a"]
+    assert t["host"] == 1 and t["intent"] is None
+
+
+def test_merge_journal_undeploy_and_workers():
+    state = MeshFabric._merge_journal(None, [
+        _rec("deploy", tenant="a", gid=0, host=0, app_text="x"),
+        _rec("deploy", tenant="b", gid=1, host=0, app_text="y"),
+        _rec("undeploy", tenant="a"),
+        _rec("worker_restart", worker=0, attempt_ages_s=[0.5]),
+        _rec("worker_restart", worker=0, attempt_ages_s=[0.0, 1.5]),
+        _rec("worker_gave_up", worker=1, restarts=5),
+    ])
+    assert set(state["tenants"]) == {"b"}
+    assert state["workers"][0]["restarts"] == 2
+    assert state["workers"][0]["attempt_ages_s"] == [0.0, 1.5]
+    assert state["workers"][1]["gave_up"] is True
+
+
+def test_merge_journal_checkpoint_seeds_fold():
+    ckpt = {"next_gid": 7,
+            "tenants": {"a": {"app_text": "x", "gid": 2, "host": 1,
+                              "applied": 9, "epoch": 1, "intent": None,
+                              "delivered": [1, 3], "outputs": []}},
+            "workers": {"0": {"restarts": 1, "gave_up": False,
+                              "attempt_ages_s": []}}}
+    state = MeshFabric._merge_journal(
+        ckpt, [_rec("cursor", tenant="a", applied=11, epoch=1)])
+    t = state["tenants"]["a"]
+    assert t["applied"] == 11 and t["epoch"] == 1 and t["gid"] == 2
+    assert state["next_gid"] == 7
+
+
+def test_restart_backoff_seed_roundtrip():
+    clk = [100.0]
+    b = RestartBackoff(base_s=0.1, window_s=60.0, max_restarts=3,
+                       clock=lambda: clk[0])
+    assert b.next_delay() is not None
+    clk[0] += 5.0
+    assert b.next_delay() is not None
+    ages = b.attempt_ages_s()
+    assert sorted(round(a, 6) for a in ages) == [0.0, 5.0]
+
+    # a restarted supervisor seeded with those ages has 1 attempt left
+    b2 = RestartBackoff(base_s=0.1, window_s=60.0, max_restarts=3,
+                        clock=lambda: clk[0])
+    b2.seed_attempt_ages(ages)
+    assert b2.report()["attempts_in_window"] == 2
+    assert b2.next_delay() is not None
+    assert b2.next_delay() is None               # budget exhausted
+
+    # ages already outside the window don't count against the budget
+    b3 = RestartBackoff(base_s=0.1, window_s=60.0, max_restarts=3,
+                        clock=lambda: clk[0])
+    b3.seed_attempt_ages([120.0, 3.0])
+    assert b3.report()["attempts_in_window"] == 1
+
+
+# --------------------------------------------- in-process restart paths
+
+APP = ("@app:name('t{i}')\n"
+      "define stream S (dev string, v double);\n"
+      "@info(name='q') from S[v > 1.0] select dev, v insert into Out;\n")
+
+
+def _durable_cfg(**kw):
+    kw.setdefault("mode", "process")
+    kw.setdefault("durable", True)
+    kw.setdefault("snapshot_every_chunks", 1)
+    kw.setdefault("heartbeat_interval_s", 0.3)
+    kw.setdefault("capacity_per_host", 4)
+    return MeshConfig(**kw)
+
+
+def test_durable_requires_process_mode():
+    with pytest.raises(ValueError):
+        MeshConfig(mode="thread", durable=True)
+
+
+def test_clean_restart_restores_tenants(tmp_path):
+    root = str(tmp_path / "fab")
+    rows, ts = chunk_rows(0, 2)
+    fab = MeshFabric(2, root, config=_durable_cfg())
+    try:
+        fab.add_tenants([APP.format(i=0)])
+        fab.send("t0", "S", rows, ts)
+        assert fab.tenants["t0"].applied == 1
+    finally:
+        fab.close()
+
+    # close() killed the workers: reopening restores from snapshots and
+    # resumes the journal cursor with a bumped output epoch
+    fab2 = MeshFabric(2, root, config=_durable_cfg())
+    try:
+        st = fab2.tenants["t0"]
+        assert st.applied == 1 and st.seq == 1 and st.epoch == 1
+        rep = fab2.report()
+        assert rep["recovery"]["restored_tenants"] == 1
+        assert rep["recovery"]["readopted_tenants"] == 0
+        # clean close checkpoints: state came from the ckpt, zero tail
+        assert rep["recovery"]["journal_records_replayed"] == 0
+        assert rep["journal"]["segments"] >= 1
+        got = []
+        fab2.add_output_hook("t0", got.extend, streams=("Out",))
+        fab2.resume_output_delivery()
+        rows1, ts1 = chunk_rows(1, 2)
+        fab2.send("t0", "S", rows1, ts1)
+        assert fab2.tenants["t0"].applied == 2
+        assert [e[4] for e in got] == [list(r) for r in rows1]
+        assert all(e[0] == 1 for e in got)       # fresh epoch namespace
+        assert rep["dup_chunks"] == 0
+    finally:
+        fab2.close()
+
+
+def test_abandoned_parent_workers_readopted(tmp_path):
+    """Simulated parent death in-process: stop fabric A's monitor, leave
+    its workers running, boot fabric B over the same root — B must adopt
+    the live workers (same pids) and resync instead of restoring."""
+    root = str(tmp_path / "fab")
+    rows, ts = chunk_rows(0, 2)
+    fab = MeshFabric(2, root, config=_durable_cfg())
+    adopted = None
+    try:
+        fab.add_tenants([APP.format(i=0), APP.format(i=1)])
+        fab.send("t0", "S", rows, ts)
+        fab.send("t1", "S", rows, ts)
+        pids_a = {i: w["pid"]
+                  for i, w in fab.report()["supervisor"]["workers"].items()}
+        # abandon: stop the monitor but do NOT close (workers stay live)
+        fab.supervisor._stop.set()
+        if fab.supervisor._monitor is not None:
+            fab.supervisor._monitor.join(timeout=5.0)
+
+        adopted = MeshFabric(2, root, config=_durable_cfg())
+        rep = adopted.report()
+        assert rep["recovery"]["readopted_workers"] == 2
+        assert rep["recovery"]["restored_workers"] == 0
+        assert rep["recovery"]["readopted_tenants"] == 2
+        pids_b = {i: w["pid"]
+                  for i, w in rep["supervisor"]["workers"].items()}
+        assert pids_b == pids_a                  # same live processes
+        st = adopted.tenants["t0"]
+        assert st.applied == 1 and st.epoch == 0  # epoch continuity
+        resume = adopted.resume_output_delivery()
+        assert resume["resnapshotted"] == 2
+        rows1, ts1 = chunk_rows(1, 2)
+        adopted.send("t0", "S", rows1, ts1)
+        assert adopted.tenants["t0"].applied == 2
+        assert adopted.report()["dup_chunks"] == 0
+    finally:
+        if adopted is not None:
+            adopted.close()
+        _kill_leftover_workers(root)
+
+
+# --------------------------------------- journal-intent structural lint
+
+def _guard_coverage_module():
+    spec = importlib.util.spec_from_file_location(
+        "check_guard_coverage",
+        os.path.join(REPO_ROOT, "scripts", "check_guard_coverage.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_journal_intent_lint_passes():
+    mod = _guard_coverage_module()
+    assert mod.check_journal_intent() == []
+
+
+def test_journal_intent_lint_catches_offenders():
+    """The structural check must actually be able to fail: an actuation
+    that precedes its journal append, and a site missing either marker."""
+    mod = _guard_coverage_module()
+    swapped = ("swapped-site",
+               "def f(self):\n"
+               "    self.host.deploy(spec)\n"
+               "    self._journal(\"deploy\", tenant=t)\n",
+               'self._journal("deploy"', ".deploy(spec)")
+    missing = ("missing-journal-site",
+               "def g(self):\n    self.host.deploy(spec)\n",
+               'self._journal("deploy"', ".deploy(spec)")
+    problems = mod.check_journal_intent([swapped, missing])
+    assert len(problems) == 2
+    assert "precedes" in problems[0] and "not found" in problems[1]
+
+
+# ------------------------------------------------- parent-SIGKILL chaos
+
+# (site spec, extra parentmain args, kill workers before restart too)
+CHAOS_SITES = [
+    ("journal.deploy:2", {}, False),
+    ("deploy.actuated", {}, False),
+    ("ingest.applied:3", {}, False),
+    ("journal.cursor:3", {}, False),
+    ("deliver.dispatched:2", {}, False),
+    ("journal.delivered:2", {}, False),
+    ("journal.checkpoint", {}, False),
+    ("journal.migrate_intent", {"migrate_at": 2}, False),
+    ("migrate.adopted", {"migrate_at": 2}, False),
+    ("journal.migrate_commit", {"migrate_at": 2}, False),
+    ("journal.cursor:3", {}, True),      # dead workers: restore + replay
+    ("ingest.applied:3", {}, True),
+]
+
+
+@pytest.mark.parametrize("site,extra,kill_workers", CHAOS_SITES,
+                         ids=[f"{s}{'+dead' if k else ''}"
+                              for s, _, k in CHAOS_SITES])
+def test_parent_sigkill_chaos(tmp_path, site, extra, kill_workers):
+    root = str(tmp_path / "root")
+    os.makedirs(root)
+    kw = dict(hosts=2, tenants=2, chunks=4, width=2)
+    kw.update(extra)
+    try:
+        _run_parent(root, crash_at=site, **kw)
+        if kill_workers:
+            assert _kill_leftover_workers(root)
+            time.sleep(0.2)
+        done = _run_parent(root, **kw)
+    finally:
+        _kill_leftover_workers(root)
+
+    # every chunk applied exactly once, across crash + restart
+    assert done["applied"] == {f"t{i}": kw["chunks"]
+                               for i in range(kw["tenants"])}
+    assert done["dup_chunks"] == 0
+
+    rec = done["recovery"]
+    if site == "journal.checkpoint":
+        # boot-checkpoint crash precedes any deploy: nothing to recover
+        assert rec is None
+    else:
+        assert rec is not None, "restart did not run parent recovery"
+        assert rec["readopted_workers"] + rec["restored_workers"] == \
+            kw["hosts"]
+        # a crash early in add_tenants may predate some deploys — those
+        # tenants deploy fresh on restart rather than recovering
+        assert 1 <= (rec["readopted_tenants"]
+                     + rec["restored_tenants"]) <= kw["tenants"]
+        if kill_workers:
+            assert rec["restored_workers"] == kw["hosts"]
+            assert rec["readopted_tenants"] == 0
+        else:
+            assert rec["readopted_workers"] == kw["hosts"]
+        assert rec["recover_s"] >= 0.0
+        assert rec["journal_records_replayed"] >= 1
+
+    # byte-exact output parity with the solo oracle after (e, idx) dedup
+    oracle = _oracle_rows(kw["chunks"], kw["width"])
+    for i in range(kw["tenants"]):
+        entries = _read_sink(root, f"t{i}")
+        deduped = _dedup(entries)
+        assert [e["d"] for e in deduped] == oracle, \
+            f"t{i} diverged from solo oracle at {site}"
+        assert all(e["s"] == "Out" and e["t"] == f"t{i}" for e in deduped)
