@@ -1846,7 +1846,53 @@ def child_procmesh() -> None:
           f"replayed={rep['replayed_chunks']}, oracle_ok={oracle_ok}",
           file=sys.stderr)
 
-    # -- 3) parent recovery: real SIGKILL of the PARENT mid-ingest ---------
+    # -- 3) federated latency breakdown (ISSUE 18, MULTICHIP_r09 line) -----
+    # one parent pull of every worker's phase histograms: per-phase
+    # p50/p99 per worker plus the fabric-level merge, with trace
+    # stitching sampled 1-in-8 so the parent ring shows journeys that
+    # span dispatch -> child transit -> ingress on one trace id.
+    FED = min(2, MESH_HOSTS)
+    # sample period COPRIME to the tenant round-robin (the tracer's 1-in-N
+    # counter is global across sends): an even period with 2 tenants
+    # aliases onto tenant 0 forever and worker h1 never sees a trace
+    fab = MeshFabric(FED, tempfile.mkdtemp(prefix="pmesh-fed-"),
+                     MeshConfig(capacity_per_host=1, mode="process",
+                                trace_sample=7))
+    fab.add_tenants([_mesh_kleene_app(i, fleet_ann) for i in range(FED)])
+    fmatches = [0] * FED
+    for i in range(FED):
+        fab.add_callback(f"kleene-{i}", "Alerts",
+                         lambda evs, i=i: fmatches.__setitem__(
+                             i, fmatches[i] + len(evs)))
+    for c, t in chunks:
+        for i in range(FED):
+            fab.send(f"kleene-{i}", "S", c, t)
+    fab.flush()
+    fed = fab.federation()
+    stitched = 0
+    if fab.tracer is not None:
+        for tr in list(fab.tracer.ring):
+            names = {(s.stage, s.name.split(":")[0]) for s in tr.spans}
+            if ("procmesh", "dispatch") in names \
+                    and ("procmesh", "transit") in names:
+                stitched += 1
+    fab.close()
+    out["latency_breakdown"] = {
+        "workers": {w: e["phases"]
+                    for w, e in fed["workers"].items() if not e["stale"]},
+        "merged": fed["merged"],
+        "stale_workers": sorted(w for w, e in fed["workers"].items()
+                                if e["stale"]),
+        "stitched_journeys": stitched,
+        "clock_offsets_ns": fed["clock_offsets_ns"],
+    }
+    mt = fed["merged"].get("procmesh_transit", {})
+    print(f"# procmesh federation: {len(out['latency_breakdown']['workers'])}"
+          f" worker(s), transit p50={mt.get('p50_ms')}ms "
+          f"p99={mt.get('p99_ms')}ms, stitched={stitched} journey(s)",
+          file=sys.stderr)
+
+    # -- 4) parent recovery: real SIGKILL of the PARENT mid-ingest ---------
     # (ISSUE 17, the MULTICHIP_r08 line): the durable fabric runs as its
     # own killable OS process (procmesh.parentmain), is SIGKILLed at a
     # journal/actuate boundary mid-ingest, and a restarted parent against

@@ -467,7 +467,14 @@ def run_procmesh_guard(tol: float, deadline_s: int = 600) -> int:
        this pins control-socket overhead, not hardware scaling);
     4. the parent-SIGKILL cycle (ISSUE 17): a durable fabric killed at a
        journal boundary and restarted must re-adopt/restore every worker
-       and keep its sinks byte-exact vs solo oracles (binary, no band)."""
+       and keep its sinks byte-exact vs solo oracles (binary, no band);
+    5. the federated latency breakdown (ISSUE 18): every live worker
+       reports per-phase histograms including the ``procmesh_transit``
+       hop, the fabric-level merge is present with non-zero counts and
+       p50 <= p99 per phase, and at least one sampled journey stitched
+       parent dispatch + child transit onto ONE trace id (binary —
+       structure and sanity, not latency bands: the recording box's
+       absolute numbers are core-limited plumbing)."""
     with open(os.path.join(REPO, "BASELINE.json")) as f:
         baseline = json.load(f).get("procmesh_baseline") or {}
     if not baseline:
@@ -571,6 +578,36 @@ def run_procmesh_guard(tol: float, deadline_s: int = 600) -> int:
             f"{baseline.get('scaling_efficiency_min')} x ideal "
             f"{ideal_eff:.3f} at {guard_hosts} hosts/{guard_cores} "
             f"core(s)) — see procmesh_baseline note")
+    # ISSUE 18: the federated observability pull — structural judgement
+    # (every live worker federates, merge is sane, one trace id spans the
+    # process hop), never latency bands
+    fed = data.get("latency_breakdown") or {}
+    fed_workers = fed.get("workers") or {}
+    fed_merged = fed.get("merged") or {}
+    if not fed:
+        failures.append("no latency_breakdown block in the procmesh line "
+                        "(federation phase did not run)")
+    else:
+        if not fed_workers:
+            failures.append("federated scrape rendered zero live workers")
+        for w, phases in fed_workers.items():
+            if "procmesh_transit" not in phases:
+                failures.append(
+                    f"worker {w} federated without a procmesh_transit "
+                    f"phase (ingest hop not instrumented)")
+        if "procmesh_transit" not in fed_merged:
+            failures.append("fabric-level merge lacks procmesh_transit")
+        for ph, st in fed_merged.items():
+            if not st.get("count"):
+                failures.append(f"merged phase '{ph}' has zero samples")
+            elif st.get("p50_ms", 0.0) > st.get("p99_ms", 0.0):
+                failures.append(
+                    f"merged phase '{ph}' p50 {st.get('p50_ms')}ms above "
+                    f"p99 {st.get('p99_ms')}ms — merge broke monotonicity")
+        if not fed.get("stitched_journeys"):
+            failures.append(
+                "no sampled journey carried ONE trace id across parent "
+                "dispatch and child transit (stitching unwired)")
 
     print(json.dumps({
         "hosts": data.get("hosts"),
@@ -590,6 +627,9 @@ def run_procmesh_guard(tol: float, deadline_s: int = 600) -> int:
         "efficiency_floor": eff_floor,
         "efficiency_ideal": ideal_eff,
         "recover_ceiling_s": rec_ceiling,
+        "federated_workers": sorted(fed_workers),
+        "federated_phases": sorted(fed_merged),
+        "stitched_journeys": fed.get("stitched_journeys"),
         "ok": not failures,
     }))
     for f_ in failures:

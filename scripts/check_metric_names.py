@@ -29,7 +29,17 @@ histograms), renders the exposition, and enforces:
   two-host fabric the lint spins up and registers onto the main app's
   statistics manager) render on every run and carry ONLY the
   ``app``/``host`` label set — host indices are bounded by the mesh size
-  (≤ 255, the DCN wire bound), tenant identities stay in report payloads.
+  (≤ 255, the DCN wire bound), tenant identities stay in report payloads;
+- the federated exposition (ISSUE 18, exercised by a two-host PROCESS
+  fabric whose ``collect_federated`` hook renders scraped per-worker
+  families): every ``worker`` label value comes from the bounded
+  vocabulary ``h{i}``/``w{i}``/``fabric``/``recovery``/``self`` (never a
+  free-form identity — cardinality is mesh-size-bounded by shape, not by
+  luck), federated histograms pass the same cumulative-``le`` checks as
+  native ones, and no federated sample collides with a parent-side
+  sample of the same family once its ``worker`` label is stripped (a
+  collision would make parent and child series indistinguishable under
+  aggregation).
 
 Usage: ``python scripts/check_metric_names.py``. Exit code 1 on findings.
 Run by ``tests/test_observability.py`` so it gates CI (the
@@ -69,6 +79,9 @@ EXEMPLAR_LABELS = {"trace_id"}
 SLO_LABELS = {"app", "query"}
 # mesh.* fabric families: per host (bounded by mesh size), nothing finer
 MESH_LABELS = {"app", "host"}
+# worker label values: index-shaped or one of the reserved series — a
+# free-form value here is an identity leaking into the time-series space
+WORKER_VALUE_RE = re.compile(r"^(h\d+|w\d+|fabric|recovery|self)$")
 
 APP = """
 @app(name='LintApp', statistics='detail')
@@ -131,10 +144,25 @@ def build_exposition() -> str:
     mesh.send("lint-mesh-0", "S", [["a", 2.0], ["b", 3.0]], [1000, 1001])
     mesh.flush()
     mesh.register_metrics(rt.ctx.statistics_manager)
+    # a two-host PROCESS fabric with trace sampling: its federated
+    # collector renders scraped per-worker + fabric-merged families, so
+    # the worker-label vocabulary, federated le-bucket structure and
+    # parent/child collision rules are linted on every run (ISSUE 18)
+    pmesh = MeshFabric(2, tempfile.mkdtemp(prefix="lint-pmesh-"),
+                       MeshConfig(capacity_per_host=1, mode="process",
+                                  trace_sample=1))
+    pmesh.add_tenants([MESH_TENANT.format(i=i + 2) for i in range(2)])
+    for i in range(2):
+        pmesh.send(f"lint-mesh-{i + 2}", "S",
+                   [["a", 2.0], ["b", 3.0]], [1000, 1001])
+    pmesh.flush()
+    pmesh.sync_children()
     # the OpenMetrics-flavored exposition: exemplars present, so their
     # syntax/placement/bounds are exercised by every lint run
     text = render([rt.ctx.statistics_manager,
-                   srt.ctx.statistics_manager], with_exemplars=True)
+                   srt.ctx.statistics_manager], with_exemplars=True,
+                  collectors=(pmesh.collect_federated,))
+    pmesh.close()
     mesh.close()
     m.shutdown()
     return text
@@ -198,6 +226,10 @@ def check(text: str) -> list[str]:
     histograms: dict[tuple, list[tuple[float, float]]] = {}
     hist_counts: dict[tuple, float] = {}
     label_values: dict[tuple, set] = {}   # (family, label) -> value set
+    # parent/child collision ledger: federated samples with the worker
+    # label stripped vs parent-side samples of the same family
+    fed_stripped: dict[tuple, int] = {}   # (name, labels-sans-worker) -> line
+    parent_keys: dict[tuple, int] = {}    # (name, labels) -> line
 
     for lineno, line in enumerate(text.splitlines(), 1):
         if not line.strip():
@@ -277,6 +309,19 @@ def check(text: str) -> list[str]:
                 f"line {lineno}: duplicate sample {name}{dict(labels)} — "
                 f"a metric must be registered exactly once per app")
         seen_samples.add(key)
+        # federated worker-label discipline + collision ledger (ISSUE 18)
+        worker = labels.get("worker")
+        if worker is not None:
+            if not WORKER_VALUE_RE.match(worker):
+                problems.append(
+                    f"line {lineno}: worker label value '{worker}' is not "
+                    f"index-shaped (h<i>/w<i>) or a reserved series — "
+                    f"free-form worker values are unbounded identities")
+            stripped = (name, tuple(sorted(
+                (k, v) for k, v in labels.items() if k != "worker")))
+            fed_stripped.setdefault(stripped, lineno)
+        else:
+            parent_keys.setdefault(key, lineno)
         # histogram structure
         if typed.get(family) == "histogram":
             series = tuple(sorted((k, v) for k, v in labels.items()
@@ -310,6 +355,16 @@ def check(text: str) -> list[str]:
                 f"{family}: label '{label}' has {len(values)} distinct "
                 f"values (bound {MAX_LABEL_VALUES}) — cardinality must not "
                 f"scale with population")
+    # parent/child collision: a federated sample that equals a parent
+    # sample once its worker label is stripped would make the two series
+    # indistinguishable under sum()/avg() aggregation over workers
+    for stripped, lineno in fed_stripped.items():
+        if stripped in parent_keys:
+            name, labels = stripped
+            problems.append(
+                f"line {lineno}: federated sample {name}{dict(labels)} "
+                f"collides with the parent-side sample at line "
+                f"{parent_keys[stripped]} once 'worker' is stripped")
     return problems
 
 
@@ -324,6 +379,15 @@ def main() -> int:
         problems.append(
             "lint deployment rendered no siddhi_tpu_mesh_* family — the "
             "mesh fabric surface is unwired or unregistered")
+    if 'worker="fabric"' not in text:
+        problems.append(
+            "lint deployment rendered no worker=\"fabric\" merged series — "
+            "the federated collector is unwired or produced nothing")
+    if not re.search(r'siddhi_tpu_phase_latency_seconds_bucket\{'
+                     r'[^}]*worker="h\d+"', text):
+        problems.append(
+            "lint deployment rendered no per-worker federated "
+            "phase-latency histogram — child trackers did not federate")
     for p in problems:
         print(p)
     if problems:

@@ -100,6 +100,30 @@ def main() -> int:
           and "_unpack_ctxs" in src(dcn.DCNWorker._apply_frame_locally)
           and "_adopt_ctxs" in src(dcn.DCNWorker._apply_frame_locally))
 
+    # 3b) procmesh ingest hop (ISSUE 18): the parent fabric stamps the
+    # context onto the control-socket ingest op; the child adopts it ONLY
+    # behind the seq dedup (lost-ack retries never double spans), records
+    # the transit span + phase histogram, and ships the journey tail back
+    from siddhi_tpu.mesh.fabric import MeshFabric
+    from siddhi_tpu.procmesh.host import ProcMeshHost, RuntimeProxy
+    from siddhi_tpu.procmesh.worker import WorkerServer
+    check("fabric dispatch packs the sampled context onto the ingest op",
+          "context_of" in src(MeshFabric._apply_locked)
+          and "dispatch" in src(MeshFabric._apply_locked))
+    check("proxy ships the context in the ingest header",
+          "trace" in src(RuntimeProxy.send_chunk))
+    check("child adopts ONLY on actual apply (behind the seq dedup)",
+          "_apply_traced" in src(WorkerServer.op_ingest)
+          and "t.applied" in src(WorkerServer.op_ingest))
+    check("child stamps the procmesh transit span + phase histogram",
+          "adopt" in src(WorkerServer._apply_traced)
+          and "procmesh_transit" in src(WorkerServer._apply_traced)
+          and "transit" in src(WorkerServer._apply_traced))
+    check("child ships grown journeys; parent stitches with clock offset",
+          "_trace_tail" in src(WorkerServer.op_flight)
+          and "stitch" in src(ProcMeshHost.forward_flight)
+          and "offset_ns" in src(ProcMeshHost.forward_flight))
+
     # 4) fleet group step
     check("fleet staging registers the active trace per member",
           all("_register_trace" in src(f) for f in (
@@ -119,7 +143,7 @@ def main() -> int:
 
     # every stage name used in the engine classifies into a known phase
     for stage in ("ingress", "queue", "query", "fill-wait", "device",
-                  "fleet", "sink", "dcn"):
+                  "fleet", "sink", "dcn", "procmesh"):
         check(f"stage '{stage}' classifies into an X-Ray phase",
               isinstance(phase_of_stage(stage), str))
 

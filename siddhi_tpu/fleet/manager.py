@@ -134,6 +134,20 @@ class _PartitionPlan:
 
 _FALLBACK_LOG_CAP = 100
 
+# Bounded reason taxonomy for the Prometheus counter family
+# ``siddhi_tpu_fleet_fallbacks_total{reason=...}``: the free-text reasons
+# kept in ``fallback_reasons`` embed exception text (unbounded label
+# cardinality), so the exposition buckets them into a fixed vocabulary.
+FALLBACK_REASON_SLUGS = ("no_fleet_shape", "shape_does_not_lower", "other")
+
+
+def _fallback_slug(reason: str) -> str:
+    if reason.startswith("no fleet shape"):
+        return "no_fleet_shape"
+    if reason.startswith("shape does not lower"):
+        return "shape_does_not_lower"
+    return "other"
+
 
 class FleetManager:
     def __init__(self, cache_size: int = 256):
@@ -150,9 +164,12 @@ class FleetManager:
         # silently — every enrollment that kept the solo path is counted
         # and its reason kept for GET /siddhi-apps/{name}/fleet
         self.fallback_reasons: list[dict] = []
+        self.fallback_counts: dict[str, int] = {
+            slug: 0 for slug in FALLBACK_REASON_SLUGS}
 
     def _note_fallback(self, app: str, name: str, reason: str) -> None:
         self.fallbacks += 1
+        self.fallback_counts[_fallback_slug(reason)] += 1
         self.fallback_reasons.append(
             {"app": app, "query": name, "reason": reason})
         del self.fallback_reasons[:-_FALLBACK_LOG_CAP]
@@ -411,6 +428,12 @@ class FleetManager:
                          lambda c=self.plan_cache: c.evictions)
         # solo-fallback evidence: fleets must not degrade silently
         sm.gauge_tracker("fleet.solo_fallbacks", lambda s=self: s.fallbacks)
+        # bounded reason taxonomy (observability federation satellite):
+        # renders as siddhi_tpu_fleet_fallbacks_total{reason=...} — the
+        # slug vocabulary is fixed, so label cardinality stays bounded
+        for slug in FALLBACK_REASON_SLUGS:
+            sm.gauge_tracker(f"fleet.fallbacks.{slug}",
+                             lambda s=self, g=slug: s.fallback_counts[g])
         # guard families (fleet.tenant.*): ejection/readmit/shed evidence
         # per tenant lane — torn down with the rest of the fleet.* family
         # on app shutdown (StatisticsManager.unregister("fleet."))
@@ -504,4 +527,5 @@ class FleetManager:
                                    + self.split_groups),
                     "enrolled": self.enrolled,
                     "fallbacks": self.fallbacks,
+                    "fallback_counts": dict(self.fallback_counts),
                     "fallback_reasons": list(self.fallback_reasons)}

@@ -108,12 +108,17 @@ class MeshConfig:
                  worker_env: Optional[dict] = None,
                  durable: bool = False,
                  journal_fsync: bool = False,
-                 journal_checkpoint_every: int = 256):
+                 journal_checkpoint_every: int = 256,
+                 trace_sample: Optional[int] = None,
+                 trace_ring: int = 2048,
+                 metrics_stale_after_s: float = 10.0):
         if mode not in ("inproc", "process"):
             raise ValueError(f"mesh mode '{mode}' is not inproc|process")
         if durable and mode != "process":
             raise ValueError("durable=True requires mode='process' (the "
                              "fabric journal recovers real worker processes)")
+        if trace_sample is not None and int(trace_sample) < 1:
+            raise ValueError(f"bad trace_sample {trace_sample} (need >= 1)")
         self.capacity_per_host = int(capacity_per_host)
         self.policy = policy
         self.seed = seed
@@ -143,6 +148,16 @@ class MeshConfig:
         self.durable = bool(durable)
         self.journal_fsync = bool(journal_fsync)
         self.journal_checkpoint_every = int(journal_checkpoint_every)
+        # cross-process trace stitching: 1-in-N ingress sampling on the
+        # fabric's send path; sampled contexts ride the ingest op header
+        # and the child's journey ships back on the flight tail. None =
+        # tracing off (the default — sampling costs one counter per send)
+        self.trace_sample = (int(trace_sample)
+                             if trace_sample is not None else None)
+        self.trace_ring = int(trace_ring)
+        # federation freshness ceiling: a worker whose last good scrape is
+        # older than this renders NO federated families (zombie expiry)
+        self.metrics_stale_after_s = float(metrics_stale_after_s)
 
 
 class MeshHost:
@@ -262,6 +277,15 @@ class MeshFabric:
         # here); migration decisions ALSO fan out to the involved tenant
         # apps' recorders (their operators read their own timelines)
         self.flight = FlightRecorder(app_name="mesh")
+        # fabric-side tracer (host=0: ids mint in the parent namespace and
+        # local journeys register as stitch targets, so child spans coming
+        # back on the flight tail land on the SAME trace object)
+        self.tracer = None
+        if self.cfg.trace_sample is not None:
+            from ..observability.tracing import PipelineTracer
+            self.tracer = PipelineTracer(sample_n=self.cfg.trace_sample,
+                                         ring_size=self.cfg.trace_ring,
+                                         host=0)
         # durable control plane: the journal replays BEFORE anything is
         # spawned — worker give-up budgets and tenant ownership come out
         # of it, and the supervisor's adopt-or-spawn pass consumes them
@@ -566,7 +590,15 @@ class MeshFabric:
                 self._spill_locked(st, seq, stream_id, rows, timestamps)
                 return
             try:
-                self._apply_locked(st, seq, stream_id, rows, timestamps)
+                # 1-in-N ingress sampling happens HERE, on the direct-apply
+                # path only: a spilled chunk replays without a context (its
+                # trace simply records no dispatch), and the replay/recovery
+                # applies never re-sample — exactly-once spans ride on the
+                # seq dedup downstream
+                tr = (self.tracer.maybe_trace(stream_id)
+                      if self.tracer is not None else None)
+                self._apply_locked(st, seq, stream_id, rows, timestamps,
+                                   trace=tr)
             except ConnectionError:
                 # the worker process died under this very chunk (procmesh
                 # WorkerDown is a ConnectionError): the chunk spills and
@@ -582,11 +614,13 @@ class MeshFabric:
             self.shed_chunks += 1        # policy chose to drop: counted
 
     def _apply_locked(self, st: _TenantState, seq: int, stream_id: str,
-                      rows: list, timestamps) -> bool:
+                      rows: list, timestamps, trace=None) -> bool:
         """Apply one chunk under the tenant lock through the dedup mark;
         returns True when the chunk actually applied. With a snapshot
         cadence armed, the tenant persists BEFORE the ack (return) — the
-        acked-chunk-is-durable contract kill-recovery leans on."""
+        acked-chunk-is-durable contract kill-recovery leans on. ``trace``
+        (a fabric-tracer Trace) rides the ingest header as a packed
+        context; the child adopts it only on actual apply."""
         if seq <= st.applied:
             self.dup_chunks += 1
             return False                 # replay of an applied chunk: dedup
@@ -599,8 +633,18 @@ class MeshFabric:
             # only after the durability step below, so a child SIGKILLed
             # between apply and ack re-applies from the restored pre-chunk
             # state and every output is delivered exactly once
+            trace_hex = None
+            if trace is not None and self.tracer is not None:
+                trace_hex = self.tracer.context_of(trace).pack().hex()
+            t0 = time.perf_counter_ns()
             rt.send_chunk(seq, stream_id, [list(r) for r in rows],
-                          list(timestamps))
+                          list(timestamps), trace=trace_hex)
+            if trace is not None:
+                # the parent-side dispatch span: socket round-trip to the
+                # child's applied ack (the child's own transit span covers
+                # dispatch wall-clock → apply, including retry delay)
+                trace.add_span("procmesh", f"dispatch:h{st.host}",
+                               time.perf_counter_ns() - t0, len(rows))
             # applied on the child, not yet cursored in the journal: a
             # parent crash here re-adopts the live child and takes ITS
             # applied mark as authoritative (resync)
@@ -1374,16 +1418,123 @@ class MeshFabric:
 
     def sync_children(self) -> dict:
         """Process-mode observability pull: scrape every live worker's
-        gauge families and absorb its flight-ring tail into the fabric's
-        timeline (site-prefixed ``h{i}:``). Inproc hosts share the parent
-        recorder already — this is a no-op for them."""
+        full tracker state (gauges + counters + latency histograms) and
+        absorb its flight-ring tail into the fabric's timeline
+        (site-prefixed ``h{i}:``, child stamps clock-offset-corrected).
+        Trace journeys riding the tail stitch into the fabric tracer.
+        Inproc hosts share the parent recorder already — this is a no-op
+        for them."""
         out = {"scraped": 0, "forwarded": 0}
         for h in list(self.hosts.values()):
             if not h.alive or not hasattr(h, "forward_flight"):
                 continue
             out["scraped"] += len(h.scrape_metrics())
-            out["forwarded"] += h.forward_flight(self.flight)
+            out["forwarded"] += h.forward_flight(self.flight,
+                                                 tracer=self.tracer)
         return out
+
+    # -- observability federation (ISSUE 18) ---------------------------------
+    def _federated_hosts(self) -> list:
+        """Process-backed hosts whose scrape is FRESH enough to render:
+        dead, gave-up, or stale-scrape workers are excluded, so their
+        families age out of the exposition instead of rendering zombie
+        values; a re-adopted/restarted worker re-enters under the same
+        ``h{i}`` label on its first good scrape."""
+        out = []
+        for h in list(self.hosts.values()):
+            if not hasattr(h, "latency_states"):
+                continue
+            handle = getattr(h, "handle", None)
+            if not h.alive or (handle is not None and handle.gave_up):
+                continue
+            if h.scrape_age_s() > self.cfg.metrics_stale_after_s:
+                continue
+            out.append(h)
+        return out
+
+    @staticmethod
+    def _phase_of_key(key: str) -> Optional[str]:
+        """Scraped latency key → phase name, for keys on the X-Ray phase
+        vocabulary (``{tenant}.phase.{query}.{phase}`` plus the
+        ``end_to_end`` distribution); None for other latency sites."""
+        from ..observability.phases import PHASES
+        parts = key.split(".")
+        leaf = parts[-1]
+        if "phase" in parts[:-1] and leaf in PHASES:
+            return leaf
+        if "detection" in parts[:-1] and leaf == "end_to_end":
+            return "end_to_end"
+        return None
+
+    def collect_federated(self, families: dict,
+                          app: Optional[str] = None) -> None:
+        """Prometheus ``render`` collector hook: per-worker federated
+        families (``worker="h{i}"``) plus the fabric-level merge
+        (``worker="fabric"``) — bounded worker-label cardinality (host
+        count + one), histogram merges exact on the shared ladder."""
+        from ..observability.prometheus import collect_scraped
+        app = app or "mesh"
+        fabric_lat: list = []
+        fabric_ctr: list = []
+        for h in self._federated_hosts():
+            lat, ctr = h.latency_states(), h.counter_states()
+            collect_scraped(families, app, f"h{h.index}",
+                            lat.items(), ctr.items())
+            fabric_lat.extend(lat.items())
+            fabric_ctr.extend(ctr.items())
+        if fabric_lat or fabric_ctr:
+            collect_scraped(families, app, "fabric", fabric_lat, fabric_ctr)
+
+    def federation(self) -> dict:
+        """``GET /mesh/latency``: the federated latency breakdown as JSON
+        — per worker (scrape age, staleness, per-phase p50/p99) plus the
+        fabric-level merge. Scrapes first, so one call is one consistent
+        pull of every live worker."""
+        if self.supervisor is not None:
+            self.sync_children()
+        workers: dict = {}
+        merged_states: dict = {}        # phase -> [state, ...]
+        for h in list(self.hosts.values()):
+            if not hasattr(h, "latency_states"):
+                continue
+            handle = getattr(h, "handle", None)
+            age = h.scrape_age_s()
+            stale = (not h.alive
+                     or (handle is not None and handle.gave_up)
+                     or age > self.cfg.metrics_stale_after_s)
+            entry = {"scrape_age_s": round(age, 3), "stale": stale,
+                     "alive": bool(h.alive), "phases": {}}
+            if not stale:
+                by_phase: dict = {}
+                for key, state in h.latency_states().items():
+                    phase = self._phase_of_key(key)
+                    if phase is None:
+                        continue
+                    by_phase.setdefault(phase, []).append(state)
+                for phase, states in by_phase.items():
+                    entry["phases"][phase] = self._phase_stats(states)
+                    merged_states.setdefault(phase, []).extend(states)
+            workers[f"h{h.index}"] = entry
+        return {
+            "workers": workers,
+            "merged": {phase: self._phase_stats(states)
+                       for phase, states in merged_states.items()},
+            "stale_after_s": self.cfg.metrics_stale_after_s,
+            "clock_offsets_ns": (
+                {f"h{i}": h.clock_offset_ns
+                 for i, h in self.supervisor.handles.items()}
+                if self.supervisor is not None else {}),
+        }
+
+    @staticmethod
+    def _phase_stats(states: list) -> dict:
+        from ..observability.histogram import LogHistogram
+        hist = LogHistogram.merge(states)
+        snap = hist.snapshot()
+        return {"count": snap["count"],
+                "p50_ms": round(snap["p50"] * 1e3, 6),
+                "p99_ms": round(snap["p99"] * 1e3, 6),
+                "avg_ms": round(snap["avg"] * 1e3, 6)}
 
     def report(self) -> dict:
         """Service-facing state (``GET /mesh``)."""

@@ -107,21 +107,37 @@ class FlightRecorder:
                         detail={"from": old})
         return on_transition
 
-    def absorb(self, entries: list, site_prefix: str = "") -> int:
+    def absorb(self, entries: list, site_prefix: str = "",
+               offset_ns: Optional[int] = None) -> int:
         """Merge EXPORTED entries from another recorder into this ring —
         the procmesh fabric forwarding a child worker's transitions into
         the parent's timeline. Sites gain ``site_prefix`` (``h3:``) so a
         merged timeline still attributes decisions to the host process
-        that made them; stamps are re-minted here (the parent ring's
-        ``t_ns`` cursor contract stays strict, arrival order preserved)."""
+        that made them.
+
+        Without ``offset_ns`` stamps are re-minted at absorb time (arrival
+        order, child timing lost). With ``offset_ns`` — the child clock's
+        estimated lead over ours — each entry keeps its ORIGINAL stamp
+        corrected into the parent clock domain, so the merged timeline is
+        causally ordered across processes; stamps still bump strictly past
+        the previous entry (the ``t_ns`` cursor contract survives)."""
         n = 0
         for e in entries:
             try:
-                self.record(e.get("category", "procmesh"),
-                            e.get("kind", ""),
-                            f"{site_prefix}{e.get('site', '')}",
-                            detail=e.get("detail"),
-                            trace_id=e.get("trace_id"))
+                self.recorded += 1
+                if offset_ns is None:
+                    t_ns = time.time_ns()
+                else:
+                    t_ns = int(e.get("t_ns", 0)) - int(offset_ns)
+                if t_ns <= self._last_t_ns:
+                    t_ns = self._last_t_ns + 1
+                self._last_t_ns = t_ns
+                self.ring.append((t_ns, next(self._seq),
+                                  e.get("category", "procmesh"),
+                                  e.get("kind", ""),
+                                  f"{site_prefix}{e.get('site', '')}",
+                                  e.get("detail"),
+                                  e.get("trace_id")))
                 n += 1
             except Exception:   # noqa: BLE001 — observability must never
                 # take the forwarding path down

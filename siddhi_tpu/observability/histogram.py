@@ -142,6 +142,70 @@ class LogHistogram:
                 "p99": self._percentile_of(d_counts, d_count, 0.99, self.max),
             }
 
+    # -- serializable state (federation over the procmesh control wire) --------
+    def state(self) -> dict:
+        """One consistent, JSON-safe dump of the full histogram: ladder
+        shape + raw (non-cumulative) bucket counts, trimmed past the last
+        occupied slot. Two states on the same ladder merge by summing
+        counts — the fixed geometric bounds are the merge invariant."""
+        with self._lock:
+            last = -1
+            for i, c in enumerate(self._counts):
+                if c:
+                    last = i
+            return {
+                "min_value": self.min_value,
+                "growth": self.growth,
+                "num_buckets": len(self._bounds),
+                "counts": self._counts[:last + 1],
+                "count": self.count,
+                "sum": self.sum,
+                "min": self.min,
+                "max": self.max,
+            }
+
+    def _check_ladder(self, state: dict) -> None:
+        if (abs(state["min_value"] - self.min_value) > 1e-12
+                or abs(state["growth"] - self.growth) > 1e-12
+                or state["num_buckets"] != len(self._bounds)):
+            raise ValueError(
+                f"histogram ladder mismatch: cannot merge "
+                f"(min={state['min_value']}, growth={state['growth']}, "
+                f"buckets={state['num_buckets']}) into "
+                f"(min={self.min_value}, growth={self.growth}, "
+                f"buckets={len(self._bounds)})")
+
+    def merge_state(self, state: dict) -> None:
+        """Fold a :meth:`state` dump into this histogram by summing bucket
+        counts. Raises ``ValueError`` on a ladder mismatch — merging
+        across different bucket bounds would silently misbucket."""
+        self._check_ladder(state)
+        counts = state["counts"]
+        if len(counts) > len(self._counts):
+            raise ValueError("histogram state has more counts than ladder")
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += int(c)
+            self.count += int(state["count"])
+            self.sum += float(state["sum"])
+            smin, smax = state.get("min"), state.get("max")
+            if smin is not None:
+                self.min = smin if self.min is None else min(self.min, smin)
+            if smax is not None:
+                self.max = smax if self.max is None else max(self.max, smax)
+
+    @classmethod
+    def merge(cls, states) -> "LogHistogram":
+        """Build one histogram from an iterable of :meth:`state` dumps
+        (empty iterable → empty histogram on the default ladder). All
+        states must share one ladder."""
+        out = None
+        for st in states:
+            if out is None:
+                out = cls(st["min_value"], st["growth"], st["num_buckets"])
+            out.merge_state(st)
+        return out if out is not None else cls()
+
     def export(self) -> tuple[list[tuple[float, int]], int, float]:
         """One consistent ``(buckets, count, sum)`` read under the lock —
         exposition must not read buckets and count separately, or a

@@ -26,7 +26,10 @@ trackers, and the bench ``latency_breakdown`` line):
 - ``host_exec``     — host-tier execution (interpreter, columnar,
   fleet lanes, shadow replays);
 - ``sink_publish``  — delivery/publish downstream of the step;
-- ``dcn_transit``   — the cross-host hop (send wall-clock → apply).
+- ``dcn_transit``   — the cross-host hop (send wall-clock → apply);
+- ``procmesh_transit`` — the parent→child control-socket hop in a
+  process-per-host fabric (dispatch wall-clock → child apply, including
+  any lost-ack retry delay).
 """
 
 from __future__ import annotations
@@ -35,7 +38,7 @@ from typing import Optional
 
 PHASES = ("ingress_parse", "ingress_queue", "fill_wait", "pack",
           "device_step", "egress_fence", "host_exec", "sink_publish",
-          "dcn_transit")
+          "dcn_transit", "procmesh_transit")
 
 # span stage → phase (unknown stages are host work by default: every
 # host-side processor span nests inside the query chain)
@@ -51,6 +54,7 @@ _STAGE_PHASE = {
     "fleet": "host_exec",
     "sink": "sink_publish",
     "dcn": "dcn_transit",
+    "procmesh": "procmesh_transit",
 }
 
 

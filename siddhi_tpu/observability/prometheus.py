@@ -60,6 +60,12 @@ def _split_key(key: str) -> tuple[str, dict, Optional[str]]:
         if parts[1] == "tenant" and len(parts) >= 4:
             return scope, {"query": parts[2]}, \
                 "tenant." + ".".join(parts[3:])
+        if parts[1] == "fallbacks" and len(parts) >= 3:
+            # fleet.fallbacks.{reason}: the solo-fallback counter family,
+            # keyed by the BOUNDED reason taxonomy (fleet/manager.py) —
+            # one family, reason as label, never a per-reason name
+            return scope, {"reason": _sanitize(".".join(parts[2:]))}, \
+                "fallbacks_total"
         if parts[1] in ("shape_cache", "solo_fallbacks"):
             return scope, {}, ".".join(parts[1:])
         field = ".".join(parts[2:]) or None
@@ -213,14 +219,82 @@ def _collect(sm, families: dict, with_exemplars: bool = False) -> None:
         f.add({**app, **labels}, count, "_count")
 
 
-def render(managers: Iterable, with_exemplars: bool = False) -> str:
+def collect_scraped(families: dict, app: str, worker: str,
+                    latency_items: Iterable, counter_items: Iterable) -> None:
+    """Append one SCRAPED tracker-state set (a procmesh worker's
+    ``metrics``-op reply, tenant-prefixed keys) into a shared family map
+    under a ``worker`` label — the federation half of :func:`render`.
+
+    The tenant prefix is STRIPPED before the key maps through
+    :func:`_split_key`: per-tenant label cardinality is unbounded and the
+    metric lint forbids a ``tenant`` label, so states from different
+    tenants that land on the same ``(family, labels)`` MERGE — histogram
+    states by bucket-count summing (the fixed ladder makes that exact),
+    counters by addition. ``latency_items`` yields ``(key, state)`` pairs
+    (:meth:`LogHistogram.state` dumps), ``counter_items`` yields
+    ``(key, int)`` pairs; both may carry the same key more than once
+    (fabric-level merges feed every worker's items through one call)."""
+    from .histogram import LogHistogram
+
+    def fam(name: str, mtype: str, help_text: str) -> _Family:
+        f = families.get(name)
+        if f is None:
+            f = families[name] = _Family(name, mtype, help_text)
+        return f
+
+    base = {"app": app, "worker": worker}
+    merged: dict = {}               # (name, label_items) -> LogHistogram
+    for key, state in latency_items:
+        rest = key.split(".", 1)[-1]            # strip the tenant prefix
+        scope, labels, _ = _split_key(rest)
+        name = _LATENCY_FAMILIES.get(
+            scope, f"siddhi_tpu_{_sanitize(rest)}_latency_seconds")
+        ident = (name, tuple(sorted({**base, **labels}.items())))
+        hist = merged.get(ident)
+        try:
+            if hist is None:
+                merged[ident] = LogHistogram.merge([state])
+            else:
+                hist.merge_state(state)
+        except (ValueError, KeyError, TypeError):
+            continue                # ladder mismatch / malformed: skip
+    for (name, label_items), hist in merged.items():
+        labels = dict(label_items)
+        f = fam(name, "histogram",
+                "federated latency distribution (seconds) by worker")
+        buckets, count, total = hist.export()
+        for le, cum in buckets:
+            f.add({**labels, "le": f"{le:.6g}"}, cum, "_bucket")
+        f.add({**labels, "le": "+Inf"}, count, "_bucket")
+        f.add({**labels}, total, "_sum")
+        f.add({**labels}, count, "_count")
+
+    ctr_merged: dict = {}
+    for key, v in counter_items:
+        rest = key.split(".", 1)[-1]
+        scope, labels, field = _split_key(rest)
+        name = _metric_name(scope, field, "_total")
+        ident = (name, tuple(sorted({**base, **labels}.items())), scope)
+        ctr_merged[ident] = ctr_merged.get(ident, 0) + int(v)
+    for (name, label_items, scope), v in ctr_merged.items():
+        fam(name, "counter", f"federated {scope} counter by worker").add(
+            dict(label_items), v)
+
+
+def render(managers: Iterable, with_exemplars: bool = False,
+           collectors: Iterable = ()) -> str:
     """Prometheus text for one or more apps' StatisticsManagers.
     ``with_exemplars=True`` renders the OpenMetrics-flavored exposition
     (trace-id exemplars on ``le`` buckets; serve it under
-    :data:`OPENMETRICS_CONTENT_TYPE` with a trailing ``# EOF``)."""
+    :data:`OPENMETRICS_CONTENT_TYPE` with a trailing ``# EOF``).
+    ``collectors`` are callables receiving the shared family map — the
+    procmesh fabric's federated exposition hooks in here, so one scrape
+    renders parent families AND per-worker/merged child families."""
     families: dict[str, _Family] = {}
     for sm in managers:
         _collect(sm, families, with_exemplars)
+    for collector in collectors:
+        collector(families)
     lines: list[str] = []
     for name in sorted(families):
         f = families[name]

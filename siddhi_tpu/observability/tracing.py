@@ -123,6 +123,16 @@ class Trace:
     def stages(self) -> set:
         return {s.stage for s in self.spans}
 
+    def spans_wire(self) -> list:
+        """Raw-nanosecond, JSON-safe span dump for cross-PROCESS shipping
+        (the procmesh flight tail) — :meth:`to_dict` renders milliseconds
+        for humans; the stitch side needs exact ns for dedup identity."""
+        return [{"stage": s.stage, "name": s.name,
+                 "start_offset_ns": s.start_offset_ns,
+                 "duration_ns": s.duration_ns,
+                 "batch_size": s.batch_size, "outcome": s.outcome}
+                for s in self.spans]
+
     def to_dict(self) -> dict:
         out = {"trace_id": self.trace_id, "stream": self.stream,
                "started_at": self.started_at,
@@ -232,6 +242,40 @@ class PipelineTracer:
                    started_at=ctx.ingress_unix_ns / 1e9)
         self._register_adopted(key, tr)
         self.ring.append(tr)
+        return tr
+
+    def stitch(self, origin_host: int, trace_id: int, spans: list,
+               offset_ns: int = 0, stream: str = "procmesh") -> Trace:
+        """Fold spans shipped from another PROCESS (a procmesh child's
+        :meth:`Trace.spans_wire` tail) into the trace holding
+        ``(origin, id)`` here — the parent-side half of cross-process
+        stitching. ``offset_ns`` is the shipper's wall-clock LEAD over ours
+        (the supervisor's per-worker estimate): child offsets anchor to the
+        origin ingress via the child's clock, so subtracting the lead makes
+        the merged waterfall causally ordered. Dedup is by span identity
+        ``(stage, name, corrected offset, duration)`` — re-shipped tails
+        are idempotent. Journeys whose local trace was evicted (or that a
+        restarted parent never held) get a fresh stitch target."""
+        key = (origin_host, trace_id)
+        tr = self._adopted.get(key)
+        if tr is None:
+            tr = Trace(trace_id, stream, host=self.host,
+                       origin_host=origin_host)
+            self._register_adopted(key, tr)
+            self.ring.append(tr)
+        seen = {(s.stage, s.name, s.start_offset_ns, s.duration_ns)
+                for s in tr.spans}
+        for s in spans:
+            off = max(0, int(s.get("start_offset_ns", 0)) - int(offset_ns))
+            ident = (s.get("stage", ""), s.get("name", ""), off,
+                     int(s.get("duration_ns", 0)))
+            if ident in seen:
+                continue
+            seen.add(ident)
+            tr.add_span(ident[0], ident[1], ident[3],
+                        batch_size=int(s.get("batch_size", 1)),
+                        outcome=s.get("outcome", "ok"),
+                        start_offset_ns=off)
         return tr
 
     def _register_adopted(self, key, tr: Trace) -> None:

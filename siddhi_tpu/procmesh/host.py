@@ -18,6 +18,7 @@ the restored pre-chunk state and every output is delivered exactly once
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Optional
 
 from .protocol import (
@@ -139,12 +140,17 @@ class RuntimeProxy:
 
     # -- ingest / outputs ----------------------------------------------------
     def send_chunk(self, seq: int, stream_id: str, rows: list,
-                   ts: list) -> bool:
+                   ts: list, trace: Optional[str] = None) -> bool:
         """Ship one seq-stamped chunk; reply events buffer until the
-        fabric confirms durability and calls :meth:`deliver_pending`."""
+        fabric confirms durability and calls :meth:`deliver_pending`.
+        ``trace`` is a hex-packed TraceContext riding the header — the
+        child adopts it only on actual apply (seq dedup), so a lost-ack
+        retry carries the SAME context and never doubles a span."""
         from ..tpu.dcn import pack_rows
         h = {"tenant": self.tenant_id, "stream": stream_id, "seq": seq,
              "ack": self.delivered}
+        if trace is not None:
+            h["trace"] = trace
         types = _soa_types(rows)
         if types is not None:
             h["enc"] = "soa"
@@ -266,6 +272,10 @@ class ProcMeshHost:
         self._specs: dict = {}          # tenant_id -> TenantSpec (redeploy)
         self._sm = None
         self._scrape_cache: dict = {}
+        self._scrape_counters: dict = {}
+        self._scrape_latency: dict = {}     # name -> LogHistogram state
+        self._scrape_t: Optional[float] = None  # monotonic of last GOOD scrape
+        self._scrape_t0 = time.monotonic()
         self._last_child_evidence: dict = {}
 
     @property
@@ -342,18 +352,45 @@ class ProcMeshHost:
 
     # -- child metric aggregation -------------------------------------------
     def scrape_metrics(self) -> dict:
+        """Pull the child's full tracker state over the control wire. On
+        ``WorkerDown`` the last good scrape is KEPT but its age keeps
+        growing (:meth:`scrape_age_s`) — the federation layer expires
+        families past the staleness ceiling instead of rendering dead
+        values as live (the ISSUE-18 staleness fix)."""
         try:
             rh, _ = self.client.call("metrics")
             self._scrape_cache = dict(rh.get("gauges", {}))
+            self._scrape_counters = dict(rh.get("counters", {}))
+            self._scrape_latency = dict(rh.get("latency", {}))
+            self._scrape_t = time.monotonic()
         except WorkerDown:
-            pass                        # keep the last scrape
+            pass                        # keep the last scrape; age grows
         return self._scrape_cache
+
+    def scrape_age_s(self) -> float:
+        """Seconds since the last SUCCESSFUL child scrape (since host
+        creation when none ever landed) — the exported freshness signal:
+        a dead or gave-up worker's age grows without bound, and the
+        federated exposition drops its families past the ceiling."""
+        return time.monotonic() - (self._scrape_t if self._scrape_t
+                                   is not None else self._scrape_t0)
+
+    def counter_states(self) -> dict:
+        return dict(self._scrape_counters)
+
+    def latency_states(self) -> dict:
+        """Last scraped ``{tenant.name: LogHistogram state}`` — the raw
+        material the fabric merges into per-worker and fabric-level
+        families."""
+        return dict(self._scrape_latency)
 
     def register_child_metrics(self, sm) -> int:
         """(Re-)register the child's scraped gauge families under
         ``mesh.h{i}.child.*``. Idempotent by unregister-first, so a
         restarted child's fresh families replace the old generation —
-        never leak beside it (tests/test_metrics.py pins the teardown)."""
+        never leak beside it (tests/test_metrics.py pins the teardown).
+        ``scrape_age_s`` rides the same prefix, so the freshness gauge
+        tears down with the host."""
         self._sm = sm
         sm.unregister(f"mesh.h{self.index}.child.")
         names = sorted(self.scrape_metrics())
@@ -361,6 +398,8 @@ class ProcMeshHost:
             sm.gauge_tracker(
                 f"mesh.h{self.index}.child.{name}",
                 lambda name=name: self._scrape_cache.get(name, 0.0))
+        sm.gauge_tracker(f"mesh.h{self.index}.child.scrape_age_s",
+                         self.scrape_age_s)
         return len(names)
 
     def unregister_child_metrics(self) -> None:
@@ -368,19 +407,34 @@ class ProcMeshHost:
             self._sm.unregister(f"mesh.h{self.index}.child.")
 
     # -- flight-recorder forwarding -----------------------------------------
-    def forward_flight(self, flight) -> int:
+    def forward_flight(self, flight, tracer=None) -> int:
         """Absorb the child runtimes' control-plane transitions into the
         fabric's ring (site-prefixed ``h{i}:``), tailing by the ring's
-        loss-free ``since_ns`` cursor."""
+        loss-free ``since_ns`` cursor. Child stamps are corrected by the
+        supervisor's clock-offset estimate so the merged timeline is
+        causally ordered; trace journeys riding the tail stitch into
+        ``tracer`` (span-identity dedup — idempotent)."""
         try:
             rh, _ = self.client.call(
                 "flight", {"since_ns": self.handle.flight_cursor})
         except WorkerDown:
             return 0
         entries = rh.get("entries", [])
+        offset_ns = int(getattr(self.handle, "clock_offset_ns", 0))
         if entries:
             self.handle.flight_cursor = max(e["t_ns"] for e in entries)
-        return flight.absorb(entries, site_prefix=f"h{self.index}:")
+        if tracer is not None:
+            for tj in rh.get("traces", ()):
+                try:
+                    tracer.stitch(int(tj.get("origin_host", 0)),
+                                  int(tj.get("trace_id", 0)),
+                                  tj.get("spans", ()),
+                                  offset_ns=offset_ns,
+                                  stream=tj.get("stream", "procmesh"))
+                except Exception:   # noqa: BLE001 — stitching must never
+                    continue        # take the sync path down
+        return flight.absorb(entries, site_prefix=f"h{self.index}:",
+                             offset_ns=offset_ns)
 
     # -- crash / teardown ----------------------------------------------------
     def kill(self) -> None:

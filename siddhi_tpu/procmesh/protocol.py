@@ -26,6 +26,18 @@ Ingest rows ride either JSON (``enc='json'``, any row shape) or the DCN
 SoA wire (``enc='soa'`` — :func:`~siddhi_tpu.tpu.dcn.pack_rows` bytes in
 the body, the worker-owned bulk hand-off decoded by ``unpack_rows`` on
 the child), chosen per chunk by whether a types string covers the rows.
+An ingest header may additionally carry ``trace`` — a hex-packed
+:class:`~siddhi_tpu.observability.tracing.TraceContext` the child adopts
+only on actual apply (seq dedup ⇒ exactly-once spans).
+
+Observability federation (ISSUE 18): the ``metrics`` op reply ships FULL
+tracker state — ``gauges`` (floats), ``counters`` (ints), ``latency``
+(serialized :meth:`LogHistogram.state` dumps, mergeable by summing
+counts on the fixed quarter-octave ladder) — plus a ``unix_ns`` scrape
+stamp; ``ping`` replies and the ``PROCMESH_READY`` hello carry
+``unix_ns`` so the supervisor can estimate each shard's wall-clock
+offset; ``flight`` replies carry a ``traces`` tail of grown trace
+journeys for parent-side stitching.
 """
 
 from __future__ import annotations

@@ -107,6 +107,11 @@ class ProcWorkerHandle:
                                       cfg.restart_window_s, cfg.restart_max)
         self.client = WorkerClient(lambda: self.port)
         self.flight_cursor = 0          # child flight-ring tail (since_ns)
+        # estimated wall-clock LEAD of the child over this process
+        # (child_unix_ns - parent_unix_ns), from the ready hello and
+        # refined by ping RTT midpoints — the federation layer uses it to
+        # causally order merged flight timelines and stitched trace spans
+        self.clock_offset_ns = 0
 
     @property
     def alive(self) -> bool:
@@ -243,15 +248,34 @@ class ProcMeshSupervisor:
                 or rh.get("index") != h.index):
             return False
         h.proc = None
+        h.clock_offset_ns = 0           # refreshed below over the client
         h.adopted = True
         h.port = int(rf["port"])
         h.pid = int(rf["pid"])
         h.nonce = rf.get("nonce")
         h.health.record_success()
+        self._refresh_clock(h)          # re-adoption refreshes the offset
         self.flight.record("procmesh", "worker_readopt",
                            site=f"worker:{h.index}",
-                           detail={"pid": h.pid, "port": h.port})
+                           detail={"pid": h.pid, "port": h.port,
+                                   "clock_offset_ns": h.clock_offset_ns})
         return True
+
+    def _refresh_clock(self, h: ProcWorkerHandle) -> None:
+        """RTT-midpoint clock-offset estimate over one ping: the child's
+        reply stamp minus the midpoint of our send/receive wall-clocks.
+        Loopback RTTs are sub-millisecond, so the estimate's error bar is
+        RTT/2 — documented in DISTRIBUTED.md as the causal-ordering
+        caveat. Best-effort: a failed ping keeps the previous estimate."""
+        try:
+            t0 = time.time_ns()
+            rh, _ = h.client.call("ping", timeout=5.0)
+            t1 = time.time_ns()
+        except WorkerDown:
+            return
+        child_ns = rh.get("unix_ns")
+        if child_ns is not None:
+            h.clock_offset_ns = int(child_ns) - (t0 + t1) // 2
 
     def _await_ready(self, h: ProcWorkerHandle) -> None:
         import json as _json
@@ -274,7 +298,12 @@ class ProcMeshSupervisor:
         h.port = int(hello["port"])
         h.pid = int(hello["pid"])
         h.nonce = hello.get("nonce")
+        if hello.get("unix_ns") is not None:
+            # coarse handshake estimate (biased by the stdout read delay);
+            # the RTT-midpoint refresh below tightens it
+            h.clock_offset_ns = int(hello["unix_ns"]) - time.time_ns()
         h.health.record_success()
+        self._refresh_clock(h)
 
     # -- fabric host construction -------------------------------------------
     def host(self, index: int, capacity: int,
@@ -313,14 +342,19 @@ class ProcMeshSupervisor:
         if not h.health.allow_probe():
             return
         try:
+            t0 = time.time_ns()
             rh, _ = h.client.call("ping", timeout=self.cfg.down_cooldown_s
                                   + self.cfg.heartbeat_interval_s)
+            t1 = time.time_ns()
         except WorkerDown:
             h.health.record_failure()
             if h.health.state == "down":
                 self._on_death(h, cause="heartbeat")
             return
         h.health.record_success()
+        if rh.get("unix_ns") is not None:
+            # every heartbeat refreshes the RTT-midpoint offset estimate
+            h.clock_offset_ns = int(rh["unix_ns"]) - (t0 + t1) // 2
         if rh.get("uptime_s", 0) > self.cfg.restart_window_s:
             h.backoff.note_stable()     # a stable child earns its budget back
         for decision in rh.get("escalations", ()):
@@ -436,6 +470,8 @@ class ProcMeshSupervisor:
                              lambda h=h: h.health.downtime_s())
             sm.gauge_tracker(f"procmesh.w{i}.last_downtime_s",
                              lambda h=h: h.health.last_downtime_s)
+            sm.gauge_tracker(f"procmesh.w{i}.clock_offset_ns",
+                             lambda h=h: h.clock_offset_ns)
         sm.gauge_tracker("procmesh.self.workers",
                          lambda: sum(1 for h in self.handles.values()
                                      if h.alive))

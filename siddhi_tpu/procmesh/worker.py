@@ -82,6 +82,12 @@ class WorkerServer:
         self.tenants: dict = {}            # tenant_id -> _Tenant
         self.rows_in = 0
         self.escalations: list = []        # SLO mesh_replace decisions
+        # trace-journey shipping cursors: (tenant, (origin, trace_id)) ->
+        # spans already shipped on an op_flight tail, so re-polls ship only
+        # span growth (bounded: evicted oldest-first past the cap)
+        from collections import OrderedDict
+        self._trace_shipped: "OrderedDict" = OrderedDict()
+        self._trace_shipped_cap = 4096
         self.dcn = None                    # optional worker-owned DCNWorker
         # boot identity: a restarted supervisor re-adopts a live worker only
         # if pid AND nonce match its runfile (pid reuse cannot spoof a shard)
@@ -218,6 +224,10 @@ class WorkerServer:
                 "uptime_s": time.monotonic() - self.started,
                 "tenants": len(self.tenants),
                 "rows_in": self.rows_in,
+                # the shard's wall-clock at reply build: the supervisor
+                # estimates this process's clock offset from the request
+                # RTT midpoint (refreshed on every adoption/restart)
+                "unix_ns": time.time_ns(),
                 "escalations": esc}, b""
 
     def op_deploy(self, h: dict, body: bytes):
@@ -264,7 +274,12 @@ class WorkerServer:
     def op_ingest(self, h: dict, body: bytes):
         """Apply one seq-stamped chunk through the dedup mark. The reply
         carries the outbox tail past the client's ``ack`` cursor — dup ops
-        (lost-ack retries) re-ship the same events, apply nothing."""
+        (lost-ack retries) re-ship the same events, apply nothing.
+
+        A sampled TraceContext may ride the header (hex-packed). Adoption
+        happens ONLY inside the apply branch — the ``K_ROWS`` discipline:
+        a lost-ack retry dedups on ``seq`` and never re-adopts, so spans
+        stay exactly-once alongside the rows."""
         t = self._tenant(h)
         seq = int(h["seq"])
         applied = False
@@ -274,13 +289,65 @@ class WorkerServer:
                 rows, tss = unpack_rows(body)
             else:
                 rows, tss = h["rows"], h["ts"]
-            t.rt.input_handler(h["stream"]).send_rows(
-                [list(r) for r in rows], list(tss))
+            rows = [list(r) for r in rows]
+            tss = list(tss)
+            self._apply_traced(t, h, rows, tss)
             t.applied = seq
             self.rows_in += len(rows)
             applied = True
         return {"applied": applied,
                 "events": self._out_tail(t, int(h.get("ack", -1)))}, b""
+
+    def _apply_traced(self, t: _Tenant, h: dict, rows: list,
+                      tss: list) -> None:
+        """Deliver an applied chunk, stitching a trace-context header into
+        the tenant tracer's ring: the adopted trace gets a ``procmesh``
+        transit span (dispatch wall-clock → apply, so retry delay counts as
+        transit) and is ACTIVE while the engine runs, so device/sink spans
+        land on the same journey. The transit also records into the
+        ``phase.{stream}.procmesh_transit`` histogram — scraped by the
+        parent through op_metrics for the federated breakdown."""
+        ih = t.rt.input_handler(h["stream"])
+        tracer = getattr(t.rt.ctx, "tracer", None)
+        ctx_hex = h.get("trace")
+        if tracer is None and ctx_hex:
+            # the parent fabric samples traces even for tenant apps that
+            # carry no @app:trace of their own — install an adopt-only
+            # tracer (host=None: it never mints shippable local journeys;
+            # the huge sample keeps the untraced send_rows path quiet)
+            from ..observability.tracing import PipelineTracer
+            tracer = t.rt.ctx.tracer = PipelineTracer(
+                sample_n=1 << 20, ring_size=256, host=None)
+        if tracer is None or not ctx_hex:
+            ih.send_rows(rows, tss)
+            return
+        from ..observability.tracing import TraceContext
+        try:
+            ctx = TraceContext.unpack_from(bytes.fromhex(ctx_hex))
+        except Exception:   # noqa: BLE001 — a malformed trace header
+            ih.send_rows(rows, tss)       # must never drop the rows
+            return
+        now_unix = time.time_ns()
+        transit_ns = max(0, now_unix - ctx.sent_unix_ns)
+        tr = tracer.adopt(ctx)
+        tr.add_span("procmesh", f"transit:w{self.index}", transit_ns,
+                    batch_size=len(rows),
+                    start_offset_ns=max(
+                        0, ctx.sent_unix_ns - ctx.ingress_unix_ns))
+        sm = t.rt.ctx.statistics_manager
+        sm.latency_tracker(
+            f"phase.{h['stream']}.procmesh_transit").record_seconds(
+            transit_ns / 1e9, n=len(rows), exemplar=ctx.trace_id)
+        # bypass send_rows' own sampler (it would mint a SIBLING trace and
+        # split the journey) — same traced-ingress idiom, adopted trace
+        t0 = time.perf_counter_ns()
+        tracer.push(tr)
+        try:
+            ih._send_rows(rows, tss)
+        finally:
+            tracer.pop()
+            tr.add_span("ingress", h["stream"],
+                        time.perf_counter_ns() - t0, len(rows))
 
     def op_resync(self, h: dict, body: bytes):
         """Parent-recovery reconciliation: a restarted supervisor re-adopts
@@ -328,19 +395,40 @@ class WorkerServer:
         }}, b""
 
     def op_metrics(self, h: dict, body: bytes):
-        """Scrape every deployed runtime's gauge trackers (name-spaced by
+        """Scrape every deployed runtime's trackers (name-spaced by
         tenant) for parent-side aggregation — the child's families never
         register in the parent's StatisticsManager directly, so a dead
-        child can never leak zombie gauges there."""
-        gauges = {}
+        child can never leak zombie gauges there.
+
+        Beyond the original gauge floats, the reply ships counters and
+        FULL latency-histogram states (:meth:`LogHistogram.state` — fixed
+        quarter-octave ladder, so the parent merges by summing counts):
+        the federation plane's raw material. ``unix_ns`` stamps the scrape
+        for parent-side freshness accounting."""
+        gauges, counters, latency = {}, {}, {}
         for tid, t in self.tenants.items():
             sm = t.rt.ctx.statistics_manager
-            for name, tr in sm.snapshot_trackers().get("gauges", {}).items():
+            snap = sm.snapshot_trackers()
+            for name, tr in snap.get("gauges", {}).items():
                 try:
                     gauges[f"{tid}.{name}"] = float(tr.value)
                 except Exception:   # noqa: BLE001 — one bad gauge must not
                     continue        # take the scrape down
-        return {"gauges": gauges}, b""
+            for name, tr in snap.get("counters", {}).items():
+                try:
+                    counters[f"{tid}.{name}"] = int(tr.count)
+                except Exception:   # noqa: BLE001
+                    continue
+            for name, tr in snap.get("latency", {}).items():
+                hist = getattr(tr, "hist", None)
+                if hist is None:
+                    continue
+                try:
+                    latency[f"{tid}.{name}"] = hist.state()
+                except Exception:   # noqa: BLE001
+                    continue
+        return {"gauges": gauges, "counters": counters,
+                "latency": latency, "unix_ns": time.time_ns()}, b""
 
     def op_flight(self, h: dict, body: bytes):
         """Tail every runtime's flight-recorder ring past ``since_ns`` —
@@ -356,7 +444,31 @@ class WorkerServer:
                 e["tenant"] = tid
                 entries.append(e)
         entries.sort(key=lambda e: e["t_ns"])
-        return {"entries": entries}, b""
+        return {"entries": entries, "traces": self._trace_tail()}, b""
+
+    def _trace_tail(self) -> list:
+        """Adopted-trace journeys that GREW since the last poll: each item
+        ships only the new spans past the per-trace cursor, so the parent's
+        stitch is append-only (and idempotent regardless — the parent
+        dedups by span identity, so an overlap can never double a span)."""
+        out = []
+        for tid, t in self.tenants.items():
+            tracer = getattr(t.rt.ctx, "tracer", None)
+            if tracer is None:
+                continue
+            for key, tr in list(tracer._adopted.items()):
+                spans = tr.spans_wire()
+                cur = self._trace_shipped.get((tid, key), 0)
+                if len(spans) <= cur:
+                    continue
+                out.append({"origin_host": key[0], "trace_id": key[1],
+                            "stream": tr.stream, "tenant": tid,
+                            "spans": spans[cur:]})
+                self._trace_shipped[(tid, key)] = len(spans)
+                self._trace_shipped.move_to_end((tid, key))
+        while len(self._trace_shipped) > self._trace_shipped_cap:
+            self._trace_shipped.popitem(last=False)
+        return out
 
     def op_boot_dcn(self, h: dict, body: bytes):
         """Boot the worker-owned DCN data plane: a DCNWorker bound to its
@@ -410,9 +522,11 @@ def main(argv=None) -> int:
         # parent proceeds, a parent crash + restart must find this shard
         from .protocol import write_runfile
         write_runfile(args.rundir, args.index, port, os.getpid(), srv.nonce)
-    print(f"PROCMESH_READY "
-          f"{json.dumps({'port': port, 'pid': os.getpid(), 'nonce': srv.nonce})}",
-          flush=True)
+    hello = {"port": port, "pid": os.getpid(), "nonce": srv.nonce,
+             # wall-clock at hello: the supervisor's first (coarse) clock-
+             # offset estimate for this shard, refined by ping RTT later
+             "unix_ns": time.time_ns()}
+    print(f"PROCMESH_READY {json.dumps(hello)}", flush=True)
     srv.serve_forever()
     if args.rundir:
         # clean stop: a restarted supervisor must not dial a retired shard

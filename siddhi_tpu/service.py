@@ -164,6 +164,9 @@ class SiddhiService:
                 elif parts == ["mesh"]:
                     code, payload = service.mesh_stats()
                     self._reply(code, payload)
+                elif parts == ["mesh", "latency"]:
+                    code, payload = service.mesh_latency()
+                    self._reply(code, payload)
                 elif parts == ["metrics"]:
                     code, text, ctype = service.metrics_text(
                         None, openmetrics=self._wants_openmetrics())
@@ -257,6 +260,18 @@ class SiddhiService:
         if self.mesh is None:
             return 200, {"status": "OK", "enabled": False}
         return 200, {"status": "OK", "enabled": True, **self.mesh.report()}
+
+    def mesh_latency(self) -> tuple[int, dict]:
+        """Federated latency breakdown across the process mesh: one pull
+        of every live worker's phase histograms, rendered per-worker plus
+        the fabric-level merge (``GET /mesh/latency``)."""
+        if self.mesh is None:
+            return 200, {"status": "OK", "enabled": False}
+        try:
+            fed = self.mesh.federation()
+        except Exception as e:  # noqa: BLE001 — surfaced to the caller
+            return 500, {"status": "ERROR", "message": str(e)}
+        return 200, {"status": "OK", "enabled": True, **fed}
 
     # -- operations (also usable programmatically) -----------------------------
     def deploy(self, app_text: str) -> tuple[int, dict]:
@@ -411,7 +426,19 @@ class SiddhiService:
             if rt is None:
                 return 404, f"no app '{name}' deployed", CONTENT_TYPE
             managers = [rt.ctx.statistics_manager]
-        text = render(managers, with_exemplars=openmetrics)
+        collectors = ()
+        if name is None and self.mesh is not None \
+                and self.mesh.supervisor is not None:
+            # federate the process mesh on the all-apps scrape: pull every
+            # live worker's tracker state, then render per-worker families
+            # plus the fabric merge alongside the parent's own
+            try:
+                self.mesh.sync_children()
+            except Exception:  # noqa: BLE001 — stale caches still render
+                pass
+            collectors = (self.mesh.collect_federated,)
+        text = render(managers, with_exemplars=openmetrics,
+                      collectors=collectors)
         if openmetrics:
             text += "# EOF\n"
         return 200, text, ctype

@@ -1,0 +1,318 @@
+"""Fabric X-Ray federation (ISSUE 18): one scrape, one trace, one
+timeline across the process mesh.
+
+The acceptance pins:
+
+- ``LogHistogram`` snapshots MERGE exactly: summing bucket counts on the
+  fixed quarter-octave ladder reproduces the histogram of the
+  concatenated samples bucket-for-bucket (property-tested, including
+  empty and partial snapshots); mismatched ladders refuse to merge;
+- one parent ``/metrics`` scrape renders le-bucketed
+  ``siddhi_tpu_*{worker="h{i}"}`` families from every live worker PLUS
+  fabric-level merged aggregates under ``worker="fabric"``;
+- staleness is honest: a dead worker's families age out of the
+  exposition (no zombie values rendered as live) and a re-adopted worker
+  resumes the SAME ``h{i}`` label;
+- a sampled trace through ``MeshConfig(mode='process')`` carries ONE
+  trace id across parent and child — parent ``dispatch`` span, child
+  ``procmesh_transit`` + ``ingress`` spans stitched back onto the same
+  journey — and a lost-ack ingest retry never duplicates spans (adoption
+  only on actual apply, behind the seq dedup).
+"""
+
+import random
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.mesh import MeshConfig, MeshFabric
+from siddhi_tpu.observability.histogram import LogHistogram
+from siddhi_tpu.observability.prometheus import collect_scraped, render
+
+APP = """
+@app:name('t{i}')
+define stream S (dev string, v double);
+@info(name='q{i}')
+from S[v > 1.0] select dev, v insert into Out;
+"""
+
+
+def _proc_cfg(**kw) -> MeshConfig:
+    kw.setdefault("mode", "process")
+    kw.setdefault("snapshot_every_chunks", 1)
+    kw.setdefault("heartbeat_interval_s", 0.2)
+    kw.setdefault("capacity_per_host", 4)
+    return MeshConfig(**kw)
+
+
+# -- tentpole 1: mergeable tracker snapshots -------------------------------
+
+def test_histogram_state_roundtrip_and_exact_merge():
+    """merge(snapshots of partitions) == histogram of the concatenation:
+    exact bucket counts and identical percentiles — the invariant the
+    whole federation plane rests on."""
+    rng = random.Random(0xFED)
+    for trial in range(20):
+        samples = [rng.lognormvariate(-6, 2.5) for _ in
+                   range(rng.randrange(1, 400))]
+        nparts = rng.randrange(1, 6)
+        parts = [[] for _ in range(nparts)]
+        for s in samples:
+            parts[rng.randrange(nparts)].append(s)
+
+        whole = LogHistogram()
+        for s in samples:
+            whole.record(s)
+        shards = []
+        for p in parts:
+            h = LogHistogram()
+            for s in p:
+                h.record(s)
+            shards.append(h)
+
+        merged = LogHistogram.merge([h.state() for h in shards])
+        m_buckets, m_count, m_sum = merged.export()
+        w_buckets, w_count, w_sum = whole.export()
+        assert m_buckets == w_buckets                   # exact buckets
+        assert m_count == w_count == merged.count == whole.count
+        # the sum is float-add order dependent across partitions: equal
+        # to within accumulation rounding, never in bucket placement
+        assert m_sum == pytest.approx(w_sum)
+        for q in (0.5, 0.9, 0.99):
+            assert merged.percentile(q) == whole.percentile(q)
+        snap_m, snap_w = merged.snapshot(), whole.snapshot()
+        for k in ("count", "p50", "p90", "p99", "min", "max"):
+            assert snap_m[k] == pytest.approx(snap_w[k])
+
+
+def test_histogram_merge_empty_and_partial_snapshots():
+    # empty iterable -> an empty histogram on the default ladder
+    empty = LogHistogram.merge([])
+    assert empty.count == 0
+    assert empty.snapshot()["p99"] == 0.0
+    # empty states fold in as no-ops
+    a, b = LogHistogram(), LogHistogram()
+    a.record(0.25)
+    merged = LogHistogram.merge([a.state(), b.state(), b.state()])
+    assert merged.export() == a.export()
+    # a partial state (counts trimmed past the last occupied bucket) is
+    # the WIRE format — merging it back must reproduce the full ladder
+    st = a.state()
+    assert len(st["counts"]) < 129          # trimmed, not the full ladder
+    assert LogHistogram.merge([st]).percentile(0.5) == a.percentile(0.5)
+
+
+def test_histogram_merge_rejects_ladder_mismatch():
+    a = LogHistogram()
+    a.record(1.0)
+    other = LogHistogram(min_value=1e-3)
+    with pytest.raises(ValueError):
+        other.merge_state(a.state())
+    bad = a.state()
+    bad["num_buckets"] = 7
+    with pytest.raises(ValueError):
+        LogHistogram.merge([a.state(), bad])
+
+
+# -- tentpole 2: federated exposition --------------------------------------
+
+def test_collect_scraped_renders_worker_families_and_merges_tenants():
+    """Scraped states render under a ``worker`` label with cumulative le
+    buckets; two tenants' states on the same family/labels MERGE (the
+    tenant prefix is stripped — per-tenant labels are unbounded)."""
+    h0, h1 = LogHistogram(), LogHistogram()
+    for v in (0.001, 0.002, 0.004):
+        h0.record(v)
+    h1.record(0.008)
+    families = {}
+    collect_scraped(
+        families, "mesh", "h0",
+        [("tA.phase.q0.procmesh_transit", h0.state()),
+         ("tB.phase.q0.procmesh_transit", h1.state())],
+        [("tA.app.gauge_errors", 2), ("tB.app.gauge_errors", 3)])
+    text = render([], collectors=(lambda fams: fams.update(families),))
+    assert ('siddhi_tpu_phase_latency_seconds_count{app="mesh",'
+            'phase="procmesh_transit",query="q0",worker="h0"} 4') in text
+    assert ('siddhi_tpu_gauge_errors_total{app="mesh",worker="h0"} 5'
+            in text)
+    # cumulative le buckets, monotone, terminated by +Inf == _count
+    buckets = [line for line in text.splitlines()
+               if line.startswith("siddhi_tpu_phase_latency_seconds_bucket")]
+    counts = [float(line.rsplit(" ", 1)[1]) for line in buckets]
+    assert counts == sorted(counts) and counts[-1] == 4.0
+    assert 'le="+Inf"' in buckets[-1]
+    assert 'tenant=' not in text and "tA" not in text
+
+
+def test_one_scrape_federates_every_worker_plus_fabric_merge(tmp_path):
+    fab = MeshFabric(2, str(tmp_path / "fed"),
+                     _proc_cfg(capacity_per_host=1, trace_sample=1))
+    try:
+        fab.add_tenants([APP.format(i=i) for i in range(2)])
+        for i in range(2):
+            fab.add_callback(f"t{i}", "Out", lambda evs: None)
+        rows = [[f"d{j}", float(j)] for j in range(8)]
+        ts = list(range(1, 9))
+        for i in range(2):
+            fab.send(f"t{i}", "S", rows, ts)
+        fab.flush()
+        fab.sync_children()
+        text = render([], collectors=(fab.collect_federated,))
+        for w in ("h0", "h1", "fabric"):
+            assert (f'siddhi_tpu_phase_latency_seconds_count{{app="mesh",'
+                    f'phase="procmesh_transit",query="S",worker="{w}"}}'
+                    in text), f"worker {w} missing from the federation"
+        # the fabric aggregate is the SUM of the per-worker counts
+        def count_of(w):
+            tag = (f'_count{{app="mesh",phase="procmesh_transit",'
+                   f'query="S",worker="{w}"}}')
+            line = next(line for line in text.splitlines() if tag in line)
+            return float(line.rsplit(" ", 1)[1])
+        assert count_of("fabric") == count_of("h0") + count_of("h1") > 0
+        # the JSON readout agrees with the exposition
+        fed = fab.federation()
+        assert not fed["workers"]["h0"]["stale"]
+        merged = fed["merged"]["procmesh_transit"]
+        assert merged["count"] == count_of("fabric")
+        assert merged["p50_ms"] <= merged["p99_ms"]
+        assert set(fed["clock_offsets_ns"]) == {"h0", "h1"}
+    finally:
+        fab.close()
+
+
+def test_dead_worker_families_age_out_and_readoption_resumes(tmp_path):
+    """Satellite 1 + acceptance: ``scrape_age_s`` grows while a worker is
+    down, its families leave the exposition past the staleness window (no
+    zombie values), and the respawned worker resumes the SAME ``h{i}``
+    series on its first good scrape."""
+    fab = MeshFabric(2, str(tmp_path / "stale"),
+                     _proc_cfg(capacity_per_host=1, trace_sample=1,
+                               metrics_stale_after_s=0.25))
+    try:
+        fab.add_tenants([APP.format(i=i) for i in range(2)])
+        for i in range(2):
+            fab.add_callback(f"t{i}", "Out", lambda evs: None)
+        rows, ts = [["a", 2.0], ["b", 3.0]], [1, 2]
+        for i in range(2):
+            fab.send(f"t{i}", "S", rows, ts)
+        fab.flush()
+        fab.sync_children()
+        assert fab.hosts[0].scrape_age_s() < 0.25
+        text = render([], collectors=(fab.collect_federated,))
+        assert 'worker="h0"' in text and 'worker="h1"' in text
+
+        # no fresh scrape -> the whole federation ages out together
+        time.sleep(0.35)
+        text = render([], collectors=(fab.collect_federated,))
+        assert 'worker="h0"' not in text and 'worker="fabric"' not in text
+        fab.sync_children()                     # fresh scrape -> back
+        assert 'worker="h0"' in render([], collectors=(fab.collect_federated,))
+
+        # real SIGKILL: the dead worker's scrape fails, its age keeps
+        # growing past the window, and the exposition drops h0 while the
+        # live neighbour h1 keeps rendering — no zombie families
+        fab.kill_host(0)
+        time.sleep(0.35)
+        fab.sync_children()                     # h0 scrape -> WorkerDown
+        age_down = fab.hosts[0].scrape_age_s()
+        assert age_down > 0.25
+        text = render([], collectors=(fab.collect_federated,))
+        if 'worker="h0"' in text:
+            # only legitimate if the supervisor already respawned AND
+            # rescraped h0 inside the sleep window
+            assert fab.hosts[0].scrape_age_s() < 0.25
+        assert 'worker="h1"' in text
+
+        # supervisor respawn + spill replay -> same label resumes
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            rep = fab.report()
+            if all(h["alive"] for h in rep["hosts"].values()) \
+                    and not rep["spill_backlog"]:
+                break
+            time.sleep(0.1)
+        fab.send("t0", "S", rows, ts)
+        fab.flush()
+        fab.sync_children()
+        assert fab.hosts[0].scrape_age_s() < 0.25
+        text = render([], collectors=(fab.collect_federated,))
+        assert ('phase="procmesh_transit",query="S",worker="h0"' in text)
+    finally:
+        fab.close()
+
+
+# -- tentpole 3: cross-process trace stitching ------------------------------
+
+def _journeys(fab):
+    """Parent-ring traces carrying BOTH the dispatch and the stitched
+    child transit span — one trace id spanning the process hop."""
+    out = []
+    for tr in list(fab.tracer.ring):
+        stages = {(s.stage, s.name.split(":")[0]) for s in tr.spans}
+        if ("procmesh", "dispatch") in stages \
+                and ("procmesh", "transit") in stages:
+            out.append(tr)
+    return out
+
+
+def test_sampled_trace_spans_parent_and_child_on_one_id(tmp_path):
+    fab = MeshFabric(1, str(tmp_path / "trace"),
+                     _proc_cfg(capacity_per_host=1, trace_sample=1))
+    try:
+        fab.add_tenants([APP.format(i=0)])
+        fab.add_callback("t0", "Out", lambda evs: None)
+        fab.send("t0", "S", [["a", 2.0], ["b", 3.0]], [1, 2])
+        fab.flush()
+        fab.sync_children()
+        js = _journeys(fab)
+        assert len(js) == 1
+        tr = js[0]
+        stages = [(s.stage, s.name) for s in tr.spans]
+        assert ("procmesh", "dispatch:h0") in stages
+        assert ("procmesh", "transit:w0") in stages
+        assert any(st == "ingress" for st, _ in stages)
+        # ONE journey: every span of the stitched trace shares its id, and
+        # the ring holds no sibling trace for the same ingest
+        assert sum(1 for t in fab.tracer.ring
+                   if t.trace_id == tr.trace_id) == 1
+        # re-shipping the tail is idempotent (span-identity dedup)
+        before = len(tr.spans)
+        fab.sync_children()
+        assert len(tr.spans) == before
+    finally:
+        fab.close()
+
+
+def test_lost_ack_retry_never_duplicates_spans(tmp_path):
+    """The K_ROWS discipline for traces: a retried ingest op carrying the
+    same seq (lost ack) dedups at the child and NEVER re-adopts — span
+    counts stay exactly-once even though the context header rode twice."""
+    fab = MeshFabric(1, str(tmp_path / "retry"),
+                     _proc_cfg(capacity_per_host=1, trace_sample=1))
+    try:
+        fab.add_tenants([APP.format(i=0)])
+        fab.add_callback("t0", "Out", lambda evs: None)
+        fab.send("t0", "S", [["a", 2.0]], [1])
+        fab.flush()
+        st = fab.tenants["t0"]
+        proxy = fab.hosts[st.host].runtimes["t0"]
+        tr = fab.tracer.maybe_trace("S")        # sample=1: always traced
+        ctx_hex = fab.tracer.context_of(tr).pack().hex()
+        rows, ts = [["c", 4.0]], [3]
+        first = proxy.send_chunk(st.seq + 1, "S", rows, ts, trace=ctx_hex)
+        retry = proxy.send_chunk(st.seq + 1, "S", rows, ts, trace=ctx_hex)
+        assert first is True and retry is False
+        fab.sync_children()
+        spans = [s for t in fab.tracer.ring if t.trace_id == tr.trace_id
+                 for s in t.spans]
+        assert sum(1 for s in spans if s.stage == "procmesh"
+                   and s.name.startswith("transit:")) == 1
+        assert sum(1 for s in spans if s.stage == "ingress") == 1
+        # and a third ship of the same tail stays idempotent
+        fab.sync_children()
+        spans2 = [s for t in fab.tracer.ring if t.trace_id == tr.trace_id
+                  for s in t.spans]
+        assert len(spans2) == len(spans)
+    finally:
+        fab.close()

@@ -106,6 +106,48 @@ def test_custom_reporter_receives_reports():
     assert calls and calls[0]["app"] == "x"
 
 
+def test_fleet_fallback_reason_counter_family():
+    """ISSUE 18 satellite: solo fallbacks surface as ONE counter family
+    ``siddhi_tpu_fleet_fallbacks_total{reason=...}`` with a BOUNDED
+    reason taxonomy (the free-text reasons embed exception text — label
+    cardinality poison), and tear down with the ``fleet.`` prefix."""
+    from siddhi_tpu.fleet.manager import FALLBACK_REASON_SLUGS
+    from siddhi_tpu.observability import render
+
+    m = SiddhiManager()
+    try:
+        rt = m.create_siddhi_app_runtime(
+            "@app(name='fbx')\n@app:fleet(batch='64')\n"
+            "define stream S (sym string, v double);\n"
+            "define stream T (sym string, w double);\n"
+            "@info(name='ok') from S[v > 1.0] select v insert into Out;\n"
+            "@info(name='j') from S join T on S.sym == T.sym "
+            "select S.sym, v, w insert into J;",       # joins keep solo
+            playback=True)
+        rt.start()
+        fm = m.context.fleet_manager
+        assert fm.fallback_counts["no_fleet_shape"] == 1
+        assert set(fm.fallback_counts) == set(FALLBACK_REASON_SLUGS)
+        assert fm.stats()["fallback_counts"]["no_fleet_shape"] == 1
+        sm = rt.ctx.statistics_manager
+        gauges = sm.snapshot_trackers()["gauges"]
+        assert gauges["fleet.fallbacks.no_fleet_shape"].value == 1
+        assert gauges["fleet.fallbacks.shape_does_not_lower"].value == 0
+        text = render([sm])
+        assert ('siddhi_tpu_fleet_fallbacks_total{app="fbx",'
+                'reason="no_fleet_shape"} 1') in text
+        # a COUNTER family (the _total contract), one line per slug only
+        assert "# TYPE siddhi_tpu_fleet_fallbacks_total counter" in text
+        assert text.count("siddhi_tpu_fleet_fallbacks_total{") == \
+            len(FALLBACK_REASON_SLUGS)
+        rt.shutdown()
+        snap = sm.snapshot_trackers()
+        assert not any(k.startswith("fleet.")
+                       for d in snap.values() for k in d)
+    finally:
+        m.shutdown()
+
+
 def test_guard_metric_families_unregister_on_shutdown():
     """PR 6 pinned the fleet.* / host_batch.* teardown contract; the guard
     families ride the same prefixes: fleet.tenant.* (ejections/readmit/
@@ -196,6 +238,13 @@ def test_guard_metric_families_unregister_on_shutdown():
         assert "procmesh.w0.alive" in gauges
         assert gauges["procmesh.w0.last_downtime_s"].value == 0.0
         assert gauges["procmesh.w0.restarts_total"].value == 0
+        # ISSUE 18: the federation plane's freshness + clock evidence ride
+        # the same teardown prefixes — scrape_age_s is the HONEST age of
+        # the cached child state (it grows while the child is down, never
+        # resets on a failed scrape), clock_offset_ns the worker's
+        # estimated wall-clock lead used for trace/timeline correction
+        assert gauges["mesh.h0.child.scrape_age_s"].value >= 0.0
+        assert "procmesh.w0.clock_offset_ns" in gauges
         assert gauges["procmesh.recovery.readopted_workers"].value == 0
         assert gauges["procmesh.recovery.restored_tenants"].value == 0
         assert gauges["procmesh.recovery.recover_s"].value == 0.0
