@@ -140,6 +140,11 @@ MESH_SCALE_TENANTS = int(os.environ.get("BENCH_MESH_SCALE_TENANTS", 2))
 MESH_FEED = int(os.environ.get("BENCH_MESH_FEED", 4000))
 MESH_CHUNK = int(os.environ.get("BENCH_MESH_CHUNK", 64))
 MESH_DEADLINE_S = int(os.environ.get("BENCH_MESH_DEADLINE_S", 900))
+# gray-failure gauntlet (ISSUE 19, the MULTICHIP_r10 line): feed length
+# for the wedged-worker phase — two kleene tenants on separate host
+# processes, one worker wedged mid-feed (alive, heartbeating, op-stalling)
+GRAY_FEED = int(os.environ.get("BENCH_GRAY_FEED", 2000))
+GRAY_DEADLINE_S = int(os.environ.get("BENCH_GRAY_DEADLINE_S", 600))
 HOST_DEADLINE_S = int(os.environ.get("BENCH_HOST_DEADLINE_S", 300))
 FLEET_DEADLINE_S = int(os.environ.get("BENCH_FLEET_DEADLINE_S", 300))
 SLO_DEADLINE_S = int(os.environ.get("BENCH_SLO_DEADLINE_S", 240))
@@ -2014,6 +2019,184 @@ def _procmesh_parent_recovery() -> dict:
     return res
 
 
+def child_gray() -> None:
+    """Gray-failure gauntlet (ISSUE 19, the MULTICHIP_r10 line): a LIVE
+    worker that keeps answering heartbeats while every substantive op
+    stalls — the failure mode liveness probes cannot see. The latency-
+    evidence ladder must classify it *wedged* within a detection budget,
+    kill/respawn it, and replay its spill exactly-once, all while the
+    innocent tenant on the other host process keeps its throughput.
+    Plus a hedge micro-phase: one partitioned reply on a hedge-safe op
+    is won by the deadline-budgeted second attempt over a fresh
+    connection."""
+    import tempfile
+    import threading as _th
+
+    from siddhi_tpu import SiddhiManager, StreamCallback
+    from siddhi_tpu.mesh import MeshConfig, MeshFabric
+    from siddhi_tpu.procmesh.protocol import WireChaos, install_wire_chaos
+
+    fleet_ann = f"@app:fleet(batch='{FLEET_BATCH}', lanes='{HOST_LANES}')\n"
+    out = {"hosts": 2, "mode": "process", "feed": GRAY_FEED}
+
+    feed = gen_events(GRAY_FEED)
+    rows = [[dev, v] for dev, v, _ in feed]
+    tss = [ts for _, _, ts in feed]
+    chunks = [(rows[s:s + MESH_CHUNK], tss[s:s + MESH_CHUNK])
+              for s in range(0, GRAY_FEED, MESH_CHUNK)]
+    third = max(1, len(chunks) // 3)
+
+    # -- 1) wedged-worker ladder -------------------------------------------
+    # capacity 1 pins the two tenants onto SEPARATE host processes: the
+    # innocent tenant's throughput during the wedge window is then a real
+    # blast-radius measurement, not a shared-worker artifact
+    fab = MeshFabric(2, tempfile.mkdtemp(prefix="pmesh-gray-"),
+                     MeshConfig(capacity_per_host=1, mode="process",
+                                snapshot_every_chunks=1,
+                                heartbeat_interval_s=0.1,
+                                io_timeout_s=1.0, wedge_threshold=2,
+                                degrade_factor=0.0,  # isolate the wedge rung
+                                restart_base_s=0.05))
+    fab.add_tenants([_mesh_kleene_app(i, fleet_ann) for i in range(2)])
+    gcounts = {i: [] for i in range(2)}
+    for i in range(2):
+        fab.add_callback(f"kleene-{i}", "Alerts",
+                         lambda evs, i=i: gcounts[i].extend(
+                             tuple(e.data) for e in evs))
+    victim = fab.tenants["kleene-0"].host
+
+    def feed_slice(tid, sl, wall):
+        t0 = time.perf_counter()
+        for c, t in sl:
+            fab.send(tid, "S", c, t)
+        wall[tid] = time.perf_counter() - t0
+
+    # calm first third to both tenants
+    for c, t in chunks[:third]:
+        for i in range(2):
+            fab.send(f"kleene-{i}", "S", c, t)
+    # wedge the victim's worker: pings keep answering (the stall sits in
+    # front of the dispatch lock for substantive ops only), so breaker/
+    # heartbeat monitoring alone would call this host healthy forever
+    fab.hosts[victim].client.call("wedge", {"stall_s": 60})
+    t_wedge = time.time()
+    t_wedge_mono = time.perf_counter()
+    # middle third from one thread per tenant: the victim's timing-out
+    # sends must not serialize in front of the innocent's
+    walls = {}
+    ths = [_th.Thread(target=feed_slice,
+                      args=(f"kleene-{i}", chunks[third:2 * third], walls))
+           for i in range(2)]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join()
+    in_wall = walls["kleene-1"]
+    innocent_evps = round(third * MESH_CHUNK / in_wall) if in_wall else 0
+    # wait for the FULL ladder: classified -> killed -> respawned
+    # (restarts advances) -> tenant recovered onto the fresh child
+    h = fab.supervisor.handles[victim]
+    heal_s = None
+    deadline = time.time() + 60.0
+    while time.time() < deadline:
+        if h.health.wedge_count >= 1 and h.restarts >= 1 \
+                and fab.hosts[victim].alive \
+                and "kleene-0" in fab.hosts[victim].runtimes:
+            heal_s = time.perf_counter() - t_wedge_mono
+            break
+        time.sleep(0.05)
+    # detection time from the flight ring: injection wall-clock to the
+    # decision:worker_wedged stamp (record-before-actuate, so this is the
+    # moment the ladder classified, not the kill)
+    detection_s = None
+    wedge_detail = {}
+    for e in fab.supervisor.flight.export(category="procmesh"):
+        if e["kind"] == "decision:worker_wedged":
+            detection_s = max(0.0, e["t"] - t_wedge)
+            wedge_detail = e.get("detail") or {}
+            break
+    # final third to both, then drain and check exactly-once parity
+    for c, t in chunks[2 * third:]:
+        for i in range(2):
+            fab.send(f"kleene-{i}", "S", c, t)
+    fab.flush()
+    rep = fab.report()
+    wrk = rep["supervisor"]["workers"][victim]
+    gray_counts = {i: list(gcounts[i]) for i in range(2)}
+    fab.close()
+    oracle_ok = True
+    m = SiddhiManager()
+    for i in range(2):
+        rt = m.create_siddhi_app_runtime(
+            _mesh_kleene_app(i, ""), playback=True)
+        solo = []
+        rt.add_callback("Alerts", StreamCallback(
+            lambda evs, solo=solo: solo.extend(
+                tuple(e.data) for e in evs)))
+        rt.start()
+        ih = rt.input_handler("S")
+        for c, t in chunks:
+            ih.send_rows([list(r) for r in c], list(t))
+        if solo != gray_counts[i]:
+            oracle_ok = False
+    m.shutdown()
+    out["wedge"] = {
+        "tenants": 2,
+        "detection_s": round(detection_s, 3)
+        if detection_s is not None else None,
+        "heal_s": round(heal_s, 2) if heal_s is not None else None,
+        "wedge_count": wrk.get("wedge_count"),
+        "restarts": wrk["restarts"],
+        "op_p99_at_detection_s": wedge_detail.get("op_p99_s"),
+        "heartbeat_p99_at_detection_s": wedge_detail.get("heartbeat_p99_s"),
+        "replayed_chunks": rep["replayed_chunks"],
+        "dup_chunks": rep["dup_chunks"],
+        "oracle_ok": oracle_ok,
+        "innocent_evps_during_wedge": innocent_evps,
+    }
+    print(f"# gray wedge: detect={out['wedge']['detection_s']}s "
+          f"heal={out['wedge']['heal_s']}s "
+          f"restarts={out['wedge']['restarts']} "
+          f"dup={rep['dup_chunks']} oracle_ok={oracle_ok} "
+          f"innocent={innocent_evps:,} ev/s during wedge",
+          file=sys.stderr)
+
+    # -- 2) hedged retry over a partitioned reply --------------------------
+    # deterministic wire chaos drops exactly ONE worker->parent reply on a
+    # hedge-safe op: the client burns the hedge fraction of the budget,
+    # drops the desynced connection, and the fresh-connection second
+    # attempt wins — seq-dedup keeps it exactly-once
+    fab = MeshFabric(1, tempfile.mkdtemp(prefix="pmesh-hedge-"),
+                     MeshConfig(capacity_per_host=4, mode="process",
+                                heartbeat_interval_s=0.2,
+                                io_timeout_s=4.0))
+    chaos = WireChaos(seed=3, drop_recv_p=1.0, ops={"metrics"},
+                      fault_budget=1)
+    prev = install_wire_chaos(chaos)
+    t0 = time.perf_counter()
+    try:
+        client = fab.hosts[0].client
+        rh, _ = client.call("metrics")
+        hedge_wall = time.perf_counter() - t0
+        out["hedge"] = {
+            "op": "metrics",
+            "hedge_attempts": client.hedge_attempts,
+            "hedge_wins": client.hedge_wins,
+            "dropped_recv": chaos.counters["dropped_recv"],
+            "hedged_op_wall_s": round(hedge_wall, 3),
+            "ok": bool(rh.get("gauges") is not None
+                       and client.hedge_wins >= 1),
+        }
+    finally:
+        install_wire_chaos(prev)
+        fab.close()
+    print(f"# gray hedge: attempts={out['hedge']['hedge_attempts']} "
+          f"wins={out['hedge']['hedge_wins']} "
+          f"wall={out['hedge']['hedged_op_wall_s']}s",
+          file=sys.stderr)
+    print(json.dumps(out))
+
+
 # ---------------------------------------------------------------------------
 # parent: orchestration (no jax import — immune to backend-init hangs)
 # ---------------------------------------------------------------------------
@@ -2418,5 +2601,7 @@ if __name__ == "__main__":
         child_mesh()
     elif len(sys.argv) > 1 and sys.argv[1] == "--procmesh-child":
         child_procmesh()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--gray-child":
+        child_gray()
     else:
         main()

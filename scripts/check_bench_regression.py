@@ -40,6 +40,15 @@ one actual respawn, kill→respawn→spill-drained recovery under the stored
 ceiling, and the (core-limited) per-host-process scaling efficiency above
 its floor.
 
+A ``gray`` guard (``run_gray_guard``) runs a fresh ``bench.py
+--gray-child`` (reduced feed, 2 host processes, one wedged mid-feed) and
+pins the gray-failure ladder vs BASELINE.json ``gray_baseline``: the
+heartbeat-green op-stalling worker classified WEDGED within the stored
+detection ceiling and actually healed (respawn + tenant recovery), the
+spill replay exactly-once (zero dups, victim AND innocent byte-identical
+to solo oracles), and the hedged second attempt winning a
+deterministically partitioned reply on a hedge-safe op.
+
 A ``device_latency`` guard (``run_device_latency_guard``) additionally pins
 the double-buffered pipeline's recorded evidence: when a bench report with a
 ``latency_mode`` line exists, its p99 must stay under
@@ -637,6 +646,124 @@ def run_procmesh_guard(tol: float, deadline_s: int = 600) -> int:
     return 1 if failures else 0
 
 
+def run_gray_guard(tol: float, deadline_s: int = 600) -> int:
+    """Gray-failure line vs BASELINE.json ``gray_baseline`` (ISSUE 19): a
+    fresh ``bench.py --gray-child`` (reduced feed, 2 host PROCESSES, one
+    wedged mid-feed) must keep
+
+    1. the wedged worker — alive, heartbeating, every substantive op
+       stalling — DETECTED (``decision:worker_wedged`` on the flight
+       ring) within the stored detection ceiling scaled by 1/tol, and
+       actually healed (>= 1 respawn, tenant recovered);
+    2. the spill replay exactly-once: zero dup chunks and BOTH tenants
+       byte-identical to their solo oracles (binary, no band) — the
+       innocent neighbour on the other host process included;
+    3. the hedge path live: one deterministically partitioned reply on a
+       hedge-safe op won by the fresh-connection second attempt
+       (``hedge_wins`` >= stored floor — binary plumbing, not a latency
+       band: the chaos partition raises immediately, so wall time says
+       nothing)."""
+    with open(os.path.join(REPO, "BASELINE.json")) as f:
+        baseline = json.load(f).get("gray_baseline") or {}
+    if not baseline:
+        print(json.dumps({
+            "gray_guard": "skipped",
+            "reason": "no gray_baseline in BASELINE.json"}))
+        return 0
+    det_ceiling = float(baseline.get("detection_ceiling_s", 5.0)) \
+        / max(tol, 1e-9)
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "BENCH_GRAY_FEED":
+            os.environ.get("BENCH_GUARD_GRAY_FEED", "640"),
+    }
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--gray-child"],
+            capture_output=True, text=True, timeout=deadline_s, env=env,
+            cwd=REPO)
+    except subprocess.TimeoutExpired:
+        print(f"GUARD: gray bench exceeded {deadline_s}s",
+              file=sys.stderr)
+        return 2
+    if p.returncode != 0:
+        tail = (p.stderr or "").strip().splitlines()[-6:]
+        print("GUARD: gray bench failed: " + " | ".join(tail),
+              file=sys.stderr)
+        return 2
+    data = None
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            data = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    if data is None:
+        print("GUARD: no JSON in gray bench output", file=sys.stderr)
+        return 2
+
+    failures = []
+    wedge = data.get("wedge") or {}
+    detection_s = wedge.get("detection_s")
+    if detection_s is None:
+        failures.append(
+            "wedged worker never classified — no decision:worker_wedged "
+            "on the flight ring (latency-evidence ladder unwired?)")
+    elif detection_s > det_ceiling:
+        failures.append(
+            f"wedge detection took {detection_s:.2f}s, over the ceiling "
+            f"{det_ceiling:.2f}s (stored "
+            f"{baseline.get('detection_ceiling_s')}s / {tol})")
+    if not wedge.get("restarts"):
+        failures.append(
+            "wedged worker never respawned — classified but the "
+            "down-ladder actuation (kill -> respawn) did not follow")
+    if wedge.get("heal_s") is None:
+        failures.append(
+            "fleet never healed after the wedge (respawn + tenant "
+            "recovery incomplete at the child's deadline)")
+    if wedge.get("dup_chunks"):
+        failures.append(
+            f"wedge spill replay duplicated {wedge.get('dup_chunks')} "
+            f"chunk(s) through the child-side seq dedup")
+    if not wedge.get("oracle_ok"):
+        failures.append(
+            "wedge cycle broke exactly-once (victim or innocent tenant "
+            "diverged from its solo oracle)")
+    hedge = data.get("hedge") or {}
+    wins_floor = int(baseline.get("hedge_wins_min", 1))
+    if (hedge.get("hedge_wins") or 0) < wins_floor:
+        failures.append(
+            f"hedged retry won {hedge.get('hedge_wins')} time(s), below "
+            f"the stored floor {wins_floor} — the partitioned-reply "
+            f"second attempt is unwired or lost its budget")
+
+    print(json.dumps({
+        "hosts": data.get("hosts"),
+        "detection_s": detection_s,
+        "detection_ceiling_s": det_ceiling,
+        "heal_s": wedge.get("heal_s"),
+        "restarts": wedge.get("restarts"),
+        "wedge_count": wedge.get("wedge_count"),
+        "replayed_chunks": wedge.get("replayed_chunks"),
+        "dup_chunks": wedge.get("dup_chunks"),
+        "oracle_ok": wedge.get("oracle_ok"),
+        "innocent_evps_during_wedge":
+            wedge.get("innocent_evps_during_wedge"),
+        "hedge_attempts": hedge.get("hedge_attempts"),
+        "hedge_wins": hedge.get("hedge_wins"),
+        "hedge_wins_floor": wins_floor,
+        "ok": not failures,
+    }))
+    for f_ in failures:
+        print(f"GUARD REGRESSION (gray): {f_}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def _latest_device_report():
     """The report the device_latency guard judges: the file named by
     ``BENCH_GUARD_DEVICE_REPORT``, else the highest-numbered BENCH_r*.json
@@ -823,11 +950,12 @@ def main() -> int:
         return rc or drc or erc
     frc = run_fleet_guard(tol)
     src = run_slo_guard(tol)
-    mrc = prc = 0
+    mrc = prc = grc = 0
     if os.environ.get("BENCH_GUARD_SKIP_MESH", "") != "1":
         mrc = run_mesh_guard(tol)
         prc = run_procmesh_guard(tol)
-    return rc or frc or src or drc or erc or mrc or prc
+        grc = run_gray_guard(tol)
+    return rc or frc or src or drc or erc or mrc or prc or grc
 
 
 if __name__ == "__main__":

@@ -54,6 +54,20 @@ tier and asserts the resilience wrap is actually installed:
    importable — tests/test_parent_recovery.py also feeds it a synthetic
    offender to prove the check can fail).
 
+8. **gray-failure ladder discipline (ISSUE 19)** — the latency-evidence
+   rungs follow the same record-before-actuate law: ``_on_wedged`` puts
+   ``decision:worker_wedged`` (with the op-latency tails that earned it)
+   on the ring before marking the peer wedged and before the kill;
+   ``_evaluate_degrade`` records ``decision:worker_degraded`` before
+   ``mark_degraded``; ``MeshFabric.drain_host`` records
+   ``decision:drain_host`` before flipping the placement fence and
+   before any migration. And the hedge allowlist is STRUCTURAL: only
+   ``HEDGE_SAFE_OPS`` (wire-idempotent ops) may receive a shortened
+   first deadline — ``WorkerClient.call`` gates on set membership, and
+   the set is disjoint from every lifecycle op, so hedging a
+   ``deploy``/``restore``/``migrate`` is unrepresentable, not merely
+   untested.
+
 Run from tier-1 (tests/test_fleet_guard.py); exits non-zero on any gap.
 """
 
@@ -309,6 +323,44 @@ def main() -> int:
         check("every durable-fabric mutation journals before actuating",
               not problems, f"({problems})")
 
+        # 8) gray-failure ladder discipline (ISSUE 19)
+        wsrc = inspect.getsource(sup_mod.ProcMeshSupervisor._on_wedged)
+        rec_at = wsrc.find('"decision:worker_wedged"')
+        mark_at = wsrc.find("h.health.mark_wedged()")
+        kill_at = wsrc.find("self._on_death(")
+        check("supervisor._on_wedged records before marking wedged",
+              0 <= rec_at < mark_at,
+              f"(record at {rec_at}, mark at {mark_at})")
+        check("supervisor._on_wedged marks wedged before the kill",
+              0 <= mark_at < kill_at,
+              f"(mark at {mark_at}, kill at {kill_at})")
+        gsrc = inspect.getsource(sup_mod.ProcMeshSupervisor._evaluate_degrade)
+        rec_at = gsrc.find('"decision:worker_degraded"')
+        mark_at = gsrc.find("h.health.mark_degraded()")
+        check("supervisor degrade rung records before marking degraded",
+              0 <= rec_at < mark_at,
+              f"(record at {rec_at}, mark at {mark_at})")
+        dsrc2 = inspect.getsource(fab_mod.MeshFabric.drain_host)
+        rec_at = dsrc2.find('"decision:drain_host"')
+        fence_at = dsrc2.find("h.draining = True")
+        mig_at = dsrc2.find("self.migrate(")
+        check("MeshFabric.drain_host records before the placement fence",
+              0 <= rec_at < fence_at,
+              f"(record at {rec_at}, fence at {fence_at})")
+        check("MeshFabric.drain_host fences before migrating tenants",
+              0 <= fence_at < mig_at,
+              f"(fence at {fence_at}, migrate at {mig_at})")
+        from siddhi_tpu.procmesh import host as pmh_mod
+        lifecycle = {"deploy", "undeploy", "restore", "subscribe",
+                     "migrate", "boot_dcn", "drain", "stop", "wedge"}
+        check("hedge allowlist is disjoint from every lifecycle op",
+              pmh_mod.HEDGE_SAFE_OPS.isdisjoint(lifecycle),
+              f"(overlap: {sorted(pmh_mod.HEDGE_SAFE_OPS & lifecycle)})")
+        csrc = inspect.getsource(pmh_mod.WorkerClient.call)
+        check("WorkerClient.call gates the shortened deadline on the "
+              "allowlist", "in HEDGE_SAFE_OPS" in csrc,
+              "(no structural membership gate in call())")
+
         # live: a synthetic rebalancer actuation must land on the fabric
         # ring BEFORE the migration's own entries (ring order = append
         # order), and the tenant must actually move
@@ -347,7 +399,8 @@ def main() -> int:
         return 1
     print("\nguard coverage OK: fleet group step, device dispatch/collect, "
           "host_batch step, slo decision paths, mesh decision paths, "
-          "procmesh supervisor decision paths, durable journal intent")
+          "procmesh supervisor decision paths, durable journal intent, "
+          "gray-failure ladder + hedge allowlist")
     return 0
 
 

@@ -111,7 +111,15 @@ class MeshConfig:
                  journal_checkpoint_every: int = 256,
                  trace_sample: Optional[int] = None,
                  trace_ring: int = 2048,
-                 metrics_stale_after_s: float = 10.0):
+                 metrics_stale_after_s: float = 10.0,
+                 io_timeout_s: Optional[float] = None,
+                 connect_timeout_s: Optional[float] = None,
+                 hedge_fraction: float = 0.45,
+                 wedge_threshold: int = 3,
+                 degrade_factor: float = 4.0,
+                 degrade_floor_s: float = 0.05,
+                 degrade_min_samples: int = 16,
+                 drain_on_degrade: bool = True):
         if mode not in ("inproc", "process"):
             raise ValueError(f"mesh mode '{mode}' is not inproc|process")
         if durable and mode != "process":
@@ -158,6 +166,24 @@ class MeshConfig:
         # federation freshness ceiling: a worker whose last good scrape is
         # older than this renders NO federated families (zombie expiry)
         self.metrics_stale_after_s = float(metrics_stale_after_s)
+        # gray-failure surface (process mode): control-socket deadline base
+        # (None = protocol default / SIDDHI_PROCMESH_IO_TIMEOUT_S env),
+        # hedged-retry trigger fraction for idempotent ops, and the
+        # latency-evidence ladder — N consecutive op timeouts while
+        # heartbeats stay green = wedged (treated as down), a windowed op
+        # p99 above degrade_factor x the fleet-median p99 (floored at
+        # degrade_floor_s) = degraded, which drains the host's tenants
+        # away when drain_on_degrade is set
+        self.io_timeout_s = (float(io_timeout_s)
+                             if io_timeout_s is not None else None)
+        self.connect_timeout_s = (float(connect_timeout_s)
+                                  if connect_timeout_s is not None else None)
+        self.hedge_fraction = float(hedge_fraction)
+        self.wedge_threshold = int(wedge_threshold)
+        self.degrade_factor = float(degrade_factor)
+        self.degrade_floor_s = float(degrade_floor_s)
+        self.degrade_min_samples = int(degrade_min_samples)
+        self.drain_on_degrade = bool(drain_on_degrade)
 
 
 class MeshHost:
@@ -178,6 +204,7 @@ class MeshHost:
         # admission is check-then-deploy; the reservation closes the race
         # between concurrent movers targeting the same destination)
         self.alive = True
+        self.draining = False           # degrade drain: no NEW placements
 
     @property
     def free_slots(self) -> int:
@@ -323,13 +350,22 @@ class MeshFabric:
                     auto_restart=self.cfg.auto_restart,
                     env=self.cfg.worker_env,
                     run_dir=(os.path.join(store_root, "run")
-                             if self.cfg.durable else None)),
+                             if self.cfg.durable else None),
+                    io_timeout_s=self.cfg.io_timeout_s,
+                    connect_timeout_s=self.cfg.connect_timeout_s,
+                    hedge_fraction=self.cfg.hedge_fraction,
+                    wedge_threshold=self.cfg.wedge_threshold,
+                    degrade_factor=self.cfg.degrade_factor,
+                    degrade_floor_s=self.cfg.degrade_floor_s,
+                    degrade_min_samples=self.cfg.degrade_min_samples),
                 flight=self.flight, playback=self.cfg.playback,
                 journal=self.journal,
                 worker_state=(jstate or {}).get("workers"))
             self.supervisor.on_failed = self.host_failed
             self.supervisor.on_restarted = self.host_restarted
             self.supervisor.on_escalation = self._slo_escalate
+            self.supervisor.on_degraded = self.host_degraded
+            self.supervisor.on_undegraded = self.host_undegraded
             self.hosts: dict = {
                 i: self.supervisor.host(
                     i, self.cfg.capacity_per_host,
@@ -351,6 +387,7 @@ class MeshFabric:
         self.migrations = 0
         self.migration_failures = 0
         self.recoveries = 0
+        self.drains = 0                 # degrade-triggered host drains
         self.spilled_chunks = 0
         self.shed_chunks = 0            # spill overflow the policy DROPPED
         self.replayed_chunks = 0
@@ -544,7 +581,8 @@ class MeshFabric:
     def _least_loaded_host(self, exclude: Optional[int] = None
                            ) -> Optional[int]:
         cands = [h for h in self.hosts.values()
-                 if h.alive and h.index != exclude and h.free_slots > 0]
+                 if h.alive and h.index != exclude and h.free_slots > 0
+                 and not getattr(h, "draining", False)]
         if not cands:
             return None
         # occupancy first (cumulative rows_in would bias against any host
@@ -913,6 +951,70 @@ class MeshFabric:
                 h.kill()
             return orphans
 
+    def host_degraded(self, index: int) -> None:
+        """Supervisor degrade callback (latency-evidence ladder): the
+        worker answers, but its windowed op p99 is a fleet-relative
+        outlier. Proactive containment, not execution: mark the host
+        draining (no NEW placements land on it) and migrate its tenants
+        away. Runs the moves on a background thread — the monitor sweep
+        that classified the outlier must never block on a migration
+        (the ``_slo_escalate`` discipline)."""
+        if not self.cfg.drain_on_degrade:
+            return
+        threading.Thread(target=self.drain_host, args=(index,),
+                         kwargs={"reason": "degraded"}, daemon=True).start()
+
+    def host_undegraded(self, index: int) -> None:
+        """Degrade recovery (hysteresis rung): the host takes NEW
+        placements again. Tenants already moved off stay where they
+        are — re-spreading is the rebalancer's call, not the ladder's."""
+        with self._lock:
+            h = self.hosts.get(index)
+            if h is None or not getattr(h, "draining", False):
+                return
+            h.draining = False
+            self.flight.record("mesh", "host_undrained",
+                               site=f"host:{index}")
+
+    def drain_host(self, index: int, reason: str = "operator") -> int:
+        """Drain actuator: record the decision, fence the host from new
+        placements, then migrate every tenant it owns to the least-loaded
+        non-draining peer. EVIDENCE FIRST — the ``decision:drain_host``
+        entry is on the ring BEFORE ``draining`` flips and before any
+        tenant moves (the ``mesh_replace`` record-before-actuate
+        discipline). Returns the number of tenants moved."""
+        with self._lock:
+            h = self.hosts.get(index)
+            if h is None or not h.alive:
+                return 0
+            tenants = sorted(h.runtimes)
+            self.flight.record("mesh", "decision:drain_host",
+                               site=f"host:{index}",
+                               detail={"reason": reason,
+                                       "tenants": tenants})
+            h.draining = True
+            self.drains += 1
+        moved = 0
+        for tid in tenants:
+            st = self.tenants.get(tid)
+            if st is None or st.host != index:
+                continue
+            dst = self._least_loaded_host(exclude=index)
+            if dst is None:
+                # nowhere to put it — the tenant stays; the fence still
+                # keeps NEW work off the sick host, which is the point
+                log.warning("mesh: drain of host %d has no destination "
+                            "for '%s'", index, tid)
+                continue
+            try:
+                self.migrate(tid, dst, reason=f"drain:{reason}")
+                moved += 1
+            except Exception:   # noqa: BLE001 — best-effort drain; the
+                # tenant stays on the draining host, still served
+                log.exception("mesh: drain migration of '%s' off host %d "
+                              "failed", tid, index)
+        return moved
+
     def host_restarted(self, index: int) -> int:
         """Supervisor restart callback: the respawned worker is ALIVE and
         EMPTY — replay the fabric's own recovery ladder
@@ -924,6 +1026,9 @@ class MeshFabric:
             if h is None:
                 return 0
             h.alive = True
+            # a fresh incarnation starts clean: whatever latency evidence
+            # condemned the old process died with it
+            h.draining = False
             self.flight.record("mesh", "host_restarted",
                                site=f"host:{index}")
             if self._sm is not None and hasattr(h, "register_child_metrics"):
@@ -1553,6 +1658,10 @@ class MeshFabric:
                 "migrations": self.migrations,
                 "migration_failures": self.migration_failures,
                 "recoveries": self.recoveries,
+                "drains": self.drains,
+                "draining_hosts": sorted(
+                    h.index for h in self.hosts.values()
+                    if getattr(h, "draining", False)),
                 "plan_recomputes": self.plan_recomputes,
                 "spilled_chunks": self.spilled_chunks,
                 "shed_chunks": self.shed_chunks,
@@ -1590,6 +1699,11 @@ class MeshFabric:
                          lambda: self.migration_failures)
         sm.gauge_tracker("mesh.self.recoveries_total",
                          lambda: self.recoveries)
+        sm.gauge_tracker("mesh.self.drains_total",
+                         lambda: self.drains)
+        sm.gauge_tracker("mesh.self.draining_hosts",
+                         lambda: sum(1 for h in self.hosts.values()
+                                     if getattr(h, "draining", False)))
         sm.gauge_tracker("mesh.self.spilled_chunks_total",
                          lambda: self.spilled_chunks)
         sm.gauge_tracker("mesh.self.shed_chunks_total",

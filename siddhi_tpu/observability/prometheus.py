@@ -106,6 +106,22 @@ _LATENCY_FAMILIES = {
 }
 
 
+def _latency_family(scope: str, labels: dict, field: Optional[str],
+                    key: str) -> str:
+    """Family name for one latency tracker key. Labeled scopes
+    (``_SCOPE_LABEL``: mesh/slo/procmesh) fold the per-instance segment
+    into a LABEL, so ``procmesh.w0.heartbeat`` renders ONE
+    ``siddhi_tpu_procmesh_heartbeat_seconds{worker="w0"}`` family instead
+    of a per-worker name (unbounded-family lint discipline). Everything
+    else keeps the fixed-family table / sanitized-key fallback."""
+    name = _LATENCY_FAMILIES.get(scope)
+    if name is not None:
+        return name
+    if scope in _SCOPE_LABEL and labels and field:
+        return _metric_name(scope, field, "_seconds")
+    return f"siddhi_tpu_{_sanitize(key)}_latency_seconds"
+
+
 def _escape(value) -> str:
     return str(value).replace("\\", "\\\\").replace("\n", "\\n") \
                      .replace('"', '\\"')
@@ -201,8 +217,7 @@ def _collect(sm, families: dict, with_exemplars: bool = False) -> None:
 
     for key, tracker in snap["latency"].items():
         scope, labels, field = _split_key(key)
-        name = _LATENCY_FAMILIES.get(
-            scope, f"siddhi_tpu_{_sanitize(key)}_latency_seconds")
+        name = _latency_family(scope, labels, field, key)
         f = fam(name, "histogram", f"{scope} latency distribution (seconds)")
         buckets, count, total = tracker.hist.export()   # one atomic read
         # OpenMetrics exemplars: a tail bucket links to the concrete trace
@@ -246,9 +261,8 @@ def collect_scraped(families: dict, app: str, worker: str,
     merged: dict = {}               # (name, label_items) -> LogHistogram
     for key, state in latency_items:
         rest = key.split(".", 1)[-1]            # strip the tenant prefix
-        scope, labels, _ = _split_key(rest)
-        name = _LATENCY_FAMILIES.get(
-            scope, f"siddhi_tpu_{_sanitize(rest)}_latency_seconds")
+        scope, labels, field = _split_key(rest)
+        name = _latency_family(scope, labels, field, rest)
         ident = (name, tuple(sorted({**base, **labels}.items())))
         hist = merged.get(ident)
         try:

@@ -17,17 +17,41 @@ the restored pre-chunk state and every output is delivered exactly once
 
 from __future__ import annotations
 
+import re
+import socket
 import threading
 import time
 from typing import Callable, Optional
 
 from .protocol import (
-    CONNECT_TIMEOUT_S,
-    IO_TIMEOUT_S,
     WorkerDown,
     connect,
+    connect_timeout_s,
+    io_timeout_s,
+    op_deadline_s,
     request,
 )
+
+# The hedge allowlist (ISSUE 19): ONLY ops that are idempotent BY WIRE
+# CONTRACT may race a second attempt — ingest dedups by seq, resync/flush
+# reconcile by cursor, snapshot/metrics/evidence/flight/ping are reads.
+# deploy/undeploy/restore/subscribe and every lifecycle op stay out: their
+# idempotence is by-tenant convention, not by sequence number, and a
+# hedged lifecycle op racing a migration would be a correctness bug.
+# scripts/check_guard_coverage.py pins this set structurally.
+HEDGE_SAFE_OPS = frozenset({
+    "ingest", "snapshot", "metrics", "evidence", "ping", "resync", "flight",
+})
+
+_SLO_CLASS_RE = re.compile(r"slo\.class\s*=\s*'([A-Za-z]+)'")
+
+
+def slo_class_of(app_text: Optional[str]) -> Optional[str]:
+    """The tenant's SLO class from its ``@app:fleet(... slo.class='…')``
+    annotation (None → standard budgets). A regex, not a parse: deadline
+    derivation must not cost a grammar pass per deploy."""
+    m = _SLO_CLASS_RE.search(app_text or "")
+    return m.group(1) if m else None
 
 
 def _soa_types(rows: list) -> Optional[str]:
@@ -70,21 +94,45 @@ class WorkerClient:
     socket reconnects ONCE per op — every procmesh op is idempotent
     (deploys dedup by tenant, ingests dedup by seq, restores re-restore
     the same revision), so the retry is the lost-ack recovery path, not a
-    double-apply risk."""
+    double-apply risk.
+
+    Deadline-budgeted hedging (ISSUE 19): an op in :data:`HEDGE_SAFE_OPS`
+    spends only ``hedge_fraction`` of its budget on the first attempt —
+    once that elapses, the (possibly desynced) connection is dropped and
+    a SECOND attempt goes out over a fresh connection with the remaining
+    budget. Exactly-once is pinned by the ops' own dedup (seq for ingest,
+    read-only for the rest); ops outside the allowlist structurally never
+    get a shortened first deadline. ``observer(op, seconds, ok)`` fires
+    once per user-level call with the final outcome — the supervisor's
+    per-op latency evidence."""
 
     def __init__(self, port_fn: Callable[[], Optional[int]],
-                 io_timeout_s: float = IO_TIMEOUT_S):
+                 io_timeout_s: Optional[float] = None,
+                 connect_timeout_s: Optional[float] = None,
+                 hedge_fraction: Optional[float] = 0.45,
+                 observer: Optional[Callable[[str, float, bool],
+                                             None]] = None):
         self._port_fn = port_fn
-        self._io_timeout_s = io_timeout_s
+        self._io_timeout_s = io_timeout_s       # None → env/module default
+        self._connect_timeout_s = connect_timeout_s
+        self.hedge_fraction = hedge_fraction    # None disables hedging
+        self.observer = observer
+        self.hedge_attempts = 0
+        self.hedge_wins = 0
         self._sock = None
         self._lock = threading.Lock()
+
+    def base_timeout_s(self) -> float:
+        """The resolved base IO deadline (config > env > default)."""
+        return io_timeout_s(self._io_timeout_s)
 
     def _socket(self):
         if self._sock is None:
             port = self._port_fn()
             if port is None:
                 raise WorkerDown("worker has no live control port")
-            self._sock = connect(port, timeout=CONNECT_TIMEOUT_S)
+            self._sock = connect(port, timeout=connect_timeout_s(
+                self._connect_timeout_s), io_timeout=self.base_timeout_s())
         return self._sock
 
     def drop(self) -> None:
@@ -101,21 +149,47 @@ class WorkerClient:
 
     def call(self, op: str, header: Optional[dict] = None,
              body: bytes = b"", timeout: Optional[float] = None):
-        timeout = timeout or self._io_timeout_s
-        with self._lock:
-            try:
-                return request(self._socket(), op, header, body,
-                               timeout=timeout)
-            except WorkerDown:
-                # stale connection (worker restarted, idle RST): one
-                # reconnect, then the op's own idempotence carries it
-                self._drop_locked()
+        budget = timeout if timeout else self.base_timeout_s()
+        # the structural hedge gate: only allowlisted (wire-idempotent)
+        # ops ever get a shortened first deadline
+        hedged = self.hedge_fraction is not None and op in HEDGE_SAFE_OPS
+        first = budget * self.hedge_fraction if hedged else budget
+        t0 = time.monotonic()
+        ok = False
+        try:
+            with self._lock:
                 try:
-                    return request(self._socket(), op, header, body,
-                                   timeout=timeout)
-                except WorkerDown:
+                    rv = request(self._socket(), op, header, body,
+                                 timeout=first)
+                    ok = True
+                    return rv
+                except WorkerDown as e:
+                    # stale connection (worker restarted, idle RST) or a
+                    # burned hedge fraction: one fresh-connection attempt
+                    # with the remaining budget, then the op's own
+                    # idempotence carries it
                     self._drop_locked()
-                    raise
+                    hedge = hedged and isinstance(e.__cause__,
+                                                  socket.timeout)
+                    if hedge:
+                        self.hedge_attempts += 1
+                    remaining = max(budget - (time.monotonic() - t0), 0.05)
+                    try:
+                        rv = request(self._socket(), op, header, body,
+                                     timeout=remaining)
+                    except WorkerDown:
+                        self._drop_locked()
+                        raise
+                    if hedge:
+                        self.hedge_wins += 1
+                    ok = True
+                    return rv
+        finally:
+            if self.observer is not None:
+                try:
+                    self.observer(op, time.monotonic() - t0, ok)
+                except Exception:   # noqa: BLE001 — evidence must never
+                    pass            # fail the op it describes
 
 
 class RuntimeProxy:
@@ -125,9 +199,13 @@ class RuntimeProxy:
 
     procmesh_proxy = True
 
-    def __init__(self, client: WorkerClient, tenant_id: str):
+    def __init__(self, client: WorkerClient, tenant_id: str,
+                 slo_class: Optional[str] = None):
         self.client = client
         self.tenant_id = tenant_id
+        # the tenant's SLO class scales every per-op deadline budget
+        # (ISSUE 19): premium fails over fast, besteffort waits longer
+        self.slo_class = slo_class
         self.callbacks: dict = {}       # stream_id -> [StreamCallback]
         self.delivered = -1             # highest outbox idx dispatched
         self._pending: list = []        # undispatched (idx, sid, ts, row)
@@ -137,6 +215,12 @@ class RuntimeProxy:
         self.out_epoch = 0
         self.raw_hooks: list = []       # fn([(epoch, idx, sid, ts, row)...])
         self.on_delivered = None        # fn(highest_idx) — journal cursor
+
+    def _deadline(self, op: str) -> float:
+        """Per-op deadline budget: op class × SLO class × the client's
+        resolved base (MeshConfig > env > default)."""
+        return op_deadline_s(op, self.slo_class,
+                             self.client.base_timeout_s())
 
     # -- ingest / outputs ----------------------------------------------------
     def send_chunk(self, seq: int, stream_id: str, rows: list,
@@ -155,10 +239,12 @@ class RuntimeProxy:
         if types is not None:
             h["enc"] = "soa"
             rh, _ = self.client.call(
-                "ingest", h, body=pack_rows(types, rows, ts))
+                "ingest", h, body=pack_rows(types, rows, ts),
+                timeout=self._deadline("ingest"))
         else:
             h["rows"], h["ts"] = rows, ts
-            rh, _ = self.client.call("ingest", h)
+            rh, _ = self.client.call("ingest", h,
+                                     timeout=self._deadline("ingest"))
         self._buffer(rh.get("events", ()))
         return bool(rh.get("applied"))
 
@@ -213,7 +299,8 @@ class RuntimeProxy:
         journaled delivery cursor ``ack``, buffers the undelivered tail,
         and returns the child's authoritative applied mark."""
         rh, _ = self.client.call("resync", {"tenant": self.tenant_id,
-                                            "ack": ack})
+                                            "ack": ack},
+                                 timeout=self._deadline("resync"))
         if rh.get("present"):
             self.delivered = max(self.delivered, int(ack))
             self._buffer(rh.get("events", ()))
@@ -238,19 +325,23 @@ class RuntimeProxy:
 
     def flush_host(self) -> None:
         rh, _ = self.client.call("flush", {"tenant": self.tenant_id,
-                                           "ack": self.delivered})
+                                           "ack": self.delivered},
+                                 timeout=self._deadline("flush"))
         self._buffer(rh.get("events", ()))
 
     def snapshot(self) -> bytes:
-        _, blob = self.client.call("snapshot", {"tenant": self.tenant_id})
+        _, blob = self.client.call("snapshot", {"tenant": self.tenant_id},
+                                   timeout=self._deadline("snapshot"))
         return blob
 
     def restore(self, blob: bytes, applied: int = 0) -> None:
         self.client.call("restore", {"tenant": self.tenant_id,
-                                     "applied": applied}, body=blob)
+                                     "applied": applied}, body=blob,
+                         timeout=self._deadline("restore"))
 
     def shutdown(self) -> None:     # parity with SiddhiAppRuntime.shutdown
-        self.client.call("undeploy", {"tenant": self.tenant_id})
+        self.client.call("undeploy", {"tenant": self.tenant_id},
+                         timeout=self._deadline("undeploy"))
 
 
 class ProcMeshHost:
@@ -269,6 +360,9 @@ class ProcMeshHost:
         self.rows_in = 0
         self.reserved = 0
         self.alive = True
+        # degrade-drain flag (ISSUE 19): a draining host serves its
+        # current tenants but takes no new placements
+        self.draining = False
         self._specs: dict = {}          # tenant_id -> TenantSpec (redeploy)
         self._sm = None
         self._scrape_cache: dict = {}
@@ -293,11 +387,14 @@ class ProcMeshHost:
 
     # -- tenant lifecycle ----------------------------------------------------
     def deploy(self, spec) -> RuntimeProxy:
+        klass = slo_class_of(spec.app_text)
         self.client.call("deploy", {"tenant": spec.tenant_id,
                                     "app_text": spec.app_text,
                                     "playback": self.playback},
-                         timeout=max(IO_TIMEOUT_S, 60.0))
-        proxy = RuntimeProxy(self.client, spec.tenant_id)
+                         timeout=max(op_deadline_s(
+                             "deploy", klass,
+                             self.client.base_timeout_s()), 60.0))
+        proxy = RuntimeProxy(self.client, spec.tenant_id, slo_class=klass)
         self.runtimes[spec.tenant_id] = proxy
         self._specs[spec.tenant_id] = spec
         return proxy
@@ -307,7 +404,8 @@ class ProcMeshHost:
         recovery re-adoption): no deploy op — the shard keeps its engine
         state; the caller reconciles cursors via :meth:`RuntimeProxy.
         resync`."""
-        proxy = RuntimeProxy(self.client, spec.tenant_id)
+        proxy = RuntimeProxy(self.client, spec.tenant_id,
+                             slo_class=slo_class_of(spec.app_text))
         self.runtimes[spec.tenant_id] = proxy
         self._specs[spec.tenant_id] = spec
         return proxy

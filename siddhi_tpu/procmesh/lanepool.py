@@ -43,11 +43,11 @@ from .protocol import (
     F_ERR,
     F_REQ,
     F_RES,
-    IO_TIMEOUT_S,
     READY_TIMEOUT_S,
     WorkerOpError,
     child_env,
     connect,
+    io_timeout_s,
     recv_frame,
     send_frame,
 )
@@ -173,7 +173,9 @@ class ProcessLanePool:
 
     # -- rpc ------------------------------------------------------------------
     def _rpc(self, ch: _LaneChild, op: str, header: Optional[dict] = None,
-             body: bytes = b"", timeout: float = IO_TIMEOUT_S):
+             body: bytes = b"", timeout: Optional[float] = None):
+        if timeout is None:
+            timeout = io_timeout_s()    # env-tunable, resolved per op
         h = dict(header or {})
         h["op"] = op
         try:
@@ -347,7 +349,7 @@ def _serve(listener: socket.socket) -> None:
             conn, _ = listener.accept()
         except socket.timeout:
             continue
-    conn.settimeout(IO_TIMEOUT_S)
+    conn.settimeout(io_timeout_s())
     while True:
         try:
             kind, h, body = recv_frame(conn, timeout=_STEP_TIMEOUT_S)

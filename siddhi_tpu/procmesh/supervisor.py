@@ -35,6 +35,7 @@ import time
 from typing import Callable, Optional
 
 from ..observability.flight_recorder import FlightRecorder
+from ..observability.histogram import LogHistogram
 from ..resilience.circuit import RestartBackoff
 from ..resilience.dcn_guard import PeerHealth
 from .host import ProcMeshHost, WorkerClient
@@ -68,7 +69,14 @@ class SupervisorConfig:
                  restart_max: int = 5,
                  auto_restart: bool = True,
                  env: Optional[dict] = None,
-                 run_dir: Optional[str] = None):
+                 run_dir: Optional[str] = None,
+                 io_timeout_s: Optional[float] = None,
+                 connect_timeout_s: Optional[float] = None,
+                 hedge_fraction: Optional[float] = 0.45,
+                 wedge_threshold: int = 3,
+                 degrade_factor: float = 4.0,
+                 degrade_floor_s: float = 0.05,
+                 degrade_min_samples: int = 16):
         self.heartbeat_interval_s = float(heartbeat_interval_s)
         self.failure_threshold = int(failure_threshold)
         self.down_cooldown_s = float(down_cooldown_s)
@@ -82,6 +90,21 @@ class SupervisorConfig:
         # workers persist runfiles here at handshake; a restarted
         # supervisor scans them to re-adopt live shards (parent recovery)
         self.run_dir = run_dir
+        # gray-failure surface (ISSUE 19): base control-op deadline
+        # (None = SIDDHI_PROCMESH_IO_TIMEOUT_S env or the module default),
+        # the hedge fraction for idempotent ops (None disables hedging),
+        # and the latency-evidence ladder knobs — wedge_threshold
+        # consecutive substantive-op timeouts while heartbeats succeed ⇒
+        # *wedged*; a windowed op p99 above degrade_factor × the fleet
+        # median (and above degrade_floor_s, with degrade_min_samples in
+        # the window) ⇒ *degraded*. degrade_factor <= 0 disables the rung.
+        self.io_timeout_s = io_timeout_s
+        self.connect_timeout_s = connect_timeout_s
+        self.hedge_fraction = hedge_fraction
+        self.wedge_threshold = int(wedge_threshold)
+        self.degrade_factor = float(degrade_factor)
+        self.degrade_floor_s = float(degrade_floor_s)
+        self.degrade_min_samples = int(degrade_min_samples)
 
 
 class ProcWorkerHandle:
@@ -105,13 +128,40 @@ class ProcWorkerHandle:
                                  cfg.down_cooldown_s)
         self.backoff = RestartBackoff(cfg.restart_base_s, cfg.restart_max_s,
                                       cfg.restart_window_s, cfg.restart_max)
-        self.client = WorkerClient(lambda: self.port)
+        self.client = WorkerClient(lambda: self.port,
+                                   io_timeout_s=cfg.io_timeout_s,
+                                   connect_timeout_s=cfg.connect_timeout_s,
+                                   hedge_fraction=cfg.hedge_fraction,
+                                   observer=self.note_op)
+        # latency EVIDENCE (ISSUE 19): every control op the fabric sends
+        # through this handle's client lands in a per-op LogHistogram;
+        # heartbeat RTTs get their own (a 1.9s heartbeat is no longer the
+        # same evidence as a 1ms one). op_timeouts counts CONSECUTIVE
+        # substantive-op failures — the wedge detector's input.
+        self.hb_hist = LogHistogram()
+        self.op_hist: dict = {}
+        self.op_lat = LogHistogram()    # all non-ping ops merged
+        self.lat_chk = None             # windowed-p99 cursor (degrade rung)
+        self.op_timeouts = 0
         self.flight_cursor = 0          # child flight-ring tail (since_ns)
         # estimated wall-clock LEAD of the child over this process
         # (child_unix_ns - parent_unix_ns), from the ready hello and
         # refined by ping RTT midpoints — the federation layer uses it to
         # causally order merged flight timelines and stitched trace spans
         self.clock_offset_ns = 0
+
+    def note_op(self, op: str, seconds: float, ok: bool) -> None:
+        """WorkerClient observer: one record per user-level call, with the
+        final outcome. A failed op still records the budget it burned —
+        a timed-out op IS tail-latency evidence."""
+        if op == "ping":
+            return                  # heartbeats have their own histogram
+        hist = self.op_hist.get(op)
+        if hist is None:
+            hist = self.op_hist[op] = LogHistogram()
+        hist.record(seconds)
+        self.op_lat.record(seconds)
+        self.op_timeouts = 0 if ok else self.op_timeouts + 1
 
     @property
     def alive(self) -> bool:
@@ -183,6 +233,10 @@ class ProcMeshSupervisor:
         self.on_restarted: Optional[Callable[[int], None]] = None
         self.on_gave_up: Optional[Callable[[int], None]] = None
         self.on_escalation: Optional[Callable[[dict], None]] = None
+        # gray-failure actuator wiring (ISSUE 19): the fabric drains a
+        # degraded worker's tenants away / re-admits a recovered one
+        self.on_degraded: Optional[Callable[[int], None]] = None
+        self.on_undegraded: Optional[Callable[[int], None]] = None
         self._sm = None
         self._stop = threading.Event()
         self._monitor = None
@@ -333,6 +387,10 @@ class ProcMeshSupervisor:
                     # must never take the monitor down
                     log.exception("procmesh: monitor check of worker %d "
                                   "failed", h.index)
+            try:
+                self._evaluate_degrade()
+            except Exception:       # noqa: BLE001
+                log.exception("procmesh: degrade evaluation failed")
             self._stop.wait(self.cfg.heartbeat_interval_s)
 
     def _check(self, h: ProcWorkerHandle) -> None:
@@ -352,6 +410,11 @@ class ProcMeshSupervisor:
                 self._on_death(h, cause="heartbeat")
             return
         h.health.record_success()
+        rtt_s = (t1 - t0) / 1e9
+        h.hb_hist.record(rtt_s)         # RTT is health EVIDENCE, not a bool
+        if self._sm is not None:
+            self._sm.latency_tracker(
+                f"procmesh.w{h.index}.heartbeat").record_seconds(rtt_s)
         if rh.get("unix_ns") is not None:
             # every heartbeat refreshes the RTT-midpoint offset estimate
             h.clock_offset_ns = int(rh["unix_ns"]) - (t0 + t1) // 2
@@ -360,6 +423,81 @@ class ProcMeshSupervisor:
         for decision in rh.get("escalations", ()):
             if self.on_escalation is not None:
                 self.on_escalation(decision)
+        if (h.op_timeouts >= self.cfg.wedge_threshold
+                and not h.health.wedged):
+            # the gray signature: THIS heartbeat just succeeded while
+            # substantive ops keep timing out — the worker is wedged
+            self._on_wedged(h)
+
+    def _on_wedged(self, h: ProcWorkerHandle) -> None:
+        """Classify a heartbeat-OK-but-ops-timing-out worker as *wedged*
+        and treat it as down (kill + backoff-paced restart). EVIDENCE
+        FIRST: the classification, with the op-latency tails that earned
+        it, is on the ring before the worker is condemned."""
+        with self._lock:
+            if h.gave_up or h.health.wedged:
+                return
+            self.flight.record(
+                "procmesh", "decision:worker_wedged",
+                site=f"worker:{h.index}",
+                detail={"op_timeouts": h.op_timeouts,
+                        "heartbeat_p99_s": h.hb_hist.percentile(0.99),
+                        "op_p99_s": {op: hs.percentile(0.99)
+                                     for op, hs in h.op_hist.items()}})
+            h.health.mark_wedged()
+        self._on_death(h, cause="wedged")
+
+    def _evaluate_degrade(self) -> None:
+        """Fleet-relative tail-outlier detection: each sweep closes one
+        window over every worker's merged op histogram; a worker whose
+        windowed p99 exceeds ``degrade_factor`` × the median of its PEERS'
+        p99s (above an absolute floor) goes *degraded* and the fabric
+        drains it. Recovery (half the trip threshold — hysteresis) clears
+        the rung and re-admits the worker for placement."""
+        cfg = self.cfg
+        if cfg.degrade_factor <= 0:
+            return
+        wins = {}
+        for h in self.handles.values():
+            if h.gave_up or h.health.wedged or not h.alive:
+                continue
+            chk, h.lat_chk = h.lat_chk, h.op_lat.checkpoint()
+            if chk is None:
+                continue
+            win = h.op_lat.since(chk)
+            if win["count"] >= cfg.degrade_min_samples:
+                wins[h.index] = win
+        for idx, win in wins.items():
+            others = sorted(w["p99"] for j, w in wins.items() if j != idx)
+            if not others:
+                continue            # fleet-relative needs a fleet
+            med = others[len(others) // 2]
+            trip = max(cfg.degrade_floor_s, cfg.degrade_factor * med)
+            h = self.handles[idx]
+            if win["p99"] > trip and not h.health.degraded:
+                with self._lock:
+                    if h.health.degraded:
+                        continue
+                    self.flight.record(
+                        "procmesh", "decision:worker_degraded",
+                        site=f"worker:{idx}",
+                        detail={"p99_s": win["p99"],
+                                "peer_median_p99_s": med,
+                                "window_count": win["count"],
+                                "factor": cfg.degrade_factor})
+                    h.health.mark_degraded()
+                if self.on_degraded is not None:
+                    self.on_degraded(idx)
+            elif h.health.degraded and win["p99"] <= trip / 2.0:
+                with self._lock:
+                    self.flight.record(
+                        "procmesh", "worker_undegraded",
+                        site=f"worker:{idx}",
+                        detail={"p99_s": win["p99"],
+                                "peer_median_p99_s": med})
+                    h.health.clear_degraded()
+                if self.on_undegraded is not None:
+                    self.on_undegraded(idx)
 
     def _on_death(self, h: ProcWorkerHandle, cause: str) -> None:
         with self._lock:
@@ -416,6 +554,11 @@ class ProcMeshSupervisor:
                 self._stop.wait(delay)
             h.kill()                    # no half-dead twins
             h.reap()
+            # the respawn starts with a clean gray slate: the evidence
+            # that condemned the old incarnation must not condemn the new
+            h.health.clear_wedged()
+            h.health.clear_degraded()
+            h.op_timeouts = 0
             self._spawn(h)
             try:
                 self._await_ready(h)
@@ -472,6 +615,20 @@ class ProcMeshSupervisor:
                              lambda h=h: h.health.last_downtime_s)
             sm.gauge_tracker(f"procmesh.w{i}.clock_offset_ns",
                              lambda h=h: h.clock_offset_ns)
+            sm.gauge_tracker(f"procmesh.w{i}.op_timeouts",
+                             lambda h=h: h.op_timeouts)
+            sm.gauge_tracker(f"procmesh.w{i}.wedges_total",
+                             lambda h=h: h.health.wedge_count)
+            sm.gauge_tracker(f"procmesh.w{i}.degrades_total",
+                             lambda h=h: h.health.degrade_count)
+            sm.gauge_tracker(f"procmesh.w{i}.hedge_attempts_total",
+                             lambda h=h: h.client.hedge_attempts)
+            sm.gauge_tracker(f"procmesh.w{i}.hedge_wins_total",
+                             lambda h=h: h.client.hedge_wins)
+            # heartbeat RTT as a real histogram family —
+            # siddhi_tpu_procmesh_heartbeat_seconds{worker="w{i}"};
+            # _check records into it on every successful ping
+            sm.latency_tracker(f"procmesh.w{i}.heartbeat")
         sm.gauge_tracker("procmesh.self.workers",
                          lambda: sum(1 for h in self.handles.values()
                                      if h.alive))
@@ -487,6 +644,12 @@ class ProcMeshSupervisor:
             h.index: {"alive": h.alive, "pid": h.pid, "port": h.port,
                       "restarts": h.restarts, "kills": h.kills,
                       "gave_up": h.gave_up, "adopted": h.adopted,
+                      "op_timeouts": h.op_timeouts,
+                      "heartbeat": h.hb_hist.snapshot(),
+                      "op_p99_s": {op: hs.percentile(0.99)
+                                   for op, hs in h.op_hist.items()},
+                      "hedge_attempts": h.client.hedge_attempts,
+                      "hedge_wins": h.client.hedge_wins,
                       **h.health.report()}
             for h in self.handles.values()}}
 

@@ -42,9 +42,10 @@ from .protocol import (
     F_ERR,
     F_REQ,
     F_RES,
-    IO_TIMEOUT_S,
+    io_timeout_s,
     recv_frame,
     send_frame,
+    wire_counters,
 )
 
 log = logging.getLogger("siddhi_tpu.procmesh.worker")
@@ -93,6 +94,16 @@ class WorkerServer:
         # if pid AND nonce match its runfile (pid reuse cannot spoof a shard)
         self.nonce = os.urandom(8).hex()
         self.started = time.monotonic()
+        # gray-failure chaos hook (ISSUE 19): when armed (op_wedge or the
+        # SIDDHI_PROCMESH_WEDGE_S env at boot), every SUBSTANTIVE op
+        # stalls this many seconds BEFORE taking the dispatch lock — so
+        # heartbeat pings keep answering while real work times out: the
+        # alive-yet-wedged gray failure, as a real process
+        try:
+            self._wedge_s = float(
+                os.environ.get("SIDDHI_PROCMESH_WEDGE_S", 0) or 0)
+        except ValueError:
+            self._wedge_s = 0.0
         self._lock = threading.RLock()     # all op handling (control rate)
         self._stop = threading.Event()
         self._listener = None
@@ -143,7 +154,7 @@ class WorkerServer:
 
     # -- serve loop ----------------------------------------------------------
     def _serve(self, conn: socket.socket) -> None:
-        conn.settimeout(IO_TIMEOUT_S)
+        conn.settimeout(io_timeout_s())
         try:
             while not self._stop.is_set():
                 try:
@@ -182,6 +193,11 @@ class WorkerServer:
         fn = getattr(self, f"op_{op}", None)
         if fn is None:
             raise ValueError(f"unknown procmesh op '{op}'")
+        if self._wedge_s > 0 and op not in ("ping", "wedge", "stop"):
+            # stall OUTSIDE the dispatch lock: a wedge that held the lock
+            # would also stall pings and read as a plain crash — the whole
+            # point is heartbeats stay green while work times out
+            time.sleep(self._wedge_s)
         with self._lock:
             return fn(h, body)
 
@@ -228,7 +244,21 @@ class WorkerServer:
                 # estimates this process's clock offset from the request
                 # RTT midpoint (refreshed on every adoption/restart)
                 "unix_ns": time.time_ns(),
+                # receiver-side wire-integrity detections (crc_rejected /
+                # dup_frames_dropped): the exactly-once evidence the
+                # chaos gauntlet reads back
+                "wire": wire_counters(),
                 "escalations": esc}, b""
+
+    def op_wedge(self, h: dict, body: bytes):
+        """Chaos op: arm (or clear, with 0) the gray-failure stall — every
+        subsequent substantive op sleeps ``stall_s`` before dispatch while
+        pings keep answering. The bench gauntlet and tests wedge a LIVE
+        worker mid-run with this; production never calls it."""
+        self._wedge_s = float(h.get("stall_s", 0) or 0)
+        self.flight.record("procmesh", "chaos:wedge", f"w{self.index}",
+                           detail={"stall_s": self._wedge_s})
+        return {"stall_s": self._wedge_s}, b""
 
     def op_deploy(self, h: dict, body: bytes):
         tid = h["tenant"]
@@ -389,6 +419,7 @@ class WorkerServer:
             "tenants": len(self.tenants),
             "rows_in": self.rows_in,
             "pid": os.getpid(),
+            "wire": wire_counters(),
             "compiled_programs":
                 self.manager.fleet.plan_cache.stats()["size"],
             **self.manager.fleet.mesh_evidence(),

@@ -53,11 +53,18 @@ PEER_HEALTHY = "healthy"
 PEER_SUSPECT = "suspect"
 PEER_PROBING = "probing"
 PEER_DOWN = "down"
+# gray-failure rungs (ISSUE 19): latency EVIDENCE, not liveness evidence.
+# *degraded* — alive and correct but a fleet-relative tail outlier; the
+# fabric proactively drains tenants off it. *wedged* — heartbeats answer
+# while substantive ops time out; operationally DOWN (a heartbeat proves
+# the event loop breathes, not that work completes).
+PEER_DEGRADED = "degraded"
+PEER_WEDGED = "wedged"
 
 # numeric codes for the peer_state gauge (a time series must not carry
 # strings — same convention as CircuitState.CODES)
 PEER_STATE_CODES = {PEER_HEALTHY: 0, PEER_SUSPECT: 1, PEER_PROBING: 2,
-                    PEER_DOWN: 3}
+                    PEER_DOWN: 3, PEER_DEGRADED: 4, PEER_WEDGED: 5}
 
 PEER_COUNTER_KEYS = ("pings", "ping_failures", "retries", "reconnects",
                      "redirects")
@@ -66,12 +73,21 @@ PEER_COUNTER_KEYS = ("pings", "ping_failures", "retries", "reconnects",
 class PeerHealth:
     """Per-peer failure detector over a :class:`CircuitBreaker`.
 
-    The breaker's three states map onto the four peer states: CLOSED splits
-    into *healthy* (no consecutive failures) and *suspect* (some, below the
-    threshold); OPEN is *down*; HALF_OPEN is *probing*. ``down_since`` is
-    pinned at the first OPEN transition and survives failed probes (a
-    re-opened breaker resets ``opened_at``, which would otherwise push the
-    takeover deadline out on every probe).
+    The breaker's three states map onto the four liveness states: CLOSED
+    splits into *healthy* (no consecutive failures) and *suspect* (some,
+    below the threshold); OPEN is *down*; HALF_OPEN is *probing*.
+    ``down_since`` is pinned at the first OPEN transition and survives
+    failed probes (a re-opened breaker resets ``opened_at``, which would
+    otherwise push the takeover deadline out on every probe).
+
+    Two latency-evidence overlays (ISSUE 19) extend the ladder: *wedged*
+    (:meth:`mark_wedged` — heartbeats OK, substantive ops timing out)
+    outranks everything but a hard OPEN and is treated as down by every
+    caller (:meth:`is_down`); *degraded* (:meth:`mark_degraded` — a
+    fleet-relative p99 outlier) shows below probing and triggers a
+    proactive drain, but the peer keeps serving. Both flags are FED by
+    the supervisor's per-op histograms — the breaker alone cannot see a
+    gray failure because heartbeat successes keep it CLOSED.
     """
 
     def __init__(self, failure_threshold: int = 3,
@@ -81,15 +97,50 @@ class PeerHealth:
         self.clock = clock
         self.down_since: Optional[float] = None
         self.last_downtime_s = 0.0      # length of the last CLOSED outage
+        self.wedged = False             # gray overlay: ops stall, pings OK
+        self.degraded = False           # gray overlay: fleet p99 outlier
+        self.wedge_count = 0            # lifetime wedge declarations
+        self.degrade_count = 0          # lifetime degrade declarations
 
     @property
     def state(self) -> str:
         st = self.breaker.state
         if st == CircuitState.OPEN:
             return PEER_DOWN
+        if self.wedged:
+            return PEER_WEDGED
         if st == CircuitState.HALF_OPEN:
             return PEER_PROBING
+        if self.degraded:
+            return PEER_DEGRADED
         return PEER_SUSPECT if self.breaker.suspect else PEER_HEALTHY
+
+    def is_down(self) -> bool:
+        """Operationally down: hard-down OR wedged — a wedged peer must
+        not be trusted with work even though its heartbeats answer."""
+        return self.state in (PEER_DOWN, PEER_WEDGED)
+
+    def mark_wedged(self) -> None:
+        """Declare gray-down on latency evidence: heartbeats succeed while
+        substantive ops time out. Pins ``down_since`` (the outage clock
+        starts at DETECTION, not at the eventual kill) — cleared only by
+        :meth:`clear_wedged` after a restart heals the worker."""
+        if not self.wedged:
+            self.wedge_count += 1
+        self.wedged = True
+        if self.down_since is None:
+            self.down_since = self.clock()
+
+    def clear_wedged(self) -> None:
+        self.wedged = False
+
+    def mark_degraded(self) -> None:
+        if not self.degraded:
+            self.degrade_count += 1
+        self.degraded = True
+
+    def clear_degraded(self) -> None:
+        self.degraded = False
 
     @property
     def state_code(self) -> int:
@@ -101,6 +152,11 @@ class PeerHealth:
         return self.breaker.allow()
 
     def record_success(self) -> None:
+        if self.wedged:
+            # heartbeat successes are exactly the gray-failure signature:
+            # they must neither close the breaker's view of the outage
+            # nor stop the downtime clock — only clear_wedged() does
+            return
         if self.down_since is not None:
             # close the outage, keeping its length: the restart-latency
             # evidence outlives the recovery that ends it
@@ -138,7 +194,10 @@ class PeerHealth:
                 "open_count": self.breaker.open_count,
                 "down_since": self.down_since,
                 "downtime_s": self.downtime_s(),
-                "last_downtime_s": self.last_downtime_s}
+                "last_downtime_s": self.last_downtime_s,
+                "wedged": self.wedged, "degraded": self.degraded,
+                "wedge_count": self.wedge_count,
+                "degrade_count": self.degrade_count}
 
 
 class SpillQueue:
